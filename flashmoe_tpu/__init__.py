@@ -1,0 +1,21 @@
+"""flashmoe-tpu: TPU-native distributed Mixture-of-Experts framework.
+
+A ground-up JAX / XLA / Pallas re-design with the capability envelope of
+osayamenja/FlashMoE (surveyed in SURVEY.md): fused gate, capacity/ragged
+token dispatch, grouped expert FFN kernels, expert-parallel all-to-all over
+TPU meshes, topology-aware expert placement, and a transformer model family
+on top.
+"""
+
+__version__ = "0.1.0"
+
+from flashmoe_tpu.config import Activation, MoEConfig, BENCH_CONFIGS
+from flashmoe_tpu.ops.moe import moe_layer, MoEOutput
+
+__all__ = [
+    "Activation",
+    "MoEConfig",
+    "BENCH_CONFIGS",
+    "moe_layer",
+    "MoEOutput",
+]

@@ -1,0 +1,261 @@
+"""Static configuration system for flashmoe-tpu.
+
+The reference (osayamenja/FlashMoE) bakes its model/job parameters in at
+*compile time*: ``csrc/flashmoe_config.json`` is converted to ``-D`` macros by
+``setup.py:226-292`` / ``CMakeLists.txt:114-159`` and consumed into the
+``ACC`` constexpr struct (``csrc/include/flashmoe/types.cuh:441-512``), which
+derives ~40 compile-time constants (token count ``S``, expert capacity ``EC``,
+padded capacity ``pEC``, tile counts, gate reduction mode, combine mode, ...).
+
+On TPU we get the same "compile-time specialization" for free from JAX
+tracing: a frozen, hashable dataclass passed as a static argument (or closed
+over) specializes every ``jit``/Pallas compilation to the exact shapes, with
+no rebuild step.  This module is therefore the TPU-native equivalent of the
+whole JSON -> macro -> ``ACC`` pipeline, including the schema constraints of
+``csrc/flashmoe_config.schema.json:34-63`` (divisibility requirements) and
+the derived-quantity formulas of ``types.cuh:497-499``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Any
+
+import jax.numpy as jnp
+
+# TPU-native tile geometry.  The MXU is a 128x128 systolic array and the VPU
+# operates on (8, 128) vregs; 128 is the universal lane width.  The reference
+# uses BLOCK_M=128 / BLOCK_N=64 CUDA tiles (types.cuh); on TPU the natural
+# block is 128x128.
+BLOCK_M = 128
+BLOCK_N = 128
+LANE = 128
+
+
+class Activation:
+    """Activation selector, mirroring ``hidden_act`` (0=relu / 1=gelu) in
+    ``csrc/flashmoe_config.json`` with TPU-relevant extensions."""
+
+    RELU = "relu"
+    GELU = "gelu"
+    SILU = "silu"  # used by Mixtral/DeepSeek family (gated FFN)
+
+
+_DTYPE_MAP = {
+    # reference torch_dtype codes: 0=f32 / 1=tf32 / 2=bf16 / 3=fp16
+    # (csrc/flashmoe_config.schema.json).  tf32 has no TPU equivalent; the
+    # closest MXU mode is bf16 inputs with f32 accumulation, which is what
+    # "bf16" here means.  fp16 is not TPU-native; we map it to bf16.
+    0: jnp.float32,
+    1: jnp.bfloat16,
+    2: jnp.bfloat16,
+    3: jnp.bfloat16,
+    "float32": jnp.float32,
+    "f32": jnp.float32,
+    "tf32": jnp.bfloat16,
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "float16": jnp.bfloat16,
+    "fp16": jnp.bfloat16,
+}
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """Frozen model/job configuration.
+
+    Field names follow ``csrc/flashmoe_config.json:1-17`` where a counterpart
+    exists; everything derived mirrors ``ACC`` (``types.cuh:441-512``).
+    Instances are hashable and therefore usable as ``jit`` static arguments.
+    """
+
+    # --- core MoE shape (reference names) ---
+    num_experts: int = 8
+    expert_top_k: int = 2
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    sequence_len: int = 128
+    mini_batch: int = 1
+    global_batch: int = 1
+    capacity_factor: float = 1.25
+    drop_tokens: bool = True
+    is_training: bool = False
+    hidden_act: str = Activation.GELU
+
+    # --- full-model shape ---
+    num_layers: int = 2
+    moe_frequency: int = 1  # every Nth layer is MoE
+    vocab_size: int = 32000
+
+    # --- extensions beyond the reference (needed for a full framework) ---
+    num_shared_experts: int = 0  # DeepSeekMoE-style always-on experts
+    num_heads: int = 8
+    num_kv_heads: int = 0  # 0 => = num_heads (MHA); <num_heads => GQA
+    head_dim: int = 0  # 0 => hidden_size // num_heads
+    gated_ffn: bool = False  # SwiGLU-style expert FFN (Mixtral/DeepSeek)
+    router_jitter: float = 0.0
+    aux_loss_coef: float = 0.01
+    router_z_loss_coef: float = 0.0
+    rope_theta: float = 10000.0
+
+    # --- numerics ---
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    accum_dtype: Any = jnp.float32
+
+    # --- parallelism (mesh axis sizes; 1 = off) ---
+    dp: int = 1  # data parallel
+    ep: int = 1  # expert parallel
+    tp: int = 1  # tensor parallel
+    sp: int = 1  # sequence/context parallel
+    pp: int = 1  # pipeline parallel
+
+    def __post_init__(self):
+        if self.num_experts < 1:
+            raise ValueError("num_experts must be >= 1")
+        if not (1 <= self.expert_top_k <= self.num_experts):
+            raise ValueError("expert_top_k must be in [1, num_experts]")
+        # schema.json:34-63: hidden/intermediate multipleOf 64, seq multipleOf 128.
+        if self.hidden_size % 64:
+            raise ValueError("hidden_size must be a multiple of 64")
+        if self.intermediate_size % 64:
+            raise ValueError("intermediate_size must be a multiple of 64")
+        if self.num_experts > 1 and self.num_experts % self.ep:
+            raise ValueError("num_experts must divide evenly over ep")
+        if self.capacity_factor <= 0:
+            raise ValueError("capacity_factor must be > 0")
+
+    # ------------------------------------------------------------------
+    # Derived quantities (ACC equivalents, types.cuh:441-512)
+    # ------------------------------------------------------------------
+
+    @property
+    def tokens(self) -> int:
+        """S = sequence_len * mini_batch (types.cuh:470)."""
+        return self.sequence_len * self.mini_batch
+
+    @property
+    def padded_num_experts(self) -> int:
+        """PX: experts padded to the lane width (types.cuh ``PX``)."""
+        return _round_up(self.num_experts, LANE)
+
+    @property
+    def expert_capacity(self) -> int:
+        """EC (types.cuh:497-499): CF * TK * ceil(S/E) when dropping, else S.
+
+        Note this is the capacity *per expert per device-shard of tokens*;
+        the EP layer applies it to the local token shard.
+        """
+        if not self.drop_tokens:
+            return self.tokens
+        return max(
+            1,
+            int(
+                math.ceil(
+                    self.capacity_factor
+                    * self.expert_top_k
+                    * math.ceil(self.tokens / self.num_experts)
+                )
+            ),
+        )
+
+    @property
+    def padded_expert_capacity(self) -> int:
+        """pEC: EC padded to the block size (types.cuh ``pEC``)."""
+        return _round_up(self.expert_capacity, 8)
+
+    @property
+    def num_local_experts(self) -> int:
+        """nLx under the (uniform) EP sharding."""
+        return max(1, self.num_experts // self.ep)
+
+    @property
+    def resolved_num_kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def moe_layer_indices(self) -> tuple[int, ...]:
+        """Which transformer layers carry an MoE FFN (vs dense)."""
+        if self.num_experts <= 1:
+            return ()
+        f = max(1, self.moe_frequency)
+        return tuple(i for i in range(self.num_layers) if (i + 1) % f == 0)
+
+    @property
+    def param_count(self) -> int:
+        """PC (types.cuh:491-492): Chinchilla-style dense parameter count used
+        by the Decider's cost model for gradient-buffer sizing."""
+        h, i, v, l = (
+            self.hidden_size,
+            self.intermediate_size,
+            self.vocab_size,
+            self.num_layers,
+        )
+        return v * h + l * (4 * h * h + 2 * h * i) + h * v
+
+    # ------------------------------------------------------------------
+    # IO
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def from_json(cls, path_or_dict) -> "MoEConfig":
+        """Load from a reference-style ``flashmoe_config.json`` dict/file."""
+        if isinstance(path_or_dict, (str,)):
+            with open(path_or_dict) as f:
+                raw = json.load(f)
+        else:
+            raw = dict(path_or_dict)
+        act = raw.pop("hidden_act", 1)
+        if isinstance(act, int):
+            act = Activation.RELU if act == 0 else Activation.GELU
+        dtype = _DTYPE_MAP[raw.pop("torch_dtype", 2)]
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in raw.items() if k in known}
+        for b in ("drop_tokens", "is_training"):
+            if b in kwargs:
+                kwargs[b] = bool(kwargs[b])
+        return cls(hidden_act=act, dtype=dtype, **kwargs)
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        for k in ("dtype", "param_dtype", "accum_dtype"):
+            d[k] = jnp.dtype(d[k]).name
+        return json.dumps(d, indent=2)
+
+    def replace(self, **kw) -> "MoEConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# Benchmark configurations from BASELINE.json / BASELINE.md.
+BENCH_CONFIGS = {
+    # 1. correctness reference
+    "tiny": MoEConfig(num_experts=8, expert_top_k=2, hidden_size=1024,
+                      intermediate_size=4096, sequence_len=128),
+    # 2. single-chip token-scaling bench (reference headline config uses
+    #    E=64, H=2048, I=2048, S=8192; BASELINE.json asks d_model=4096, S=4096)
+    "token_scaling": MoEConfig(num_experts=64, expert_top_k=2, hidden_size=4096,
+                               intermediate_size=4096, sequence_len=4096,
+                               capacity_factor=1.0),
+    "reference": MoEConfig(num_experts=64, expert_top_k=2, hidden_size=2048,
+                           intermediate_size=2048, sequence_len=8192,
+                           capacity_factor=1.0),
+    # 3. Mixtral-8x7B FFN dims, 8-chip EP
+    "mixtral": MoEConfig(num_experts=8, expert_top_k=2, hidden_size=4096,
+                         intermediate_size=14336, sequence_len=4096,
+                         gated_ffn=True, hidden_act=Activation.SILU, ep=8),
+    # 4. DeepSeekMoE-style
+    "deepseek": MoEConfig(num_experts=64, expert_top_k=6, hidden_size=2048,
+                          intermediate_size=1408, sequence_len=4096,
+                          num_shared_experts=2, gated_ffn=True,
+                          hidden_act=Activation.SILU, ep=8),
+}

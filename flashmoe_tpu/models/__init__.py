@@ -1,0 +1,1 @@
+"""Model family: dense-math reference oracle and the MoE transformer."""

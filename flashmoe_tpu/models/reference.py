@@ -1,0 +1,154 @@
+"""Dense-math reference MoE — the numerical oracle.
+
+The reference repo never finished its correctness oracle: ``rExpert``
+(``csrc/correctness/correctness.cuh:19-46``) computes only the gate GEMM +
+softmax + argmax.  This module is the complete oracle the CUDA code lacked:
+an O(S * E) dense evaluation of the full MoE layer (gate -> softmax -> top-k
+-> per-expert FFN -> weighted combine) in plain JAX, used by the test suite
+to validate every optimized path (Pallas kernels, capacity-factor dispatch,
+EP all-to-all) to tolerance.
+
+It intentionally computes *every* expert for *every* token so routing,
+capacity, permutation and communication cannot hide errors.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flashmoe_tpu.config import Activation, MoEConfig
+
+
+def activation_fn(name: str):
+    return {
+        Activation.RELU: jax.nn.relu,
+        Activation.GELU: jax.nn.gelu,
+        Activation.SILU: jax.nn.silu,
+    }[name]
+
+
+def init_moe_params(key, cfg: MoEConfig) -> dict:
+    """Random MoE-layer parameters.
+
+    Layout mirrors the reference worker's tensors (``flashmoe/worker.py:56-58``):
+    ``gate_w [H, E]``, per-expert up/down projections (+ optional gate proj for
+    SwiGLU), all stored stacked on a leading expert axis.
+    """
+    h, i, e = cfg.hidden_size, cfg.intermediate_size, cfg.num_experts
+    ks = jax.random.split(key, 6)
+    p = {
+        "gate_w": jax.random.normal(ks[0], (h, e), cfg.param_dtype) / jnp.sqrt(h),
+        "w_up": jax.random.normal(ks[1], (e, h, i), cfg.param_dtype) / jnp.sqrt(h),
+        "b_up": jnp.zeros((e, i), cfg.param_dtype),
+        "w_down": jax.random.normal(ks[2], (e, i, h), cfg.param_dtype) / jnp.sqrt(i),
+        "b_down": jnp.zeros((e, h), cfg.param_dtype),
+    }
+    if cfg.gated_ffn:
+        p["w_gate"] = (
+            jax.random.normal(ks[3], (e, h, i), cfg.param_dtype) / jnp.sqrt(h)
+        )
+    if cfg.num_shared_experts:
+        si = i * cfg.num_shared_experts
+        p["shared_w_up"] = (
+            jax.random.normal(ks[4], (h, si), cfg.param_dtype) / jnp.sqrt(h)
+        )
+        p["shared_w_down"] = (
+            jax.random.normal(ks[5], (si, h), cfg.param_dtype) / jnp.sqrt(si)
+        )
+        if cfg.gated_ffn:
+            p["shared_w_gate"] = (
+                jax.random.normal(ks[0], (h, si), cfg.param_dtype) / jnp.sqrt(h)
+            )
+    return p
+
+
+def reference_gate(x, gate_w, cfg: MoEConfig):
+    """Gate: logits -> softmax over experts -> top-k.
+
+    Returns (combine_weights [S, E], top_idx [S, K], router_probs [S, E],
+    aux_loss).  ``combine_weights`` is the softmax prob masked to the top-k
+    set and re-normalized to sum to 1 across the chosen experts — matching
+    the reference's combine epilogue which divides by the accumulated
+    combine-weight sum (``csrc/include/flashmoe/os/processor/processor.cuh``
+    combine, and ``TPS`` weight accumulation in ``moe/gate.cuh:678-718``).
+    """
+    logits = jnp.dot(
+        x.astype(cfg.accum_dtype),
+        gate_w.astype(cfg.accum_dtype),
+        preferred_element_type=cfg.accum_dtype,
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_idx = jax.lax.top_k(probs, cfg.expert_top_k)
+    # mask to top-k, renormalize over the selected set
+    denom = jnp.sum(top_p, axis=-1, keepdims=True)
+    norm_top = top_p / jnp.maximum(denom, 1e-20)
+    one_hot = jax.nn.one_hot(top_idx, cfg.num_experts, dtype=probs.dtype)
+    combine_weights = jnp.einsum("sk,ske->se", norm_top, one_hot)
+
+    # Switch-style load-balancing aux loss (gate.cuh:273-299 accumulates
+    # mean-logit and mean-expert-count into gML/gMeC -> gL in training mode).
+    density = jnp.mean(
+        jnp.sum(one_hot, axis=1), axis=0
+    )  # fraction routed per expert
+    mean_probs = jnp.mean(probs, axis=0)
+    aux_loss = cfg.num_experts * jnp.sum(density * mean_probs)
+    return combine_weights, top_idx, probs, aux_loss
+
+
+def expert_ffn(x, p, cfg: MoEConfig, e: int):
+    """Single-expert FFN: up GEMM -> (+bias) -> act -> down GEMM -> (+bias),
+    the same op chain as the fused ``fGET`` pipeline
+    (``csrc/include/flashmoe/os/processor/processor.cuh:339-468``)."""
+    act = activation_fn(cfg.hidden_act)
+    up = jnp.dot(x, p["w_up"][e], preferred_element_type=cfg.accum_dtype)
+    up = up + p["b_up"][e].astype(up.dtype)
+    if cfg.gated_ffn:
+        g = jnp.dot(x, p["w_gate"][e], preferred_element_type=cfg.accum_dtype)
+        hidden = act(g) * up
+    else:
+        hidden = act(up)
+    down = jnp.dot(
+        hidden.astype(cfg.dtype),
+        p["w_down"][e],
+        preferred_element_type=cfg.accum_dtype,
+    )
+    return down + p["b_down"][e].astype(down.dtype)
+
+
+def shared_expert_ffn(x, p, cfg: MoEConfig):
+    act = activation_fn(cfg.hidden_act)
+    up = jnp.dot(x, p["shared_w_up"], preferred_element_type=cfg.accum_dtype)
+    if cfg.gated_ffn:
+        g = jnp.dot(x, p["shared_w_gate"], preferred_element_type=cfg.accum_dtype)
+        hidden = act(g) * up
+    else:
+        hidden = act(up)
+    return jnp.dot(
+        hidden.astype(cfg.dtype),
+        p["shared_w_down"],
+        preferred_element_type=cfg.accum_dtype,
+    )
+
+
+def reference_moe(params, x, cfg: MoEConfig):
+    """Full dense-math MoE layer.
+
+    x: [S, H] tokens.  Returns (output [S, H], aux_loss).  Every expert is
+    evaluated on every token and combined through the dense combine-weight
+    matrix, so there is no routing/capacity approximation to compare against.
+    Note: with drop_tokens capacity limits, optimized paths may drop tokens
+    the oracle keeps; tests account for that explicitly.
+    """
+    combine_weights, _, _, aux = reference_gate(x, params["gate_w"], cfg)
+    xs = x.astype(cfg.dtype)
+    all_out = jnp.stack(
+        [expert_ffn(xs, params, cfg, e) for e in range(cfg.num_experts)], axis=0
+    )  # [E, S, H]
+    out = jnp.einsum(
+        "se,esh->sh", combine_weights.astype(cfg.accum_dtype),
+        all_out.astype(cfg.accum_dtype),
+    )
+    if cfg.num_shared_experts:
+        out = out + shared_expert_ffn(xs, params, cfg).astype(out.dtype)
+    return out.astype(cfg.dtype), aux

@@ -1,0 +1,1 @@
+"""Core MoE ops: gate, dispatch/combine, grouped expert FFN, fused layer."""

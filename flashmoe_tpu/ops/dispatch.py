@@ -1,0 +1,117 @@
+"""Token dispatch (permute-to-experts) and combine (weighted un-permute).
+
+TPU-native re-design of the reference's packet layer
+(``csrc/include/flashmoe/os/packet.cuh:20-286``): there, super-blocks of CUDA
+blocks gather each expert's routed tokens out of the gate's ``tokenIds``
+compaction and copy them into per-peer symmetric-heap cells, and the combine
+stage (``processor.cuh`` ``combine``, ``:27-205``) scatter-adds weighted
+expert outputs back to token order, dividing by the accumulated top-k weight
+sum.
+
+Under XLA we express the same movement as static-shape scatter/gather over a
+capacity-padded ``[E, C, H]`` dispatch buffer (the reference's ``EC``/``pEC``
+expert-capacity concept, ``types.cuh:497-499``):
+
+  * positions within an expert come from a cumulative-sum rank over the
+    (k-major, token-minor) flattening — identical priority order to GShard:
+    all k=0 assignments beat k=1 assignments, ties broken by token index.
+  * tokens whose position exceeds capacity are dropped iff
+    ``cfg.drop_tokens`` (the reference's min(eC, EC) clamp,
+    ``packet.cuh:99-206``); with ``drop_tokens=False`` capacity is S so
+    nothing ever drops.
+  * combine gathers each token's k expert outputs and forms the weighted sum
+    (weights pre-normalized by the router), replacing the reference's
+    nondeterministic atomicAdd combine with a deterministic gather — same
+    math, reproducible accumulation order.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from flashmoe_tpu.config import MoEConfig
+
+
+class DispatchPlan(NamedTuple):
+    """Routing geometry for one token shard.
+
+    expert_idx: [S, K] selected expert per (token, slot).
+    position:   [S, K] slot within the expert's capacity buffer.
+    valid:      [S, K] bool; False when dropped (over capacity).
+    counts:     [E] number of selections per expert (pre-drop).
+    """
+
+    expert_idx: jax.Array
+    position: jax.Array
+    valid: jax.Array
+    counts: jax.Array
+
+
+def make_plan(expert_idx, cfg: MoEConfig, capacity: int) -> DispatchPlan:
+    """Compute per-(token, k) capacity positions.
+
+    expert_idx: [S, K] int32.  Pure integer work, fully parallel on the VPU.
+    """
+    s, k = expert_idx.shape
+    e = cfg.num_experts
+    oh = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)  # [S, K, E]
+    counts = jnp.sum(oh, axis=(0, 1))
+    # k-major priority: flatten to [K*S, E] with k as the slow axis.
+    ohf = oh.transpose(1, 0, 2).reshape(k * s, e)
+    ranks = jnp.cumsum(ohf, axis=0) - ohf  # rank within expert
+    pos = jnp.sum(ranks * ohf, axis=-1).reshape(k, s).T  # [S, K]
+    if cfg.drop_tokens:
+        valid = pos < capacity
+    else:
+        valid = jnp.ones((s, k), bool)
+    return DispatchPlan(expert_idx, pos, valid, counts)
+
+
+def dispatch(x, plan: DispatchPlan, cfg: MoEConfig, capacity: int):
+    """Scatter tokens into the per-expert capacity buffer.
+
+    x: [S, H] -> [E, C, H].  Dropped/empty slots are zero (so the expert
+    GEMM over them contributes nothing after combine masks them out).
+    """
+    s, h = x.shape
+    e = cfg.num_experts
+    flat = jnp.where(
+        plan.valid,
+        plan.expert_idx * capacity + plan.position,
+        e * capacity,  # out of bounds -> dropped by scatter
+    ).reshape(-1)
+    src = jnp.broadcast_to(x[:, None, :], (s, plan.expert_idx.shape[1], h))
+    buf = jnp.zeros((e * capacity, h), x.dtype)
+    buf = buf.at[flat].set(src.reshape(-1, h), mode="drop")
+    return buf.reshape(e, capacity, h)
+
+
+def combine(expert_out, plan: DispatchPlan, combine_weights, cfg: MoEConfig,
+            capacity: int):
+    """Weighted un-permute: [E, C, H] -> [S, H].
+
+    combine_weights: [S, K] normalized router weights.  Deterministic
+    replacement for the reference's atomicAdd combine
+    (``processor.cuh:27-205``).
+    """
+    e, c, h = expert_out.shape
+    s, k = plan.expert_idx.shape
+    flat = jnp.where(
+        plan.valid,
+        plan.expert_idx * capacity + plan.position,
+        0,
+    ).reshape(-1)
+    gathered = expert_out.reshape(e * c, h)[flat].reshape(s, k, h)
+    w = jnp.where(plan.valid, combine_weights, 0.0).astype(jnp.float32)
+    # renormalize over surviving slots so dropped tokens keep unit weight
+    # across their remaining experts (matches reference 1/sum(w) scaling).
+    denom = jnp.sum(w, axis=-1, keepdims=True)
+    w = w / jnp.maximum(denom, 1e-20)
+    out = jnp.einsum(
+        "skh,sk->sh", gathered.astype(jnp.float32), w,
+        preferred_element_type=jnp.float32,
+    )
+    return out

@@ -1,0 +1,211 @@
+"""Grouped expert FFN: up-GEMM -> (+bias) -> activation -> down-GEMM -> (+bias).
+
+TPU-native re-design of the reference's expert pipeline: there, the fused
+kernel's processors run tile-level ``preGEMM``/``postGEMM`` Tasks through the
+``fGET`` fused GEMM+bias+activation (``csrc/include/flashmoe/os/processor/
+processor.cuh:339-468``), with an in-kernel scheduler feeding tiles as packets
+arrive, and a standalone two-GEMM ``expert`` kernel used for throughput probes
+(``csrc/include/flashmoe/moe/expert.cuh:194-372``).
+
+On TPU the scheduler's job — keeping the matrix units fed while tiles stream
+— is done by the Pallas grid pipeline: the grid is (row-tile, intermediate-
+chunk); weights for each chunk are DMA'd HBM->VMEM by the pipeline while the
+previous chunk computes on the MXU, and a float32 VMEM accumulator carries
+the down-projection partial sums across chunks.  Group (=expert) selection is
+data-dependent, handled megablox-style with a scalar-prefetched per-row-tile
+group id that the BlockSpec index maps consume — so each row tile streams
+exactly its own expert's weights, and skewed expert loads never waste MXU
+steps on padding rows of other experts.
+
+Two implementations with identical semantics:
+  * :func:`expert_ffn_dense` — batched einsum over [E, C, H] (XLA path).
+  * :func:`grouped_ffn`      — the Pallas kernel over row-sorted tokens.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from flashmoe_tpu.config import BLOCK_M, MoEConfig
+from flashmoe_tpu.models.reference import activation_fn
+
+
+# ----------------------------------------------------------------------
+# XLA path: batched over the capacity buffer
+# ----------------------------------------------------------------------
+
+def expert_ffn_dense(xs, params, cfg: MoEConfig):
+    """Batched per-expert FFN on the capacity buffer.
+
+    xs: [E, C, H] -> [E, C, H].  XLA maps the batched matmuls straight onto
+    the MXU; activation/bias fuse into the GEMM epilogues automatically.
+    """
+    act = activation_fn(cfg.hidden_act)
+    up = jnp.einsum(
+        "ech,ehi->eci", xs, params["w_up"].astype(xs.dtype),
+        preferred_element_type=cfg.accum_dtype,
+    ) + params["b_up"][:, None, :].astype(cfg.accum_dtype)
+    if cfg.gated_ffn:
+        g = jnp.einsum(
+            "ech,ehi->eci", xs, params["w_gate"].astype(xs.dtype),
+            preferred_element_type=cfg.accum_dtype,
+        )
+        hidden = act(g) * up
+    else:
+        hidden = act(up)
+    down = jnp.einsum(
+        "eci,eih->ech", hidden.astype(xs.dtype),
+        params["w_down"].astype(xs.dtype),
+        preferred_element_type=cfg.accum_dtype,
+    ) + params["b_down"][:, None, :].astype(cfg.accum_dtype)
+    return down.astype(xs.dtype)
+
+
+# ----------------------------------------------------------------------
+# Pallas grouped kernel
+# ----------------------------------------------------------------------
+
+def _ffn_kernel(gid_ref, x_ref, wup_ref, bup_ref, wdn_ref, bdn_ref, out_ref,
+                acc_ref, *, act_name, gated):
+    """One (row-tile, I-chunk) grid step.
+
+    When ``gated`` the up-weight block holds [w_gate; w_up] stacked on a
+    doubled chunk axis (see :func:`grouped_ffn`).
+    """
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    act = activation_fn(act_name)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[:]
+    if gated:
+        half = wup_ref.shape[2] // 2
+        g = jnp.dot(x, wup_ref[0, :, :half], preferred_element_type=jnp.float32)
+        up = jnp.dot(x, wup_ref[0, :, half:], preferred_element_type=jnp.float32)
+        up = up + bup_ref[0, :].astype(jnp.float32)
+        hidden = act(g) * up
+    else:
+        up = jnp.dot(x, wup_ref[0], preferred_element_type=jnp.float32)
+        hidden = act(up + bup_ref[0, :].astype(jnp.float32))
+    acc_ref[:] += jnp.dot(
+        hidden.astype(x.dtype), wdn_ref[0], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(j == nj - 1)
+    def _():
+        out_ref[:] = (
+            acc_ref[:] + bdn_ref[0, :].astype(jnp.float32)
+        ).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("act_name", "gated", "block_m", "block_i",
+                              "interpret"),
+)
+def grouped_ffn(x, tile_gid, w_up, b_up, w_down, b_down, w_gate=None, *,
+                act_name: str, gated: bool = False, block_m: int = BLOCK_M,
+                block_i: int = 512, interpret: bool = False):
+    """Grouped FFN over row-sorted tokens.
+
+    x:        [T, H] tokens, grouped so rows of one row-tile share an expert.
+    tile_gid: [T // block_m] int32 expert id owning each row tile.
+    w_up:     [E, H, I]; b_up: [E, I]; w_down: [E, I, H]; b_down: [E, H];
+    w_gate:   [E, H, I] for SwiGLU-style experts.
+
+    Returns [T, H].  The scalar-prefetched ``tile_gid`` drives the weight
+    BlockSpec index maps, so each row tile DMAs only its own expert's weight
+    chunks (megablox-style block-sparse grouped GEMM).
+    """
+    t, h = x.shape
+    e, _, i = w_up.shape
+    if t % block_m:
+        raise ValueError(f"rows {t} must be a multiple of block_m={block_m}")
+    bi = min(block_i, i)
+    if i % bi:
+        raise ValueError(f"intermediate {i} must be a multiple of {bi}")
+    nt, nj = t // block_m, i // bi
+
+    if gated:
+        if w_gate is None:
+            raise ValueError("gated_ffn requires w_gate")
+        # interleave per-chunk: [E, H, 2*I] as chunk-major [gate_chunk|up_chunk]
+        wg = w_gate.reshape(e, h, nj, bi)
+        wu = w_up.reshape(e, h, nj, bi)
+        w_up_eff = jnp.concatenate([wg, wu], axis=-1).reshape(e, h, nj * 2 * bi)
+        up_block = (1, h, 2 * bi)
+        up_map = lambda ti, j, gid: (gid[ti], 0, j)
+    else:
+        w_up_eff = w_up
+        up_block = (1, h, bi)
+        up_map = lambda ti, j, gid: (gid[ti], 0, j)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt, nj),
+        in_specs=[
+            pl.BlockSpec((block_m, h), lambda ti, j, gid: (ti, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(up_block, up_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bi), lambda ti, j, gid: (gid[ti], j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bi, h), lambda ti, j, gid: (gid[ti], j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h), lambda ti, j, gid: (gid[ti], 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_m, h), lambda ti, j, gid: (ti, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((block_m, h), jnp.float32)],
+    )
+    flops = 2 * t * h * i * (3 if gated else 2)
+    return pl.pallas_call(
+        functools.partial(_ffn_kernel, act_name=act_name, gated=gated),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, h), x.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=flops,
+            bytes_accessed=x.size * x.dtype.itemsize
+            + w_up_eff.size * w_up_eff.dtype.itemsize
+            + w_down.size * w_down.dtype.itemsize,
+            transcendentals=t * i,
+        ),
+        interpret=interpret,
+    )(tile_gid, x, w_up_eff, b_up, w_down, b_down)
+
+
+def capacity_buffer_ffn_pallas(xs, params, cfg: MoEConfig, *,
+                               interpret: bool = False):
+    """Run the grouped kernel on an [E, C, H] capacity buffer.
+
+    The capacity buffer is already expert-major, so tile group ids are just
+    ``expert_of_tile = tile_index // (C / block_m)`` — no sort needed.  C is
+    padded up to a block multiple; pad rows compute garbage that combine
+    never reads.
+    """
+    e, c, h = xs.shape
+    bm = BLOCK_M if c >= BLOCK_M else max(8, 1 << (c - 1).bit_length())
+    bm = min(bm, BLOCK_M)
+    cp = ((c + bm - 1) // bm) * bm
+    if cp != c:
+        xs = jnp.pad(xs, ((0, 0), (0, cp - c), (0, 0)))
+    x = xs.reshape(e * cp, h)
+    tiles_per_e = cp // bm
+    tile_gid = (
+        jnp.arange(e * tiles_per_e, dtype=jnp.int32) // tiles_per_e
+    )
+    out = grouped_ffn(
+        x, tile_gid, params["w_up"].astype(x.dtype),
+        params["b_up"], params["w_down"].astype(x.dtype), params["b_down"],
+        params.get("w_gate", None) if cfg.gated_ffn else None,
+        act_name=cfg.hidden_act, gated=cfg.gated_ffn, block_m=bm,
+        interpret=interpret,
+    )
+    return out.reshape(e, cp, h)[:, :c, :]

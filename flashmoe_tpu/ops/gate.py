@@ -1,0 +1,197 @@
+"""Fused MoE gate (router): GEMM + softmax + top-k + expert counts.
+
+TPU-native re-design of the reference's ``FusedGate``
+(``csrc/include/flashmoe/moe/gate.cuh:93-720``), which fuses the gate GEMM
+with an in-register online softmax, online top-k, and a CUB BlockScan token
+compaction, using a block-ring over SMs when E exceeds one CUDA tile
+(``gate.cuh:229-269, 321-390``).
+
+On TPU none of that choreography is needed: one Pallas grid step owns a full
+``[BLOCK_M, E_padded]`` logits tile in VMEM, so softmax and top-k are simple
+vector ops after an MXU matmul — the "multi-block ring" collapses to a wider
+lane dimension.  The kernel additionally accumulates the two statistics the
+reference gathers for its aux loss (``gate.cuh:273-299``): per-expert
+softmax-probability sums and per-expert top-k selection counts.
+
+Two implementations with identical semantics:
+  * :func:`router_xla` — plain jnp/lax, used as fallback and oracle.
+  * :func:`router_pallas` — fused Pallas kernel (matmul + softmax + top-k +
+    stats in one VMEM-resident pass).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from flashmoe_tpu.config import BLOCK_M, LANE, MoEConfig
+
+
+class RouterOutput(NamedTuple):
+    """Routing decisions for one token shard.
+
+    combine_weights: [S, K] normalized weights of the selected experts.
+    expert_idx:      [S, K] int32 selected expert ids.
+    expert_counts:   [E]    int32 number of (token, k) selections per expert.
+    probs_mean:      [E]    mean softmax probability per expert (aux loss).
+    aux_loss:        []     load-balancing loss (Switch-style).
+    z_loss:          []     router z-loss (0 unless enabled).
+    """
+
+    combine_weights: jax.Array
+    expert_idx: jax.Array
+    expert_counts: jax.Array
+    probs_mean: jax.Array
+    aux_loss: jax.Array
+    z_loss: jax.Array
+
+
+def _finish(cfg: MoEConfig, top_p, top_idx, probs_sum, counts, zsum, s_tokens):
+    """Shared epilogue: normalize top-k weights, form aux/z losses."""
+    denom = jnp.sum(top_p, axis=-1, keepdims=True)
+    combine_weights = (top_p / jnp.maximum(denom, 1e-20)).astype(cfg.accum_dtype)
+    probs_mean = probs_sum / s_tokens
+    density = counts.astype(cfg.accum_dtype) / (s_tokens * cfg.expert_top_k)
+    # Switch-transformer load-balance loss: E * sum(density * mean_prob).
+    aux = cfg.num_experts * jnp.sum(density * probs_mean) * cfg.expert_top_k
+    z = (zsum / s_tokens) * cfg.router_z_loss_coef
+    return RouterOutput(
+        combine_weights=combine_weights,
+        expert_idx=top_idx.astype(jnp.int32),
+        expert_counts=counts.astype(jnp.int32),
+        probs_mean=probs_mean,
+        aux_loss=aux.astype(cfg.accum_dtype),
+        z_loss=z.astype(cfg.accum_dtype),
+    )
+
+
+# ----------------------------------------------------------------------
+# XLA reference path
+# ----------------------------------------------------------------------
+
+def router_xla(x, gate_w, cfg: MoEConfig) -> RouterOutput:
+    """Router in plain XLA ops. x: [S, H], gate_w: [H, E]."""
+    s = x.shape[0]
+    logits = jnp.dot(
+        x.astype(cfg.accum_dtype),
+        gate_w.astype(cfg.accum_dtype),
+        preferred_element_type=cfg.accum_dtype,
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_idx = jax.lax.top_k(probs, cfg.expert_top_k)
+    counts = jnp.sum(
+        jax.nn.one_hot(top_idx, cfg.num_experts, dtype=jnp.int32), axis=(0, 1)
+    )
+    zsum = jnp.sum(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+    return _finish(cfg, top_p, top_idx, jnp.sum(probs, axis=0), counts, zsum, s)
+
+
+# ----------------------------------------------------------------------
+# Pallas fused kernel
+# ----------------------------------------------------------------------
+
+def _gate_kernel(x_ref, w_ref, top_p_ref, top_i_ref, stats_ref, *, k, e, px):
+    """One grid step: route BLOCK_M tokens.
+
+    stats_ref accumulates [3, PX]: row 0 = sum of softmax probs, row 1 =
+    top-k selection counts, row 2 = z-loss partial (lane 0 only).
+    """
+    logits = jnp.dot(
+        x_ref[:].astype(jnp.float32),
+        w_ref[:].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )  # [BM, PX]
+    bm = logits.shape[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, (bm, px), 1)
+    neg = jnp.float32(-1e30)
+    logits = jnp.where(col < e, logits, neg)
+
+    # numerically-stable softmax over the (padded) expert axis
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    ex = jnp.where(col < e, jnp.exp(logits - m), 0.0)
+    se = jnp.sum(ex, axis=-1, keepdims=True)
+    probs = ex / se
+
+    # z-loss partial: logsumexp = m + log(se)
+    lse = m[:, 0] + jnp.log(se[:, 0])
+    zpart = jnp.sum(jnp.square(lse))
+
+    # iterative top-k (K is small and static -> unrolled)
+    p = probs
+    sel_count = jnp.zeros((bm, px), jnp.float32)
+    top_ps, top_is = [], []
+    for _ in range(k):
+        mx = jnp.max(p, axis=-1, keepdims=True)
+        is_max = (p == mx) & (col < e)
+        idx = jnp.min(jnp.where(is_max, col, px), axis=-1, keepdims=True)
+        hit = col == idx
+        top_ps.append(mx)
+        top_is.append(idx)
+        sel_count = sel_count + hit.astype(jnp.float32)
+        p = jnp.where(hit, neg, p)
+    top_p_ref[:] = jnp.concatenate(top_ps, axis=1)
+    top_i_ref[:] = jnp.concatenate(top_is, axis=1)
+
+    first = pl.program_id(0) == 0
+
+    @pl.when(first)
+    def _():
+        stats_ref[:] = jnp.zeros_like(stats_ref)
+
+    row = jax.lax.broadcasted_iota(jnp.int32, (8, px), 0)
+    lane0 = jax.lax.broadcasted_iota(jnp.int32, (8, px), 1) == 0
+    update = (
+        jnp.where(row == 0, jnp.sum(probs, axis=0)[None, :], 0.0)
+        + jnp.where(row == 1, jnp.sum(sel_count, axis=0)[None, :], 0.0)
+        + jnp.where((row == 2) & lane0, zpart, 0.0)
+    )
+    stats_ref[:] = stats_ref[:] + update
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def router_pallas(x, gate_w, cfg: MoEConfig) -> RouterOutput:
+    """Fused gate on TPU. x: [S, H], gate_w: [H, E]. S must divide by 8."""
+    s, h = x.shape
+    e, k = cfg.num_experts, cfg.expert_top_k
+    px = max(LANE, ((e + LANE - 1) // LANE) * LANE)
+    bm = min(BLOCK_M, s)
+    if s % bm:
+        raise ValueError(f"token count {s} must be a multiple of {bm}")
+    w_pad = jnp.zeros((h, px), gate_w.dtype).at[:, :e].set(gate_w)
+
+    grid = (s // bm,)
+    top_p, top_i, stats = pl.pallas_call(
+        functools.partial(_gate_kernel, k=k, e=e, px=px),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((h, px), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, k), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, px), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s, k), jnp.float32),
+            jax.ShapeDtypeStruct((s, k), jnp.int32),
+            jax.ShapeDtypeStruct((8, px), jnp.float32),
+        ],
+    )(x, w_pad)
+
+    probs_sum = stats[0, :e]
+    counts = stats[1, :e].astype(jnp.int32)
+    zsum = stats[2, 0]
+    return _finish(cfg, top_p, top_i, probs_sum, counts, zsum, s)
+
+
+def router(x, gate_w, cfg: MoEConfig, use_pallas: bool = True) -> RouterOutput:
+    """Dispatch to the fused kernel on TPU, XLA fallback elsewhere."""
+    if use_pallas and x.shape[0] % 8 == 0 and jax.default_backend() == "tpu":
+        return router_pallas(x, gate_w, cfg)
+    return router_xla(x, gate_w, cfg)

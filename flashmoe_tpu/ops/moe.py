@@ -1,0 +1,88 @@
+"""Single-device MoE layer: gate -> dispatch -> grouped FFN -> combine.
+
+This is the TPU equivalent of one launch of the reference's fused kernel
+``moe::forward`` (``csrc/include/flashmoe/moe/moe.cuh:71-144``) in the
+single-PE case: the same four stages, expressed as a jit-compiled dataflow
+that XLA fuses and schedules (the in-kernel OS/scheduler/subscriber machinery
+of ``csrc/include/flashmoe/os/`` exists to do dynamic tile scheduling that
+the XLA/Pallas pipeline provides natively).
+
+The E==1 degenerate case routes to :func:`dense_ffn`, mirroring the
+reference's ``fffn`` kernel fallback (``moe/fffn.cuh:24-167``,
+``moe.cuh:174-177``).
+
+The expert-parallel multi-device layer lives in
+:mod:`flashmoe_tpu.parallel.ep` and reuses these stages around the
+all-to-all.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.models.reference import activation_fn, shared_expert_ffn
+from flashmoe_tpu.ops import dispatch as dsp
+from flashmoe_tpu.ops import expert as exp
+from flashmoe_tpu.ops.gate import router
+
+
+class MoEOutput(NamedTuple):
+    out: jnp.ndarray  # [S, H]
+    aux_loss: jnp.ndarray
+    z_loss: jnp.ndarray
+    expert_counts: jnp.ndarray  # [E]
+
+
+def dense_ffn(params, x, cfg: MoEConfig):
+    """E==1 dense fallback (the reference's ``fffn`` path)."""
+    act = activation_fn(cfg.hidden_act)
+    up = jnp.dot(x, params["w_up"][0].astype(x.dtype),
+                 preferred_element_type=cfg.accum_dtype)
+    up = up + params["b_up"][0].astype(cfg.accum_dtype)
+    if cfg.gated_ffn:
+        g = jnp.dot(x, params["w_gate"][0].astype(x.dtype),
+                    preferred_element_type=cfg.accum_dtype)
+        hidden = act(g) * up
+    else:
+        hidden = act(up)
+    down = jnp.dot(hidden.astype(x.dtype), params["w_down"][0].astype(x.dtype),
+                   preferred_element_type=cfg.accum_dtype)
+    down = down + params["b_down"][0].astype(cfg.accum_dtype)
+    return down.astype(x.dtype)
+
+
+def moe_layer(params, x, cfg: MoEConfig, *, use_pallas: bool = True,
+              capacity: int | None = None) -> MoEOutput:
+    """One MoE layer over a token shard x: [S, H].
+
+    ``use_pallas`` selects the fused Pallas gate + grouped-FFN kernels;
+    the XLA path is used otherwise (and is the oracle in tests).
+    """
+    s, h = x.shape
+    zero = jnp.zeros((), cfg.accum_dtype)
+    if cfg.num_experts == 1:
+        out = dense_ffn(params, x, cfg)
+        return MoEOutput(out, zero, zero, jnp.full((1,), s, jnp.int32))
+
+    cap = capacity if capacity is not None else cfg.expert_capacity
+    r = router(x, params["gate_w"], cfg, use_pallas=use_pallas)
+    plan = dsp.make_plan(r.expert_idx, cfg, cap)
+    xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)  # [E, C, H]
+    if use_pallas:
+        ybuf = exp.capacity_buffer_ffn_pallas(xbuf, params, cfg)
+    else:
+        ybuf = exp.expert_ffn_dense(xbuf, params, cfg)
+    out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)  # [S, H] f32
+    if cfg.num_shared_experts:
+        out = out + shared_expert_ffn(x.astype(cfg.dtype), params, cfg).astype(
+            out.dtype
+        )
+    return MoEOutput(
+        out.astype(cfg.dtype),
+        r.aux_loss * cfg.aux_loss_coef,
+        r.z_loss,
+        r.expert_counts,
+    )

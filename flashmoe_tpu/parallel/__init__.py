@@ -1,0 +1,1 @@
+"""Parallelism: meshes, expert-parallel layers, placement, collectives."""

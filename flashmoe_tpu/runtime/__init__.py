@@ -1,0 +1,1 @@
+"""Host runtime: distributed bootstrap, launcher, worker entrypoints."""

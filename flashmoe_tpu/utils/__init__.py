@@ -1,0 +1,1 @@
+"""Utilities: telemetry, debug helpers, math."""

@@ -1,0 +1,29 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (the "fake backend" the reference
+never built — SURVEY.md §4): ``xla_force_host_platform_device_count=8``
+gives real multi-device semantics (shard_map, collectives, all_to_all)
+without TPU hardware.  Pallas kernels run in interpreter mode on CPU.
+"""
+
+import os
+
+# Must be set before jax initializes.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    d = jax.devices()
+    assert len(d) >= 8, f"expected >=8 virtual devices, got {len(d)}"
+    return d

@@ -1,0 +1,62 @@
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from flashmoe_tpu.config import BENCH_CONFIGS, Activation, MoEConfig
+
+
+def test_defaults_derive():
+    cfg = MoEConfig()
+    assert cfg.tokens == 128
+    assert cfg.num_local_experts == 8
+    assert cfg.padded_num_experts == 128
+    # EC = ceil(1.25 * 2 * ceil(128/8)) = 40
+    assert cfg.expert_capacity == 40
+    assert cfg.padded_expert_capacity % 8 == 0
+
+
+def test_no_drop_capacity_is_all_tokens():
+    cfg = MoEConfig(drop_tokens=False, sequence_len=256)
+    assert cfg.expert_capacity == 256
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        MoEConfig(hidden_size=100)
+    with pytest.raises(ValueError):
+        MoEConfig(expert_top_k=9, num_experts=8)
+    with pytest.raises(ValueError):
+        MoEConfig(num_experts=6, ep=4)
+
+
+def test_from_reference_json():
+    # mirror of csrc/flashmoe_config.json
+    raw = {
+        "capacity_factor": 1, "drop_tokens": 1, "expert_top_k": 2,
+        "global_batch": 1, "is_training": 0, "hidden_act": 0,
+        "hidden_size": 2048, "intermediate_size": 2048, "mini_batch": 1,
+        "moe_frequency": 2, "num_experts": 64, "num_layers": 2,
+        "sequence_len": 8192, "torch_dtype": 1, "vocab_size": 50257,
+    }
+    cfg = MoEConfig.from_json(raw)
+    assert cfg.num_experts == 64
+    assert cfg.hidden_act == Activation.RELU
+    assert cfg.dtype == jnp.bfloat16
+    assert cfg.tokens == 8192
+    # EC = 1 * 2 * ceil(8192/64) = 256
+    assert cfg.expert_capacity == 256
+    json.loads(cfg.to_json())
+
+
+def test_moe_layer_indices():
+    cfg = MoEConfig(num_layers=4, moe_frequency=2)
+    assert cfg.moe_layer_indices == (1, 3)
+    dense = MoEConfig(num_experts=1, expert_top_k=1)
+    assert dense.moe_layer_indices == ()
+
+
+def test_bench_configs_valid():
+    for name, cfg in BENCH_CONFIGS.items():
+        assert cfg.tokens > 0, name
+        assert cfg.expert_capacity > 0, name
