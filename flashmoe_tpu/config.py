@@ -145,25 +145,28 @@ class MoEConfig:
         """PX: experts padded to the lane width (types.cuh ``PX``)."""
         return _round_up(self.num_experts, LANE)
 
-    @property
-    def expert_capacity(self) -> int:
-        """EC (types.cuh:497-499): CF * TK * ceil(S/E) when dropping, else S.
-
-        Note this is the capacity *per expert per device-shard of tokens*;
-        the EP layer applies it to the local token shard.
-        """
+    def capacity_for(self, tokens: int) -> int:
+        """EC (types.cuh:497-499): CF * TK * ceil(tokens/E) when dropping,
+        else all tokens.  The floor of 8 keeps the capacity buffer aligned to
+        the TPU sublane count.  Used for both the global token count and the
+        EP layer's per-shard capacity."""
         if not self.drop_tokens:
-            return self.tokens
+            return tokens
         return max(
-            1,
+            8,
             int(
                 math.ceil(
                     self.capacity_factor
                     * self.expert_top_k
-                    * math.ceil(self.tokens / self.num_experts)
+                    * math.ceil(tokens / self.num_experts)
                 )
             ),
         )
+
+    @property
+    def expert_capacity(self) -> int:
+        """EC over the full (unsharded) token count."""
+        return self.capacity_for(self.tokens)
 
     @property
     def padded_expert_capacity(self) -> int:

@@ -36,7 +36,7 @@ def init_moe_params(key, cfg: MoEConfig) -> dict:
     SwiGLU), all stored stacked on a leading expert axis.
     """
     h, i, e = cfg.hidden_size, cfg.intermediate_size, cfg.num_experts
-    ks = jax.random.split(key, 6)
+    ks = jax.random.split(key, 7)
     p = {
         "gate_w": jax.random.normal(ks[0], (h, e), cfg.param_dtype) / jnp.sqrt(h),
         "w_up": jax.random.normal(ks[1], (e, h, i), cfg.param_dtype) / jnp.sqrt(h),
@@ -58,7 +58,7 @@ def init_moe_params(key, cfg: MoEConfig) -> dict:
         )
         if cfg.gated_ffn:
             p["shared_w_gate"] = (
-                jax.random.normal(ks[0], (h, si), cfg.param_dtype) / jnp.sqrt(h)
+                jax.random.normal(ks[6], (h, si), cfg.param_dtype) / jnp.sqrt(h)
             )
     return p
 

@@ -90,11 +90,11 @@ def _ffn_kernel(gid_ref, x_ref, wup_ref, bup_ref, wdn_ref, bdn_ref, out_ref,
         half = wup_ref.shape[2] // 2
         g = jnp.dot(x, wup_ref[0, :, :half], preferred_element_type=jnp.float32)
         up = jnp.dot(x, wup_ref[0, :, half:], preferred_element_type=jnp.float32)
-        up = up + bup_ref[0, :].astype(jnp.float32)
+        up = up + bup_ref[0, 0, :].astype(jnp.float32)
         hidden = act(g) * up
     else:
         up = jnp.dot(x, wup_ref[0], preferred_element_type=jnp.float32)
-        hidden = act(up + bup_ref[0, :].astype(jnp.float32))
+        hidden = act(up + bup_ref[0, 0, :].astype(jnp.float32))
     acc_ref[:] += jnp.dot(
         hidden.astype(x.dtype), wdn_ref[0], preferred_element_type=jnp.float32
     )
@@ -102,7 +102,7 @@ def _ffn_kernel(gid_ref, x_ref, wup_ref, bup_ref, wdn_ref, bdn_ref, out_ref,
     @pl.when(j == nj - 1)
     def _():
         out_ref[:] = (
-            acc_ref[:] + bdn_ref[0, :].astype(jnp.float32)
+            acc_ref[:] + bdn_ref[0, 0, :].astype(jnp.float32)
         ).astype(out_ref.dtype)
 
 
@@ -147,6 +147,11 @@ def grouped_ffn(x, tile_gid, w_up, b_up, w_down, b_down, w_gate=None, *,
         up_block = (1, h, bi)
         up_map = lambda ti, j, gid: (gid[ti], 0, j)
 
+    # biases are lifted to [E, 1, dim] so their (1, dim) trailing block shape
+    # satisfies the TPU (8, 128) tiling rule via the equal-dimension escape
+    b_up3 = b_up.reshape(e, 1, i)
+    b_down3 = b_down.reshape(e, 1, h)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nt, nj),
@@ -154,11 +159,11 @@ def grouped_ffn(x, tile_gid, w_up, b_up, w_down, b_down, w_gate=None, *,
             pl.BlockSpec((block_m, h), lambda ti, j, gid: (ti, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(up_block, up_map, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bi), lambda ti, j, gid: (gid[ti], j),
+            pl.BlockSpec((1, 1, bi), lambda ti, j, gid: (gid[ti], 0, j),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bi, h), lambda ti, j, gid: (gid[ti], j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h), lambda ti, j, gid: (gid[ti], 0),
+            pl.BlockSpec((1, 1, h), lambda ti, j, gid: (gid[ti], 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((block_m, h), lambda ti, j, gid: (ti, 0),
@@ -178,7 +183,7 @@ def grouped_ffn(x, tile_gid, w_up, b_up, w_down, b_down, w_gate=None, *,
             transcendentals=t * i,
         ),
         interpret=interpret,
-    )(tile_gid, x, w_up_eff, b_up, w_down, b_down)
+    )(tile_gid, x, w_up_eff, b_up3, w_down, b_down3)
 
 
 def capacity_buffer_ffn_pallas(xs, params, cfg: MoEConfig, *,

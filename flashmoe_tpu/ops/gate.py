@@ -117,8 +117,8 @@ def _gate_kernel(x_ref, w_ref, top_p_ref, top_i_ref, stats_ref, *, k, e, px):
     se = jnp.sum(ex, axis=-1, keepdims=True)
     probs = ex / se
 
-    # z-loss partial: logsumexp = m + log(se)
-    lse = m[:, 0] + jnp.log(se[:, 0])
+    # z-loss partial: logsumexp = m + log(se)  (kept 2D for TPU layouts)
+    lse = m + jnp.log(se)
     zpart = jnp.sum(jnp.square(lse))
 
     # iterative top-k (K is small and static -> unrolled)
@@ -153,15 +153,18 @@ def _gate_kernel(x_ref, w_ref, top_p_ref, top_i_ref, stats_ref, *, k, e, px):
     stats_ref[:] = stats_ref[:] + update
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def router_pallas(x, gate_w, cfg: MoEConfig) -> RouterOutput:
+@functools.partial(jax.jit, static_argnames=("cfg", "interpret"))
+def router_pallas(x, gate_w, cfg: MoEConfig, interpret: bool = False
+                  ) -> RouterOutput:
     """Fused gate on TPU. x: [S, H], gate_w: [H, E]. S must divide by 8."""
     s, h = x.shape
     e, k = cfg.num_experts, cfg.expert_top_k
     px = max(LANE, ((e + LANE - 1) // LANE) * LANE)
-    bm = min(BLOCK_M, s)
-    if s % bm:
-        raise ValueError(f"token count {s} must be a multiple of {bm}")
+    if s % 8:
+        raise ValueError(f"token count {s} must be a multiple of 8")
+    # largest power-of-two row tile (<= BLOCK_M) dividing S, so any S % 8 == 0
+    # token count works without padding
+    bm = next(b for b in (128, 64, 32, 16, 8) if s % b == 0)
     w_pad = jnp.zeros((h, px), gate_w.dtype).at[:, :e].set(gate_w)
 
     grid = (s // bm,)
@@ -182,6 +185,7 @@ def router_pallas(x, gate_w, cfg: MoEConfig) -> RouterOutput:
             jax.ShapeDtypeStruct((s, k), jnp.int32),
             jax.ShapeDtypeStruct((8, px), jnp.float32),
         ],
+        interpret=interpret,
     )(x, w_pad)
 
     probs_sum = stats[0, :e]
@@ -190,8 +194,10 @@ def router_pallas(x, gate_w, cfg: MoEConfig) -> RouterOutput:
     return _finish(cfg, top_p, top_i, probs_sum, counts, zsum, s)
 
 
-def router(x, gate_w, cfg: MoEConfig, use_pallas: bool = True) -> RouterOutput:
+def router(x, gate_w, cfg: MoEConfig, use_pallas: bool = True,
+           interpret: bool = False) -> RouterOutput:
     """Dispatch to the fused kernel on TPU, XLA fallback elsewhere."""
-    if use_pallas and x.shape[0] % 8 == 0 and jax.default_backend() == "tpu":
-        return router_pallas(x, gate_w, cfg)
+    on_tpu = interpret or jax.default_backend() == "tpu"
+    if use_pallas and x.shape[0] % 8 == 0 and on_tpu:
+        return router_pallas(x, gate_w, cfg, interpret=interpret)
     return router_xla(x, gate_w, cfg)

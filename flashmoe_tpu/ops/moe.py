@@ -54,13 +54,19 @@ def dense_ffn(params, x, cfg: MoEConfig):
     return down.astype(x.dtype)
 
 
-def moe_layer(params, x, cfg: MoEConfig, *, use_pallas: bool = True,
-              capacity: int | None = None) -> MoEOutput:
+def moe_layer(params, x, cfg: MoEConfig, *, use_pallas: bool | None = None,
+              capacity: int | None = None,
+              interpret: bool = False) -> MoEOutput:
     """One MoE layer over a token shard x: [S, H].
 
     ``use_pallas`` selects the fused Pallas gate + grouped-FFN kernels;
-    the XLA path is used otherwise (and is the oracle in tests).
+    ``None`` (default) auto-selects: Pallas on TPU (or when ``interpret``),
+    XLA elsewhere.  The XLA path is the oracle in tests.
     """
+    import jax
+
+    if use_pallas is None:
+        use_pallas = interpret or jax.default_backend() == "tpu"
     s, h = x.shape
     zero = jnp.zeros((), cfg.accum_dtype)
     if cfg.num_experts == 1:
@@ -68,11 +74,13 @@ def moe_layer(params, x, cfg: MoEConfig, *, use_pallas: bool = True,
         return MoEOutput(out, zero, zero, jnp.full((1,), s, jnp.int32))
 
     cap = capacity if capacity is not None else cfg.expert_capacity
-    r = router(x, params["gate_w"], cfg, use_pallas=use_pallas)
+    r = router(x, params["gate_w"], cfg, use_pallas=use_pallas,
+               interpret=interpret)
     plan = dsp.make_plan(r.expert_idx, cfg, cap)
     xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)  # [E, C, H]
     if use_pallas:
-        ybuf = exp.capacity_buffer_ffn_pallas(xbuf, params, cfg)
+        ybuf = exp.capacity_buffer_ffn_pallas(xbuf, params, cfg,
+                                              interpret=interpret)
     else:
         ybuf = exp.expert_ffn_dense(xbuf, params, cfg)
     out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)  # [S, H] f32

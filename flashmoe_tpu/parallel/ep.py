@@ -1,0 +1,122 @@
+"""Expert-parallel MoE layer: shard_map + all-to-all over the TPU mesh.
+
+TPU-native re-design of the reference's distributed core: there, the gate's
+``tokenIds`` compaction feeds ``packet::dispatch`` which writes each expert's
+tokens straight into peer GPUs' symmetric-heap cells with NVSHMEM
+put-with-signal (``csrc/include/flashmoe/os/packet.cuh:20-286``), expert FFNs
+run as scheduled tiles, and results return by the same transport before a
+scatter-add combine (``os/processor/processor.cuh:711-767``).
+
+Here the same movement is an SPMD program over the ``ep`` mesh axis:
+
+  1. every rank routes its local token shard (full-E routing decisions),
+  2. scatters tokens into a capacity-padded ``[E, C_loc, H]`` buffer,
+  3. ``jax.lax.all_to_all`` over ``ep`` exchanges expert-major slabs —
+     XLA lowers this to ICI-optimal transfers (the analogue of the
+     NVSHMEM heap cells being sliced per (peer, expert-slot, capacity),
+     ``types.cuh:1014-1032``),
+  4. local experts run the grouped FFN on ``[nLx, D*C_loc, H]``,
+  5. the reverse all-to-all returns results and each rank combines its own
+     tokens with deterministic weighted gathers.
+
+Compute/communication overlap — the reference's headline trick — is XLA's
+latency-hiding scheduler's job at this level (it overlaps the all-to-all
+with surrounding compute); the fused Pallas path in
+:mod:`flashmoe_tpu.parallel.fused` goes further with device-initiated
+remote DMA inside the kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.models.reference import shared_expert_ffn
+from flashmoe_tpu.ops import dispatch as dsp
+from flashmoe_tpu.ops import expert as exp
+from flashmoe_tpu.ops.gate import router
+from flashmoe_tpu.ops.moe import MoEOutput, dense_ffn
+
+
+def local_capacity(cfg: MoEConfig, s_local: int) -> int:
+    """Per-(rank, expert) capacity over a local token shard (EC formula of
+    ``types.cuh:497-499`` applied shard-locally)."""
+    return cfg.capacity_for(s_local)
+
+
+def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool):
+    """Per-rank body (runs inside shard_map over the ep axis).
+
+    x: [S_loc, H] local tokens; params: expert weights sharded on axis 0
+    (leading dim nLx), gate replicated.
+    """
+    d = jax.lax.axis_size(axis)
+    s_loc, h = x.shape
+    e, nlx = cfg.num_experts, cfg.num_experts // d
+    cap = local_capacity(cfg, s_loc)
+
+    r = router(x, params["gate_w"], cfg, use_pallas=use_pallas)
+    plan = dsp.make_plan(r.expert_idx, cfg, cap)
+    xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)  # [E, C, H]
+
+    # exchange expert-major slabs: [E, C, H] -> [D, nLx, C, H] received
+    recv = jax.lax.all_to_all(
+        xbuf.reshape(d, nlx, cap, h), axis, split_axis=0, concat_axis=0,
+        tiled=False,
+    )  # [D, nLx, C, H] — dim 0 now indexes source rank
+    ybuf_in = recv.transpose(1, 0, 2, 3).reshape(nlx, d * cap, h)
+
+    if use_pallas:
+        yloc = exp.capacity_buffer_ffn_pallas(ybuf_in, params, cfg)
+    else:
+        yloc = exp.expert_ffn_dense(ybuf_in, params, cfg)
+
+    # reverse: [nLx, D*C, H] -> [D, nLx, C, H] -> all_to_all -> [E, C, H]
+    ysend = yloc.reshape(nlx, d, cap, h).transpose(1, 0, 2, 3)
+    yback = jax.lax.all_to_all(
+        ysend, axis, split_axis=0, concat_axis=0, tiled=False
+    )  # [D, nLx, C, H] — dim 0 indexes expert-owner rank
+    ybuf = yback.reshape(e, cap, h)
+
+    out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)
+    if cfg.num_shared_experts:
+        out = out + shared_expert_ffn(
+            x.astype(cfg.dtype), params, cfg
+        ).astype(out.dtype)
+
+    aux = jax.lax.pmean(r.aux_loss, axis) * cfg.aux_loss_coef
+    z = jax.lax.pmean(r.z_loss, axis)
+    counts = jax.lax.psum(r.expert_counts, axis)
+    return MoEOutput(out.astype(cfg.dtype), aux, z, counts)
+
+
+def ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
+                 use_pallas: bool = False) -> MoEOutput:
+    """Expert-parallel MoE layer over a global token batch.
+
+    x: [S, H] global tokens (sharded over ('dp','ep','sp') outside, or
+    replicated — shard_map slices it).  Expert params shard over 'ep'.
+    """
+    if cfg.num_experts == 1:
+        return MoEOutput(
+            dense_ffn(params, x, cfg),
+            jnp.zeros((), cfg.accum_dtype), jnp.zeros((), cfg.accum_dtype),
+            jnp.full((1,), x.shape[0], jnp.int32),
+        )
+
+    pspecs = {k: P("ep") if k != "gate_w" and not k.startswith("shared")
+              else P() for k in params}
+    body = functools.partial(
+        _ep_moe_shard, cfg=cfg, axis="ep", use_pallas=use_pallas
+    )
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pspecs, P("ep", None)),
+        out_specs=MoEOutput(P("ep", None), P(), P(), P()),
+        check_vma=False,
+    )
+    return fn(params, x)

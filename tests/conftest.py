@@ -8,16 +8,25 @@ without TPU hardware.  Pallas kernels run in interpreter mode on CPU.
 
 import os
 
-# Must be set before jax initializes.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Must be set before jax initializes its backends.  The environment may pin
+# JAX_PLATFORMS to a TPU plugin (e.g. axon); tests explicitly force the
+# 8-device virtual CPU backend unless FLASHMOE_TEST_TPU=1 requests running
+# the suite against real hardware.
+if os.environ.get("FLASHMOE_TEST_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
+
+if os.environ.get("FLASHMOE_TEST_TPU") != "1":
+    # A TPU plugin loaded from sitecustomize may have pinned the platform
+    # via jax.config before this file ran; force it back.
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -80,6 +80,18 @@ def test_dense_fallback_e1():
     )
 
 
+def test_integrated_pallas_path_interpret():
+    """The fused Pallas gate + grouped-FFN layer end-to-end (interpreter)."""
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                    intermediate_size=256, sequence_len=128, **NODROP)
+    params, x = _setup(cfg)
+    want, _ = reference_moe(params, x, cfg)
+    got = moe_layer(params, x, cfg, use_pallas=True, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got.out), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_jit_and_grad():
     """The layer must be jittable and differentiable (training path)."""
     cfg = MoEConfig(num_experts=4, expert_top_k=2, hidden_size=64,
