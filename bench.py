@@ -1,0 +1,104 @@
+"""Benchmark: MoE-layer forward latency on the local chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The headline config mirrors the reference's benchmark setting
+(``csrc/flashmoe_config.json``: E=64, top-k=2, H=2048, I=2048, S=8192) run
+through the fused Pallas path.  ``vs_baseline`` is the speedup of the fused
+path over the naive XLA dense-dispatch implementation measured in the same
+run on the same chip — the analogue of the reference's comparisons against
+Megatron-style baselines (``README.md:27``).
+
+Usage:
+  python bench.py              # headline number (one JSON line)
+  python bench.py --config token_scaling --trials 50
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from flashmoe_tpu.config import BENCH_CONFIGS, MoEConfig
+from flashmoe_tpu.models.reference import init_moe_params
+from flashmoe_tpu.ops.moe import moe_layer
+
+
+def _chained(cfg: MoEConfig, use_pallas: bool, iters: int):
+    """Jit `iters` dependent MoE-layer applications ending in a scalar
+    readback.  On remote-tunneled backends (axon) `block_until_ready` does
+    not synchronize, and the host round-trip is ~100x one layer — so the
+    per-iteration time comes from differencing two chain lengths."""
+
+    def run(p, x):
+        def body(x, _):
+            o = moe_layer(p, x, cfg, use_pallas=use_pallas)
+            return o.out.astype(x.dtype), None
+        x, _ = jax.lax.scan(body, x, None, length=iters)
+        return x.astype(jnp.float32).sum()
+
+    return jax.jit(run)
+
+
+def _time_chain(fn, p, x, trials):
+    float(fn(p, x))  # compile + warm
+    times = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        float(fn(p, x))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def bench_moe_layer(cfg: MoEConfig, trials: int, chain: int = 16):
+    key = jax.random.PRNGKey(0)
+    params = init_moe_params(key, cfg)
+    params = jax.tree_util.tree_map(lambda p: p.astype(cfg.dtype), params)
+    x = jax.random.normal(
+        jax.random.PRNGKey(1), (cfg.tokens, cfg.hidden_size), cfg.dtype
+    )
+
+    out = {}
+    for name, use_pallas in (("fused", True), ("xla", False)):
+        t1 = _time_chain(_chained(cfg, use_pallas, 1), params, x, trials)
+        tn = _time_chain(_chained(cfg, use_pallas, chain), params, x, trials)
+        out[name] = max(tn - t1, 1e-9) / (chain - 1)
+    return out["fused"], out["xla"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="reference",
+                    choices=sorted(BENCH_CONFIGS.keys()))
+    ap.add_argument("--trials", type=int, default=7)
+    ap.add_argument("--chain", type=int, default=16)
+    args = ap.parse_args()
+
+    cfg = BENCH_CONFIGS[args.config]
+    if cfg.ep > 1 and len(jax.devices()) < cfg.ep:
+        cfg = cfg.replace(ep=1)
+
+    t_fused, t_xla = bench_moe_layer(cfg, args.trials, args.chain)
+    tokens_per_sec = cfg.tokens / t_fused
+    print(json.dumps({
+        "metric": f"moe_layer_fwd_ms[{args.config}:E={cfg.num_experts},"
+                  f"k={cfg.expert_top_k},H={cfg.hidden_size},"
+                  f"I={cfg.intermediate_size},S={cfg.tokens},"
+                  f"{jnp.dtype(cfg.dtype).name}]",
+        "value": round(t_fused * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(t_xla / t_fused, 3),
+        "tokens_per_sec_per_chip": round(tokens_per_sec),
+        "xla_path_ms": round(t_xla * 1e3, 3),
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

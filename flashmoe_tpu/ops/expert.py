@@ -196,8 +196,16 @@ def capacity_buffer_ffn_pallas(xs, params, cfg: MoEConfig, *,
     never reads.
     """
     e, c, h = xs.shape
-    bm = BLOCK_M if c >= BLOCK_M else max(8, 1 << (c - 1).bit_length())
-    bm = min(bm, BLOCK_M)
+    # Row tile sized to cover the whole per-expert capacity when it fits
+    # (<= 512 rows): each expert's weights then stream through VMEM exactly
+    # once.  Smaller capacities round up to the sublane multiple; larger
+    # ones tile at 512 (weights re-fetched once per 512 rows).
+    if c <= 512:
+        bm = ((c + 7) // 8) * 8
+    else:
+        bm = next(b for b in (512, 256, 128) if c % b == 0) if any(
+            c % b == 0 for b in (512, 256, 128)
+        ) else 512
     cp = ((c + bm - 1) // bm) * bm
     if cp != c:
         xs = jnp.pad(xs, ((0, 0), (0, cp - c), (0, 0)))
@@ -206,11 +214,13 @@ def capacity_buffer_ffn_pallas(xs, params, cfg: MoEConfig, *,
     tile_gid = (
         jnp.arange(e * tiles_per_e, dtype=jnp.int32) // tiles_per_e
     )
+    # keep the chunked weight working set within VMEM alongside the row tile
+    block_i = 512 if bm <= 256 else 256
     out = grouped_ffn(
         x, tile_gid, params["w_up"].astype(x.dtype),
         params["b_up"], params["w_down"].astype(x.dtype), params["b_down"],
         params.get("w_gate", None) if cfg.gated_ffn else None,
         act_name=cfg.hidden_act, gated=cfg.gated_ffn, block_m=bm,
-        interpret=interpret,
+        block_i=block_i, interpret=interpret,
     )
     return out.reshape(e, cp, h)[:, :c, :]

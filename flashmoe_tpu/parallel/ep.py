@@ -48,7 +48,8 @@ def local_capacity(cfg: MoEConfig, s_local: int) -> int:
     return cfg.capacity_for(s_local)
 
 
-def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool):
+def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
+                  reduce_axes: tuple[str, ...] = ("ep",)):
     """Per-rank body (runs inside shard_map over the ep axis).
 
     x: [S_loc, H] local tokens; params: expert weights sharded on axis 0
@@ -88,18 +89,21 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool):
             x.astype(cfg.dtype), params, cfg
         ).astype(out.dtype)
 
-    aux = jax.lax.pmean(r.aux_loss, axis) * cfg.aux_loss_coef
-    z = jax.lax.pmean(r.z_loss, axis)
-    counts = jax.lax.psum(r.expert_counts, axis)
+    aux = jax.lax.pmean(r.aux_loss, reduce_axes) * cfg.aux_loss_coef
+    z = jax.lax.pmean(r.z_loss, reduce_axes)
+    counts = jax.lax.psum(r.expert_counts, reduce_axes)
     return MoEOutput(out.astype(cfg.dtype), aux, z, counts)
 
 
 def ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
-                 use_pallas: bool = False) -> MoEOutput:
+                 use_pallas: bool = False,
+                 token_axes: tuple[str, ...] = ("ep",)) -> MoEOutput:
     """Expert-parallel MoE layer over a global token batch.
 
-    x: [S, H] global tokens (sharded over ('dp','ep','sp') outside, or
-    replicated — shard_map slices it).  Expert params shard over 'ep'.
+    x: [S, H] global tokens, sharded over ``token_axes`` (e.g.
+    ``('dp', 'ep')`` inside a data-parallel model — the all-to-all then
+    runs within each dp group).  Expert params shard over 'ep' and are
+    replicated across the other axes.
     """
     if cfg.num_experts == 1:
         return MoEOutput(
@@ -111,12 +115,13 @@ def ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
     pspecs = {k: P("ep") if k != "gate_w" and not k.startswith("shared")
               else P() for k in params}
     body = functools.partial(
-        _ep_moe_shard, cfg=cfg, axis="ep", use_pallas=use_pallas
+        _ep_moe_shard, cfg=cfg, axis="ep", use_pallas=use_pallas,
+        reduce_axes=token_axes,
     )
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(pspecs, P("ep", None)),
-        out_specs=MoEOutput(P("ep", None), P(), P(), P()),
+        in_specs=(pspecs, P(token_axes, None)),
+        out_specs=MoEOutput(P(token_axes, None), P(), P(), P()),
         check_vma=False,
     )
     return fn(params, x)

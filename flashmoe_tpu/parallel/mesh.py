@@ -100,3 +100,37 @@ def shard_params(params, cfg: MoEConfig, mesh: Mesh):
         k: jax.device_put(v, NamedSharding(mesh, specs[k]))
         for k, v in params.items()
     }
+
+
+def transformer_param_specs(cfg: MoEConfig) -> dict:
+    """PartitionSpecs for the full transformer parameter tree
+    (:func:`flashmoe_tpu.models.transformer.init_params` layout).
+
+    Attention projections are Megatron-style tp-split (columns for qkv,
+    rows for the output projection); the LM head is column-parallel over
+    the vocab; MoE experts shard over ep.
+    """
+    tp_ax = "tp" if cfg.tp > 1 else None
+    layer = {
+        "attn_norm": P(None),
+        "ffn_norm": P(None),
+        "wq": P(None, tp_ax),
+        "wk": P(None, tp_ax),
+        "wv": P(None, tp_ax),
+        "wo": P(tp_ax, None),
+        "moe": moe_param_specs(cfg),
+    }
+    dense_moe = moe_param_specs(
+        cfg.replace(num_experts=1, expert_top_k=1, num_shared_experts=0, ep=1)
+    )
+    moe_set = set(cfg.moe_layer_indices)
+    layers = [
+        {**layer, "moe": layer["moe"] if li in moe_set else dense_moe}
+        for li in range(cfg.num_layers)
+    ]
+    return {
+        "embed": P(None, None),
+        "final_norm": P(None),
+        "lm_head": P(None, tp_ax),
+        "layers": layers,
+    }

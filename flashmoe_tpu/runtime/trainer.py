@@ -1,0 +1,131 @@
+"""Training loop: sharded optax train step over the device mesh.
+
+The reference models DP training costs in its Decider (gradient-buffer
+sizing ``types.cuh:491-493``, ring-allreduce pricing
+``os/decider/functions.cuh:28-32``) but executes no training.  This module
+is the executed version: a jit-compiled train step whose gradient averaging
+over dp *is* the allreduce the Decider prices, inserted by XLA from the
+sharding layout (params replicated over dp -> psum of grads over dp).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.models import transformer
+from flashmoe_tpu.parallel.mesh import transformer_param_specs
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def make_optimizer(cfg: MoEConfig, lr: float = 3e-4,
+                   weight_decay: float = 0.1,
+                   warmup_steps: int = 100,
+                   total_steps: int = 10000) -> optax.GradientTransformation:
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, lr, warmup_steps, max(total_steps, warmup_steps + 1)
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+def init_state(key, cfg: MoEConfig, optimizer) -> TrainState:
+    params = transformer.init_params(key, cfg)
+    return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+
+
+def state_shardings(state: TrainState, cfg: MoEConfig, mesh: Mesh):
+    """NamedShardings for the train state: params per the transformer
+    specs, optimizer moments following their parameters, step replicated."""
+    pspecs = transformer_param_specs(cfg)
+
+    def to_sharding(spec):
+        return NamedSharding(mesh, spec)
+
+    param_sh = jax.tree_util.tree_map(
+        to_sharding, pspecs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+    def opt_sharding(leaf):
+        # moments have the same shape as params where they are pytrees of
+        # arrays; scalars replicate
+        return NamedSharding(mesh, P())
+
+    # map optimizer state: arrays matching a param shape get the param's
+    # sharding, everything else replicates
+    flat_params, _ = jax.tree_util.tree_flatten(state.params)
+    flat_shard, _ = jax.tree_util.tree_flatten(param_sh)
+    shape_map = {}
+    for p, s in zip(flat_params, flat_shard):
+        shape_map.setdefault(p.shape, s)
+
+    def match(leaf):
+        if hasattr(leaf, "shape") and leaf.shape in shape_map and leaf.ndim > 0:
+            return shape_map[leaf.shape]
+        return NamedSharding(mesh, P())
+
+    opt_sh = jax.tree_util.tree_map(match, state.opt_state)
+    return TrainState(param_sh, opt_sh, NamedSharding(mesh, P()))
+
+
+def make_train_step(cfg: MoEConfig, mesh: Mesh, optimizer,
+                    use_pallas: bool | None = None) -> Callable:
+    """Build the jitted, mesh-sharded train step.
+
+    Returns step(state, batch) -> (state, metrics).  Batch tokens shard
+    over dp; XLA inserts the dp gradient allreduce from the sharding
+    layout.
+    """
+
+    def step_fn(state: TrainState, batch):
+        (loss, metrics), grads = jax.value_and_grad(
+            transformer.loss_fn, has_aux=True
+        )(state.params, batch, cfg, mesh, use_pallas)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        metrics = dict(metrics, loss=loss,
+                       grad_norm=optax.global_norm(grads))
+        return TrainState(params, opt_state, state.step + 1), metrics
+
+    batch_sharding = {"tokens": NamedSharding(mesh, P("dp", None))}
+    return jax.jit(
+        step_fn,
+        in_shardings=(None, batch_sharding),
+        donate_argnums=(0,),
+    )
+
+
+def train(cfg: MoEConfig, mesh: Mesh, data_iter, num_steps: int,
+          key=None, log_every: int = 10, state: TrainState | None = None,
+          use_pallas: bool | None = None):
+    """Simple host training loop (see runtime.worker for the CLI)."""
+    key = key if key is not None else jax.random.PRNGKey(0)
+    optimizer = make_optimizer(cfg, total_steps=num_steps)
+    if state is None:
+        state = init_state(key, cfg, optimizer)
+        sh = state_shardings(state, cfg, mesh)
+        state = jax.device_put(state, sh)
+    step = make_train_step(cfg, mesh, optimizer, use_pallas=use_pallas)
+    history = []
+    for i in range(num_steps):
+        batch = next(data_iter)
+        state, metrics = step(state, batch)
+        if i % log_every == 0 or i == num_steps - 1:
+            history.append({k: float(v) for k, v in metrics.items()})
+    return state, history

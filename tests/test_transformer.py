@@ -1,0 +1,84 @@
+"""Flagship transformer: forward, loss, sharded training on the mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.models.transformer import (
+    forward, init_params, loss_fn, sgd_train_step,
+)
+from flashmoe_tpu.parallel.mesh import make_mesh
+from flashmoe_tpu.runtime.trainer import (
+    init_state, make_optimizer, make_train_step, state_shardings, train,
+)
+
+CFG = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                intermediate_size=256, sequence_len=64, num_layers=2,
+                moe_frequency=2, vocab_size=512, num_heads=4,
+                drop_tokens=False, is_training=True, ep=4,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def _batch(cfg, b=2, seed=0):
+    return {
+        "tokens": jax.random.randint(
+            jax.random.PRNGKey(seed), (b, cfg.sequence_len + 1), 0,
+            cfg.vocab_size
+        )
+    }
+
+
+def test_forward_shapes():
+    cfg = CFG.replace(ep=1)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = _batch(cfg)["tokens"][:, :-1]
+    logits, aux = forward(params, tokens, cfg)
+    assert logits.shape == (2, cfg.sequence_len, cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+    assert float(aux) > 0  # MoE layer contributes aux loss
+
+
+def test_dense_layers_interleave():
+    """moe_frequency=2 -> layer 0 dense (1 expert), layer 1 MoE."""
+    cfg = CFG.replace(ep=1)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert params["layers"][0]["moe"]["w_up"].shape[0] == 1
+    assert params["layers"][1]["moe"]["w_up"].shape[0] == cfg.num_experts
+
+
+def test_train_step_decreases_loss(devices):
+    mesh = make_mesh(CFG)
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    batch = _batch(CFG)
+    p1, l1, m1 = sgd_train_step(params, batch, CFG, lr=1e-2, mesh=mesh)
+    p2, l2, m2 = sgd_train_step(p1, batch, CFG, lr=1e-2, mesh=mesh)
+    assert float(l2) < float(l1)
+    assert np.isfinite(float(m2["ce"]))
+
+
+def test_optax_trainer_with_shardings(devices):
+    mesh = make_mesh(CFG)
+    opt = make_optimizer(CFG, total_steps=4)
+    state = init_state(jax.random.PRNGKey(0), CFG, opt)
+    state = jax.device_put(state, state_shardings(state, CFG, mesh))
+    step = make_train_step(CFG, mesh, opt)
+    batch = _batch(CFG)
+    losses = []
+    for _ in range(3):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert int(state.step) == 3
+    assert losses[-1] < losses[0]
+    # expert weights actually sharded over ep
+    moe_w = state.params["layers"][1]["moe"]["w_up"]
+    assert "ep" in str(moe_w.sharding.spec) or moe_w.sharding.is_fully_replicated is False
+
+
+def test_train_loop_helper(devices):
+    mesh = make_mesh(CFG)
+    it = iter([_batch(CFG, seed=i) for i in range(3)])
+    state, hist = train(CFG, mesh, it, num_steps=3, log_every=1)
+    assert len(hist) == 3
+    assert all(np.isfinite(h["loss"]) for h in hist)
