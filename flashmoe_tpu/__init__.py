@@ -11,6 +11,7 @@ __version__ = "0.1.0"
 
 from flashmoe_tpu.config import Activation, MoEConfig, BENCH_CONFIGS
 from flashmoe_tpu.ops.moe import moe_layer, MoEOutput
+from flashmoe_tpu.api import get_compiled_config, get_num_local_experts, run_moe
 
 __all__ = [
     "Activation",
@@ -18,4 +19,7 @@ __all__ = [
     "BENCH_CONFIGS",
     "moe_layer",
     "MoEOutput",
+    "run_moe",
+    "get_compiled_config",
+    "get_num_local_experts",
 ]

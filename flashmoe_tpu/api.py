@@ -1,0 +1,48 @@
+"""Top-level API facade — parity with the reference's ``flashmoe.ops``.
+
+Reference surface (``flashmoe/ops.py:18-71``, ``flashmoe/__init__.py``):
+``run_moe(n_processes, processes_per_node, hostfile, config_path)`` and
+``get_compiled_config()``.  Here ``run_moe`` launches worker processes over
+the local devices, and ``get_compiled_config`` returns the active config
+(the reference compiles it in; we specialize at jit time, so the "compiled"
+config is the runtime's).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.runtime import bootstrap
+from flashmoe_tpu.runtime.launcher import run_workers
+
+
+def run_moe(n_processes: int = 1, processes_per_node: int | None = None,
+            hostfile: str | None = None,
+            config_path: str | None = None, *, bench: bool = False) -> int:
+    """Launch the MoE workers (reference ``flashmoe.run_moe``).
+
+    ``processes_per_node``/``hostfile`` are accepted for interface parity;
+    multi-host TPU jobs are normally scheduler-launched (see
+    :func:`flashmoe_tpu.runtime.launcher.slurm_command`).
+    """
+    del processes_per_node, hostfile  # scheduler-managed on TPU
+    return run_workers(n_processes, config_path=config_path, bench=bench)
+
+
+def get_compiled_config() -> dict:
+    """The active configuration as a dict (reference
+    ``get_compiled_config``, ``python_bindings.cu:194-217``)."""
+    try:
+        cfg = bootstrap.get_runtime().cfg
+    except RuntimeError:
+        cfg = MoEConfig()
+    d = dataclasses.asdict(cfg)
+    for k in ("dtype", "param_dtype", "accum_dtype"):
+        d[k] = str(d[k].__name__ if hasattr(d[k], "__name__") else d[k])
+    return d
+
+
+def get_num_local_experts() -> int:
+    """Reference ``get_num_local_experts`` (``python_bindings.cu:187``)."""
+    return bootstrap.get_runtime().num_local_experts

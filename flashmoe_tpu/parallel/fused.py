@@ -1,0 +1,348 @@
+"""Fused expert-parallel MoE: device-initiated all-to-all inside the kernel,
+overlapped with the expert FFN — the FlashDMoE headline capability on TPU.
+
+The reference fuses dispatch -> expert GEMMs -> combine-return into one
+persistent CUDA kernel in which NVSHMEM puts carry expert payloads between
+GPUs while tile processors compute (``csrc/include/flashmoe/moe/moe.cuh:
+71-144``; transport in ``os/packet.cuh:207-259`` and
+``os/processor/processor.cuh:711-751``; the in-kernel actor scheduler in
+``os/scheduler.cuh``/``subscriber.cuh`` exists to keep SMs busy while
+payloads are in flight).
+
+On TPU the same capability is a single Pallas kernel per rank, shard_mapped
+over the ``ep`` mesh axis:
+
+  * phase 0 — a cross-device barrier (each rank signals every peer), the
+    analogue of the symmetric-heap readiness the reference gets from
+    collective allocation (``bootstrap.cuh:347-362``);
+  * phase 1 — every rank starts ALL its outbound slab RDMAs at once
+    (``make_async_remote_copy``, non-blocking — the analogue of
+    ``nvshmem_putmem_signal_nbi``), staggered by rank so the ICI links are
+    used all-to-all rather than all-to-one;
+  * phase 2 — one grid step per source rank, in ring arrival order: wait
+    that source's recv semaphore (the data-carrying signal of the
+    reference's ``SignalPayload``), run the local experts' up/act/down
+    GEMM chain on the arrived slab with weights streamed HBM->VMEM, and
+    immediately RDMA the results back to the source.  Compute on slab s
+    overlaps the in-flight transfers of slabs s+1.. — payload-granularity
+    overlap, which is the paper's core claim;
+  * phase 3 — drain: wait all return-path semaphores and send semaphores.
+
+Gate/plan/dispatch-layout and the final combine stay in XLA (they are
+bandwidth-trivial next to the FFN); the kernel owns exactly the
+communication-heavy middle.  Capacity-format slabs keep every shape static.
+
+Layouts (D = ep world, nLx = local experts, C = per-(rank, expert) capacity):
+  x_send  [D, nLx, C, H]  on each source rank: slab d holds tokens routed
+                          to rank d's local experts (dest-major).
+  x_recv  [D, nLx, C, H]  on each dest rank: slab s is written remotely by
+                          source rank s (source-major).
+  y_recv  [D, nLx, C, H]  back on the source rank: slab d holds results
+                          from owner rank d — exactly the [E, C, H] combine
+                          layout after reshape.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.models.reference import activation_fn
+from flashmoe_tpu.ops import dispatch as dsp
+from flashmoe_tpu.ops.gate import router
+from flashmoe_tpu.ops.moe import MoEOutput
+from flashmoe_tpu.parallel.ep import local_capacity
+
+
+def _fused_kernel(
+    x_send, w_up, b_up, w_down, b_down,   # inputs (ANY/VMEM)
+    x_recv, y_recv, y_stage,              # outputs (ANY; first two remote-written)
+    xs_vmem, wup_vmem, wdn_vmem, acc, yv, # VMEM scratch
+    bup_vmem, bdn_vmem,
+    copy_sems, send_x_sems, recv_x_sems, send_y_sems, recv_y_sems,
+    *, axis, act_name, cm, bi,
+):
+    """One grid step = one source slab (ring order)."""
+    s = pl.program_id(0)
+    d_world = pl.num_programs(0)
+    my = jax.lax.axis_index(axis)
+    nlx, cap, h = x_send.shape[1], x_send.shape[2], x_send.shape[3]
+    i_dim = w_up.shape[2]
+    act = activation_fn(act_name)
+
+    # ---- phase 0/1 (first step only): barrier, then start every send ----
+    @pl.when(s == 0)
+    def _():
+        barrier = pltpu.get_barrier_semaphore()
+
+        def signal_peer(d, c):
+            @pl.when(d != my)
+            def _():
+                pltpu.semaphore_signal(
+                    barrier, inc=1, device_id=d,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+            return c
+
+        jax.lax.fori_loop(0, d_world, signal_peer, 0)
+        pltpu.semaphore_wait(barrier, d_world - 1)
+
+        def send(step, c):
+            dst = jax.lax.rem(my + step + 1, d_world)
+            pltpu.make_async_remote_copy(
+                src_ref=x_send.at[dst],
+                dst_ref=x_recv.at[my],
+                send_sem=send_x_sems.at[dst],
+                recv_sem=recv_x_sems.at[my],
+                device_id=dst,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            ).start()
+            return c
+
+        jax.lax.fori_loop(0, d_world - 1, send, 0)
+        # own slab: plain local copy
+        own = pltpu.make_async_copy(
+            x_send.at[my], x_recv.at[my], copy_sems.at[0]
+        )
+        own.start()
+        own.wait()
+
+    # ---- phase 2: process source slab in ring-arrival order ----
+    src = jax.lax.rem(my + s, d_world)
+
+    @pl.when(s != 0)
+    def _():
+        # wait for this source's slab (sender signalled recv_x_sems[src])
+        pltpu.make_async_copy(
+            x_recv.at[src], x_recv.at[src], recv_x_sems.at[src]
+        ).wait()
+
+    n_row_tiles = cap // cm
+    n_i_chunks = i_dim // bi
+
+    def expert_body(e, _):
+        # stream this expert's biases once
+        bup_dma = pltpu.make_async_copy(
+            b_up.at[pl.ds(e, 1), :], bup_vmem, copy_sems.at[1]
+        )
+        bdn_dma = pltpu.make_async_copy(
+            b_down.at[pl.ds(e, 1), :], bdn_vmem, copy_sems.at[2]
+        )
+        bup_dma.start(); bdn_dma.start()
+        bup_dma.wait(); bdn_dma.wait()
+
+        def row_tile_body(t, _):
+            xd = pltpu.make_async_copy(
+                x_recv.at[src, e, pl.ds(t * cm, cm), :],
+                xs_vmem, copy_sems.at[0],
+            )
+            xd.start()
+            xd.wait()
+            acc[:] = jnp.zeros_like(acc)
+
+            def chunk_body(j, _):
+                wu = pltpu.make_async_copy(
+                    w_up.at[e, :, pl.ds(j * bi, bi)], wup_vmem,
+                    copy_sems.at[1],
+                )
+                wd = pltpu.make_async_copy(
+                    w_down.at[e, pl.ds(j * bi, bi), :], wdn_vmem,
+                    copy_sems.at[2],
+                )
+                wu.start(); wd.start()
+                wu.wait()
+                up = jnp.dot(
+                    xs_vmem[:], wup_vmem[:],
+                    preferred_element_type=jnp.float32,
+                )
+                up = up + bup_vmem[0, pl.ds(j * bi, bi)].astype(jnp.float32)
+                hidden = act(up).astype(xs_vmem.dtype)
+                wd.wait()
+                acc[:] += jnp.dot(
+                    hidden, wdn_vmem[:], preferred_element_type=jnp.float32
+                )
+                return _
+
+            jax.lax.fori_loop(0, n_i_chunks, chunk_body, 0)
+            yv[:] = (
+                acc[:] + bdn_vmem[0].astype(jnp.float32)
+            ).astype(yv.dtype)
+            st = pltpu.make_async_copy(
+                yv, y_stage.at[src, e, pl.ds(t * cm, cm), :], copy_sems.at[0]
+            )
+            st.start()
+            st.wait()
+            return _
+
+        jax.lax.fori_loop(0, n_row_tiles, row_tile_body, 0)
+        return _
+
+    jax.lax.fori_loop(0, nlx, expert_body, 0)
+
+    # ---- return path: send results back to the source rank ----
+    # y_stage is indexed by src so step s+1 never overwrites a slab whose
+    # (asynchronous) return transfer is still in flight.
+    @pl.when(src != my)
+    def _():
+        pltpu.make_async_remote_copy(
+            src_ref=y_stage.at[src],
+            dst_ref=y_recv.at[my],
+            send_sem=send_y_sems.at[src],
+            recv_sem=recv_y_sems.at[my],
+            device_id=src,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        ).start()
+
+    @pl.when(src == my)
+    def _():
+        own = pltpu.make_async_copy(
+            y_stage.at[src], y_recv.at[my], copy_sems.at[0]
+        )
+        own.start()
+        own.wait()
+
+    # ---- phase 3 (last step): drain all semaphores ----
+    @pl.when(s == d_world - 1)
+    def _():
+        def drain(d, c):
+            @pl.when(d != my)
+            def _():
+                # sends: wait local send semaphores
+                pltpu.make_async_copy(
+                    x_send.at[d], x_send.at[d], send_x_sems.at[d]
+                ).wait()
+                pltpu.make_async_copy(
+                    y_stage.at[d], y_stage.at[d], send_y_sems.at[d]
+                ).wait()
+                # returns: wait remote-written result slabs
+                pltpu.make_async_copy(
+                    y_recv.at[d], y_recv.at[d], recv_y_sems.at[d]
+                ).wait()
+            return c
+
+        jax.lax.fori_loop(0, d_world, drain, 0)
+
+
+def _fused_shard(x_send, w_up, b_up, w_down, b_down, *, cfg: MoEConfig,
+                 axis: str, interpret, collective_id: int):
+    d_world, nlx, cap, h = x_send.shape
+    i_dim = w_up.shape[2]
+    cm = min(cap, 256)
+    if cap % cm:
+        raise ValueError(f"capacity {cap} not divisible by row tile {cm}")
+    bi = min(512 if cm <= 128 else 256, i_dim)
+    if i_dim % bi:
+        raise ValueError(f"intermediate {i_dim} not divisible by {bi}")
+
+    kernel = functools.partial(
+        _fused_kernel, axis=axis, act_name=cfg.hidden_act, cm=cm, bi=bi,
+    )
+    out_shapes = [
+        jax.ShapeDtypeStruct((d_world, nlx, cap, h), x_send.dtype),  # x_recv
+        jax.ShapeDtypeStruct((d_world, nlx, cap, h), x_send.dtype),  # y_recv
+        jax.ShapeDtypeStruct((d_world, nlx, cap, h), x_send.dtype),  # y_stage
+    ]
+    interp = False
+    if interpret:
+        interp = pltpu.InterpretParams(
+            dma_execution_mode="eager", detect_races=False,
+        )
+    _, y_recv, _ = pl.pallas_call(
+        kernel,
+        grid=(d_world,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),  # x_send
+            pl.BlockSpec(memory_space=pltpu.ANY),  # w_up
+            pl.BlockSpec(memory_space=pltpu.ANY),  # b_up
+            pl.BlockSpec(memory_space=pltpu.ANY),  # w_down
+            pl.BlockSpec(memory_space=pltpu.ANY),  # b_down
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_shape=out_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((cm, h), x_send.dtype),        # xs
+            pltpu.VMEM((h, bi), x_send.dtype),        # w_up chunk
+            pltpu.VMEM((bi, h), x_send.dtype),        # w_down chunk
+            pltpu.VMEM((cm, h), jnp.float32),         # acc
+            pltpu.VMEM((cm, h), x_send.dtype),        # y tile
+            pltpu.VMEM((1, i_dim), b_up.dtype),       # bias up
+            pltpu.VMEM((1, h), b_down.dtype),         # bias down
+            pltpu.SemaphoreType.DMA((4,)),            # local copy sems
+            pltpu.SemaphoreType.DMA((d_world,)),      # send x
+            pltpu.SemaphoreType.DMA((d_world,)),      # recv x
+            pltpu.SemaphoreType.DMA((d_world,)),      # send y
+            pltpu.SemaphoreType.DMA((d_world,)),      # recv y
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id,
+        ),
+        interpret=interp,
+    )(x_send, w_up, b_up, w_down, b_down)
+    return y_recv
+
+
+def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
+                       interpret: bool = False,
+                       use_pallas_gate: bool | None = None,
+                       token_axes: tuple[str, ...] = ("ep",),
+                       collective_id: int = 7) -> MoEOutput:
+    """Expert-parallel MoE with the fused in-kernel all-to-all.
+
+    Same contract as :func:`flashmoe_tpu.parallel.ep.ep_moe_layer`; gated
+    FFN and shared experts are not yet supported on this path.
+    """
+    if cfg.gated_ffn or cfg.num_shared_experts:
+        raise NotImplementedError(
+            "fused path does not support gated/shared experts yet"
+        )
+
+    def body(params, x):
+        d = jax.lax.axis_size("ep")
+        s_loc, h = x.shape
+        nlx = cfg.num_experts // d
+        cap = local_capacity(cfg, s_loc)
+
+        use_gate_pallas = (
+            use_pallas_gate
+            if use_pallas_gate is not None
+            else (interpret or jax.default_backend() == "tpu")
+        )
+        r = router(x, params["gate_w"], cfg, use_pallas=use_gate_pallas,
+                   interpret=interpret)
+        plan = dsp.make_plan(r.expert_idx, cfg, cap)
+        xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)
+        x_send = xbuf.reshape(d, nlx, cap, h)
+
+        y_recv = _fused_shard(
+            x_send,
+            params["w_up"].astype(cfg.dtype), params["b_up"],
+            params["w_down"].astype(cfg.dtype), params["b_down"],
+            cfg=cfg, axis="ep", interpret=interpret,
+            collective_id=collective_id,
+        )
+        ybuf = y_recv.reshape(cfg.num_experts, cap, h)
+        out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)
+
+        aux = jax.lax.pmean(r.aux_loss, token_axes) * cfg.aux_loss_coef
+        z = jax.lax.pmean(r.z_loss, token_axes)
+        counts = jax.lax.psum(r.expert_counts, token_axes)
+        return MoEOutput(out.astype(cfg.dtype), aux, z, counts)
+
+    pspecs = {k: P("ep") if k != "gate_w" else P() for k in params}
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pspecs, P(token_axes, None)),
+        out_specs=MoEOutput(P(token_axes, None), P(), P(), P()),
+        check_vma=False,
+    )
+    return fn(params, x)

@@ -1,0 +1,122 @@
+"""Host bootstrap: distributed init, topology, placement, mesh.
+
+The TPU-native equivalent of the reference's ``flashmoe::initialize()`` /
+``distributedInit`` (``csrc/include/flashmoe/bootstrap.cuh:278-547``): where
+the reference runs ``nvshmem_init``, probes throughput (``mT``), measures
+topology, runs the Decider, and sizes a symmetric heap, we run
+``jax.distributed.initialize`` (multi-host), derive the ICI adjacency
+analytically, run the Python Decider, and build the device mesh — the
+"symmetric heap" is XLA's job (buffers come from the collective layouts).
+
+The result is a :class:`Runtime` handle, the analogue of the reference's
+``Bookkeeping`` singleton (``types.cuh:696-1007``) minus everything XLA
+already owns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import jax
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.parallel.decider import Placement, decide, uniform_placement
+from flashmoe_tpu.parallel.mesh import make_mesh
+from flashmoe_tpu.parallel.topology import (
+    ici_adjacency, measured_worker_attrs,
+)
+
+_runtime: Optional["Runtime"] = None
+
+
+@dataclasses.dataclass
+class Runtime:
+    cfg: MoEConfig
+    mesh: object
+    placement: Placement
+    num_processes: int
+    process_id: int
+
+    @property
+    def num_local_experts(self) -> int:
+        """nLx for this process's first device (reference
+        ``get_num_local_experts``, ``python_bindings.cu:187``)."""
+        first = len(jax.local_devices()) * self.process_id
+        return len(self.placement.local_experts.get(first, [])) or (
+            self.cfg.num_experts // max(1, self.cfg.ep)
+        )
+
+
+def initialize(cfg: MoEConfig | dict | str | None = None, *,
+               coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None,
+               use_decider: bool = True) -> Runtime:
+    """Bring up the distributed runtime (idempotent).
+
+    Single-process callers get the local devices; multi-process jobs (env
+    ``FLASHMOE_COORDINATOR`` / ``JAX_COORDINATOR_ADDRESS`` or explicit
+    args) run ``jax.distributed.initialize`` first, like the reference's
+    rank discovery from OMPI/PMI/SLURM env vars (``worker.py:24-29``).
+    """
+    global _runtime
+    if _runtime is not None:
+        return _runtime
+
+    if isinstance(cfg, (dict, str)):
+        cfg = MoEConfig.from_json(cfg)
+    cfg = cfg or MoEConfig()
+
+    coord = coordinator_address or os.environ.get(
+        "FLASHMOE_COORDINATOR", os.environ.get("JAX_COORDINATOR_ADDRESS")
+    )
+    nproc = num_processes or int(os.environ.get("FLASHMOE_NPROCS", "0"))
+    pid = process_id if process_id is not None else int(
+        os.environ.get(
+            "FLASHMOE_RANK",
+            os.environ.get("OMPI_COMM_WORLD_RANK",
+                           os.environ.get("PMI_RANK",
+                                          os.environ.get("SLURM_PROCID", "0"))),
+        )
+    )
+    if coord and nproc > 1:
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=nproc, process_id=pid
+        )
+
+    devices = jax.devices()
+    n = len(devices)
+    # fold requested ep down to the available device count
+    ep = min(cfg.ep if cfg.ep > 1 else n, n)
+    while cfg.num_experts % ep:
+        ep -= 1
+    cfg = cfg.replace(ep=max(1, ep))
+    mesh = make_mesh(cfg)
+
+    if use_decider and n > 1:
+        adj = ici_adjacency(devices)
+        placement = decide(adj, measured_worker_attrs(devices), cfg)
+    else:
+        placement = uniform_placement(n, cfg)
+
+    _runtime = Runtime(
+        cfg=cfg, mesh=mesh, placement=placement,
+        num_processes=jax.process_count(), process_id=jax.process_index(),
+    )
+    return _runtime
+
+
+def finalize():
+    """Tear down (reference ``finalize()``, ``bootstrap.cuh:561-588``)."""
+    global _runtime
+    _runtime = None
+    if jax.process_count() > 1:
+        jax.distributed.shutdown()
+
+
+def get_runtime() -> Runtime:
+    if _runtime is None:
+        raise RuntimeError("flashmoe_tpu.runtime not initialized")
+    return _runtime

@@ -1,0 +1,57 @@
+"""Multi-process launcher.
+
+The reference shells out to ``nvshmrun -n N -ppn P python worker.py cfg``
+(``flashmoe/launcher.py:38-56``).  On TPU, multi-host jobs are normally
+started by the cluster scheduler (GKE/“one process per host”), so the
+launcher's job is (a) single-host multi-process simulation for development
+and (b) generating/executing the per-host command with the coordinator
+environment that :mod:`flashmoe_tpu.runtime.bootstrap` consumes.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def run_workers(n_processes: int = 1, *, config_path: str | None = None,
+                bench: bool = False, coordinator: str = "127.0.0.1:8476",
+                extra_env: dict | None = None,
+                worker_module: str = "flashmoe_tpu.runtime.worker") -> int:
+    """Launch N local worker processes (CPU backend: each gets the virtual
+    device set; TPU: single process owns the local chips).
+
+    Returns the worst exit code.  Mirrors ``nvshmrun_launcher``'s contract:
+    build the command, run it, surface stdout/stderr.
+    """
+    procs = []
+    for rank in range(n_processes):
+        env = dict(os.environ)
+        env.update(extra_env or {})
+        if n_processes > 1:
+            env.update({
+                "FLASHMOE_COORDINATOR": coordinator,
+                "FLASHMOE_NPROCS": str(n_processes),
+                "FLASHMOE_RANK": str(rank),
+            })
+        cmd = [sys.executable, "-m", worker_module]
+        if config_path:
+            cmd.append(config_path)
+        if bench:
+            cmd.append("--bench")
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = max(rc, p.returncode)
+    return rc
+
+
+def slurm_command(n_nodes: int, config_path: str) -> str:
+    """The srun command line for a multi-host job (reference README's SLURM
+    path, ``README.md:118-126``)."""
+    return (
+        f"srun -N {n_nodes} --ntasks-per-node=1 "
+        f"python -m flashmoe_tpu.runtime.worker {config_path} --bench"
+    )

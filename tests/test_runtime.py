@@ -1,0 +1,69 @@
+"""Runtime layer: bootstrap, worker CLI, API facade."""
+
+import json
+import subprocess
+import sys
+
+import jax
+
+import flashmoe_tpu as fm
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.runtime import bootstrap
+
+
+def setup_function(_):
+    bootstrap.finalize()
+
+
+def test_initialize_builds_runtime(devices):
+    rt = bootstrap.initialize(MoEConfig(
+        num_experts=8, hidden_size=128, intermediate_size=256,
+        sequence_len=128,
+    ))
+    assert rt.cfg.ep == 8  # folded to available devices
+    assert dict(rt.mesh.shape)["ep"] == 8
+    assert rt.num_local_experts == 1
+    assert bootstrap.get_runtime() is rt
+    # idempotent
+    assert bootstrap.initialize() is rt
+    bootstrap.finalize()
+
+
+def test_initialize_from_reference_json(devices, tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({
+        "num_experts": 4, "expert_top_k": 2, "hidden_size": 128,
+        "intermediate_size": 256, "sequence_len": 128, "torch_dtype": 0,
+        "hidden_act": 1,
+    }))
+    rt = bootstrap.initialize(str(p))
+    assert rt.cfg.num_experts == 4
+    assert rt.cfg.ep == 4
+    bootstrap.finalize()
+
+
+def test_api_facade(devices):
+    cc = fm.get_compiled_config()
+    assert "num_experts" in cc and "hidden_size" in cc
+    bootstrap.initialize(MoEConfig(num_experts=8, hidden_size=128,
+                                   intermediate_size=256))
+    assert fm.get_num_local_experts() >= 1
+    bootstrap.finalize()
+
+
+def test_worker_cli(devices):
+    """The worker runs end-to-end as a subprocess (reference worker.py)."""
+    import os
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    out = subprocess.run(
+        [sys.executable, "-m", "flashmoe_tpu.runtime.worker"],
+        capture_output=True, text=True, env=env, timeout=300,
+        cwd=__import__("pathlib").Path(__file__).parent.parent,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["finite"] is True
+    assert rec["rank"] == 0
