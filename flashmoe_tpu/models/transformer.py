@@ -108,8 +108,17 @@ def _rope(q, k, positions, theta):
     return rot(q), rot(k)
 
 
-def attention(layer, x, cfg: MoEConfig, positions=None):
-    """Causal self-attention with RoPE and GQA. x: [B, T, H]."""
+def attention(layer, x, cfg: MoEConfig, positions=None, mesh=None,
+              use_pallas=None):
+    """Causal self-attention with RoPE and GQA. x: [B, T, H].
+
+    Backend selection: ring attention over the ``sp`` mesh axis for
+    sequence-parallel configs, the flash Pallas kernel on TPU, plain XLA
+    otherwise.
+    """
+    from flashmoe_tpu.ops.attention import attention_xla, flash_attention
+    from flashmoe_tpu.parallel.ringattn import ring_attention
+
     b, t, h = x.shape
     nh, nkv, dh = cfg.num_heads, cfg.resolved_num_kv_heads, cfg.resolved_head_dim
     if positions is None:
@@ -125,16 +134,17 @@ def attention(layer, x, cfg: MoEConfig, positions=None):
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
 
-    scale = dh ** -0.5
-    logits = jnp.einsum(
-        "btnd,bsnd->bnts", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    causal = jnp.tril(jnp.ones((t, t), bool))
-    logits = jnp.where(causal[None, None], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-    ctx = jnp.einsum("bnts,bsnd->btnd", probs, v,
-                     preferred_element_type=jnp.float32)
-    ctx = ctx.reshape(b, t, nh * dh).astype(x.dtype)
+    # [B, T, N, D] -> [B, N, T, D] for the attention kernels
+    qh, kh, vh = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if mesh is not None and cfg.sp > 1:
+        ctx = ring_attention(qh, kh, vh, mesh, causal=True)
+    elif use_pallas and t % 128 == 0:
+        ctx = flash_attention(qh, kh, vh, causal=True)
+    else:
+        ctx = attention_xla(qh, kh, vh, causal=True)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, t, nh * dh).astype(x.dtype)
     return ctx @ layer["wo"].astype(x.dtype)
 
 
@@ -146,9 +156,10 @@ def _ffn(layer, x, cfg: MoEConfig, li: int, mesh, use_pallas):
         num_experts=1, expert_top_k=1, num_shared_experts=0
     )
     if mesh is not None and layer_cfg.num_experts > 1 and cfg.ep > 1:
+        axes = ("dp", "ep") + (("sp",) if cfg.sp > 1 else ())
         o = ep_moe_layer(layer["moe"], flat, layer_cfg, mesh,
                          use_pallas=bool(use_pallas),
-                         token_axes=("dp", "ep"))
+                         token_axes=axes)
     else:
         o = moe_layer(layer["moe"], flat, layer_cfg, use_pallas=use_pallas)
     return o.out.reshape(b, t, h).astype(x.dtype), o.aux_loss + o.z_loss
@@ -156,7 +167,8 @@ def _ffn(layer, x, cfg: MoEConfig, li: int, mesh, use_pallas):
 
 def block(layer, x, cfg: MoEConfig, li: int, mesh=None, use_pallas=None):
     """One pre-norm transformer block. Returns (x, moe_losses)."""
-    a = attention(layer, rms_norm(x, layer["attn_norm"]), cfg)
+    a = attention(layer, rms_norm(x, layer["attn_norm"]), cfg, mesh=mesh,
+                  use_pallas=use_pallas)
     x = x + a
     f, moe_loss = _ffn(layer, rms_norm(x, layer["ffn_norm"]), cfg, li, mesh,
                        use_pallas)

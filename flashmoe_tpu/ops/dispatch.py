@@ -63,10 +63,11 @@ def make_plan(expert_idx, cfg: MoEConfig, capacity: int) -> DispatchPlan:
     ohf = oh.transpose(1, 0, 2).reshape(k * s, e)
     ranks = jnp.cumsum(ohf, axis=0) - ohf  # rank within expert
     pos = jnp.sum(ranks * ohf, axis=-1).reshape(k, s).T  # [S, K]
-    if cfg.drop_tokens:
-        valid = pos < capacity
-    else:
-        valid = jnp.ones((s, k), bool)
+    # positions past capacity are ALWAYS invalid — with drop_tokens=False the
+    # caller must size capacity >= max count (capacity_for does), so nothing
+    # clamps; an undersized capacity then degrades to drops instead of
+    # silently scattering into the next expert's buffer region.
+    valid = pos < capacity
     return DispatchPlan(expert_idx, pos, valid, counts)
 
 

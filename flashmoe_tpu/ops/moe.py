@@ -22,10 +22,11 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.config import BLOCK_M, MoEConfig
 from flashmoe_tpu.models.reference import activation_fn, shared_expert_ffn
 from flashmoe_tpu.ops import dispatch as dsp
 from flashmoe_tpu.ops import expert as exp
+from flashmoe_tpu.ops import ragged as rag
 from flashmoe_tpu.ops.gate import router
 
 
@@ -73,17 +74,35 @@ def moe_layer(params, x, cfg: MoEConfig, *, use_pallas: bool | None = None,
         out = dense_ffn(params, x, cfg)
         return MoEOutput(out, zero, zero, jnp.full((1,), s, jnp.int32))
 
-    cap = capacity if capacity is not None else cfg.expert_capacity
     r = router(x, params["gate_w"], cfg, use_pallas=use_pallas,
                interpret=interpret)
-    plan = dsp.make_plan(r.expert_idx, cfg, cap)
-    xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)  # [E, C, H]
-    if use_pallas:
-        ybuf = exp.capacity_buffer_ffn_pallas(xbuf, params, cfg,
-                                              interpret=interpret)
+    if use_pallas and not cfg.drop_tokens and capacity is None:
+        # dropless: ragged expert-sorted grouping + block-sparse grouped FFN
+        # (S*K + E*block rows instead of the capacity path's E*S)
+        bm = BLOCK_M if s >= BLOCK_M else max(8, ((s + 7) // 8) * 8)
+        plan = rag.make_ragged_plan(r.expert_idx, cfg, bm)
+        xbuf = rag.ragged_dispatch(x.astype(cfg.dtype), plan, cfg, bm)
+        ybuf = exp.grouped_ffn(
+            xbuf, plan.tile_gid,
+            params["w_up"].astype(cfg.dtype), params["b_up"],
+            params["w_down"].astype(cfg.dtype), params["b_down"],
+            params.get("w_gate", None) if cfg.gated_ffn else None,
+            act_name=cfg.hidden_act, gated=cfg.gated_ffn, block_m=bm,
+            interpret=interpret,
+        )
+        out = rag.ragged_combine(ybuf, plan, r.combine_weights, cfg)
     else:
-        ybuf = exp.expert_ffn_dense(xbuf, params, cfg)
-    out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)  # [S, H] f32
+        # capacity from the ACTUAL token count of this call, not the config's
+        # nominal sequence length (callers pass batched shards of any size)
+        cap = capacity if capacity is not None else cfg.capacity_for(s)
+        plan = dsp.make_plan(r.expert_idx, cfg, cap)
+        xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)  # [E, C, H]
+        if use_pallas:
+            ybuf = exp.capacity_buffer_ffn_pallas(xbuf, params, cfg,
+                                                  interpret=interpret)
+        else:
+            ybuf = exp.expert_ffn_dense(xbuf, params, cfg)
+        out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)  # [S,H] f32
     if cfg.num_shared_experts:
         out = out + shared_expert_ffn(x.astype(cfg.dtype), params, cfg).astype(
             out.dtype

@@ -1,0 +1,108 @@
+"""Dropless MoE: ragged token grouping + block-sparse grouped FFN.
+
+The reference's dropless mode sets expert capacity to the full token count
+(``EC = S`` when ``drop_tokens=0``, ``types.cuh:497-499``) and lets its
+dynamic tile scheduler process only the ``routedTokens`` actually present
+(``SignalPayload.routedTokens``, dispatch clamp at ``packet.cuh:99-206``) —
+dense capacity buffers would waste memory and FLOPs, so tile-level dynamism
+is the whole point of its in-kernel OS.
+
+The TPU equivalent of that dynamism is *ragged grouping under static
+shapes*: sort the (token, k) assignments by expert, pad each expert's
+segment up to the row-tile size, and hand the result to the grouped Pallas
+FFN kernel whose scalar-prefetched ``tile_gid`` already supports
+data-dependent group ids (:func:`flashmoe_tpu.ops.expert.grouped_ffn`).
+Pad rows cost at most ``E * (block_m - 1)`` extra rows — tile-level waste,
+exactly like the reference's partially-filled final tile per expert — and
+no token is ever dropped.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from flashmoe_tpu.config import MoEConfig
+
+
+class RaggedPlan(NamedTuple):
+    """Ragged grouping of (token, k) assignments by expert.
+
+    position:    [S, K] destination row of each assignment in the sorted,
+                 segment-padded buffer.
+    tile_gid:    [T_pad // block_m] expert id per row tile (dynamic values,
+                 static shape).
+    counts:      [E] assignments per expert.
+    num_rows:    [] total populated+padded rows (<= T_pad, dynamic).
+    """
+
+    position: jax.Array
+    tile_gid: jax.Array
+    counts: jax.Array
+    num_rows: jax.Array
+
+
+def padded_total_rows(cfg: MoEConfig, s: int, block_m: int) -> int:
+    """Static upper bound on the grouped buffer: every assignment plus up
+    to block_m-1 pad rows per expert."""
+    total = s * cfg.expert_top_k + cfg.num_experts * block_m
+    return ((total + block_m - 1) // block_m) * block_m
+
+
+def make_ragged_plan(expert_idx, cfg: MoEConfig, block_m: int) -> RaggedPlan:
+    """Compute the expert-sorted, tile-padded layout. Pure integer work."""
+    s, k = expert_idx.shape
+    e = cfg.num_experts
+    flat_e = expert_idx.T.reshape(-1)  # k-major (matches capacity priority)
+    n = flat_e.shape[0]
+
+    counts = jnp.zeros((e,), jnp.int32).at[flat_e].add(1, mode="drop")
+    padded = ((counts + block_m - 1) // block_m) * block_m
+    seg_starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(padded)[:-1]]
+    )  # [E] padded segment starts
+
+    # stable sort by expert -> rank within expert
+    order = jnp.argsort(flat_e, stable=True)
+    sorted_pos = jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32)
+    )
+    unpadded_starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]]
+    )
+    rank = sorted_pos - unpadded_starts[flat_e]
+    position = (seg_starts[flat_e] + rank).reshape(k, s).T  # [S, K]
+
+    t_pad = padded_total_rows(cfg, s, block_m)
+    n_tiles = t_pad // block_m
+    tile_starts = jnp.arange(n_tiles, dtype=jnp.int32) * block_m
+    seg_ends = seg_starts + padded
+    # tile t belongs to expert e iff seg_starts[e] <= t*block_m < seg_ends[e];
+    # tail tiles past all segments clamp to the last expert (computed, unread)
+    tile_gid = jnp.clip(
+        jnp.searchsorted(seg_ends, tile_starts, side="right"), 0, e - 1
+    ).astype(jnp.int32)
+    return RaggedPlan(position, tile_gid, counts, seg_ends[-1])
+
+
+def ragged_dispatch(x, plan: RaggedPlan, cfg: MoEConfig, block_m: int):
+    """Scatter tokens into the expert-sorted padded buffer: [T_pad, H]."""
+    s, h = x.shape
+    k = plan.position.shape[1]
+    t_pad = padded_total_rows(cfg, s, block_m)
+    src = jnp.broadcast_to(x[:, None, :], (s, k, h)).reshape(-1, h)
+    buf = jnp.zeros((t_pad, h), x.dtype)
+    return buf.at[plan.position.reshape(-1)].set(src, mode="drop")
+
+
+def ragged_combine(y, plan: RaggedPlan, combine_weights, cfg: MoEConfig):
+    """Gather each token's K expert outputs and take the weighted sum."""
+    s, k = plan.position.shape
+    gathered = y[plan.position.reshape(-1)].reshape(s, k, -1)
+    w = combine_weights.astype(jnp.float32)
+    return jnp.einsum(
+        "skh,sk->sh", gathered.astype(jnp.float32), w,
+        preferred_element_type=jnp.float32,
+    )

@@ -76,6 +76,20 @@ def test_optax_trainer_with_shardings(devices):
     assert "ep" in str(moe_w.sharding.spec) or moe_w.sharding.is_fully_replicated is False
 
 
+def test_sequence_parallel_forward(devices):
+    """sp=2: ring attention + EP MoE with tokens sharded over (ep, sp)."""
+    cfg = CFG.replace(ep=2, sp=2, sequence_len=128)
+    mesh = make_mesh(cfg)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = _batch(cfg)["tokens"][:, :-1]
+    logits, aux = forward(params, tokens, cfg, mesh)
+    # oracle: same params, no mesh (single-device dense path)
+    want, _ = forward(params, tokens, cfg.replace(ep=1, sp=1), None)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(want), rtol=2e-3, atol=2e-3
+    )
+
+
 def test_train_loop_helper(devices):
     mesh = make_mesh(CFG)
     it = iter([_batch(CFG, seed=i) for i in range(3)])
