@@ -1,0 +1,111 @@
+"""Loader for the native (C++) runtime components.
+
+Builds ``csrc/*.cpp`` into ``libflashmoe_native.so`` on demand (g++, cached
+under ``csrc/build/``) and exposes the C ABI through ctypes.  Every native
+entry point has a pure-Python fallback, so the framework works without a
+toolchain; when the library is present the native path is preferred and
+cross-validated by tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_CSRC = os.path.join(_ROOT, "csrc")
+_BUILD = os.path.join(_CSRC, "build")
+_LIB = os.path.join(_BUILD, "libflashmoe_native.so")
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _sources():
+    return sorted(
+        os.path.join(_CSRC, f)
+        for f in os.listdir(_CSRC)
+        if f.endswith(".cpp")
+    ) if os.path.isdir(_CSRC) else []
+
+
+def build(force: bool = False) -> str | None:
+    """Compile the native library; returns its path or None."""
+    srcs = _sources()
+    if not srcs:
+        return None
+    os.makedirs(_BUILD, exist_ok=True)
+    if not force and os.path.exists(_LIB):
+        newest = max(os.path.getmtime(s) for s in srcs)
+        if os.path.getmtime(_LIB) >= newest:
+            return _LIB
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _LIB, *srcs]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.CalledProcessError, FileNotFoundError,
+            subprocess.TimeoutExpired):
+        return None
+    return _LIB
+
+
+def load():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            if lib.flashmoe_native_abi_version() != _ABI_VERSION:
+                return None
+            lib.flashmoe_decide.restype = ctypes.c_int
+            lib.flashmoe_decide.argtypes = [
+                ctypes.c_int,
+                np.ctypeslib.ndpointer(np.float64, flags="C"),
+                np.ctypeslib.ndpointer(np.float64, flags="C"),
+                np.ctypeslib.ndpointer(np.float64, flags="C"),
+                np.ctypeslib.ndpointer(np.float64, flags="C"),
+                ctypes.c_int, ctypes.c_double, ctypes.c_double,
+                ctypes.c_double, ctypes.c_double, ctypes.c_int,
+                np.ctypeslib.ndpointer(np.int32, flags="C"),
+                np.ctypeslib.ndpointer(np.int32, flags="C"),
+            ]
+            _lib = lib
+        except OSError:
+            return None
+        return _lib
+
+
+def native_decide(alpha, beta, throughput, memory_gb, num_experts,
+                  expert_mb, act_mb, grad_mb, gamma, is_training):
+    """Run the C++ decider. Returns (group_ids [n], expert_counts [n]) or
+    None when the native library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    n = alpha.shape[0]
+    alpha = np.ascontiguousarray(alpha, np.float64)
+    beta = np.ascontiguousarray(beta, np.float64)
+    thr = np.ascontiguousarray(throughput, np.float64)
+    mem = np.ascontiguousarray(memory_gb, np.float64)
+    gid = np.zeros((n,), np.int32)
+    cnt = np.zeros((n,), np.int32)
+    rc = lib.flashmoe_decide(
+        n, alpha, beta, thr, mem, int(num_experts), float(expert_mb),
+        float(act_mb), float(grad_mb), float(gamma), int(bool(is_training)),
+        gid, cnt,
+    )
+    if rc != 0:
+        return None
+    return gid, cnt

@@ -127,10 +127,39 @@ def _intra_comm_ms(members, adj: Adjacency, mbytes: float) -> float:
     return worst
 
 
+def _placement_from_native(group_ids, counts, n: int, e: int) -> Placement:
+    """Build a Placement from the C++ decider's (group_id, counts) arrays:
+    expert ids are assigned contiguously per group in device order, matching
+    the Python implementation."""
+    import collections
+
+    by_group = collections.defaultdict(list)
+    for d in range(n):
+        by_group[int(group_ids[d])].append(d)
+    groups = [sorted(by_group[g]) for g in sorted(by_group)]
+    expert_owner: dict[int, int] = {}
+    local_experts: dict[int, list[int]] = {d: [] for d in range(n)}
+    for gi, group in enumerate(groups):
+        eid = 0
+        for d in group:
+            for _ in range(int(counts[d])):
+                if gi == 0:
+                    expert_owner[eid] = d
+                local_experts[d].append(eid)
+                eid += 1
+    return Placement(groups, expert_owner, local_experts)
+
+
 def decide(adj: Adjacency, workers: list[WorkerAttr], cfg: MoEConfig,
-           expert_mb: float | None = None) -> Placement:
+           expert_mb: float | None = None,
+           native: str | bool = "auto") -> Placement:
     """Form DP x EP groups and assign experts (the reference's
-    ``Decider<JobType>::operator()`` + ``assign``)."""
+    ``Decider<JobType>::operator()`` + ``assign``).
+
+    ``native``: "auto" prefers the C++ implementation
+    (:mod:`flashmoe_tpu.parallel._native`) when it builds/loads, True
+    requires it, False forces pure Python.
+    """
     n = adj.n
     e = cfg.num_experts
     import jax.numpy as jnp
@@ -144,12 +173,27 @@ def decide(adj: Adjacency, workers: list[WorkerAttr], cfg: MoEConfig,
     grad_mb = cfg.param_count * bytes_per / 1e6 if cfg.is_training else 0.0
 
     rates = [w.throughput for w in workers]
+    gamma = max(1, cfg.num_layers // max(1, cfg.moe_frequency))
     args = CostArgs(
         total_expert_cost_ms=e / max(min(rates), 1e-9),
         comm_mbytes=act_mb,
         grad_buffer_mb=grad_mb,
-        gamma=max(1, cfg.num_layers // max(1, cfg.moe_frequency)),
+        gamma=gamma,
     )
+
+    if native != False:  # noqa: E712  ("auto" and True both try native)
+        from flashmoe_tpu.parallel import _native
+
+        res = _native.native_decide(
+            adj.alpha, adj.beta,
+            np.array(rates, np.float64),
+            np.array([w.memory_gb for w in workers], np.float64),
+            e, expert_mb, act_mb, grad_mb, gamma, cfg.is_training,
+        )
+        if res is not None:
+            return _placement_from_native(res[0], res[1], n, e)
+        if native is True:
+            raise RuntimeError("native decider unavailable (g++/build failed)")
 
     def can_hold_all(members) -> bool:
         cap = sum(workers[m].memory_gb for m in members) * 1024.0  # MB

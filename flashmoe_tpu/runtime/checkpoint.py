@@ -1,0 +1,76 @@
+"""Checkpoint / resume.
+
+The reference has none (SURVEY §5: weights are caller-provided tensors, no
+optimizer, nothing to save).  A training framework needs it, so this module
+provides orbax-backed save/restore of the :class:`TrainState` (params +
+optimizer moments + step), preserving shardings on restore — multi-host
+safe (orbax coordinates the write across processes).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from flashmoe_tpu.runtime.trainer import TrainState
+
+
+def _manager(directory: str, max_to_keep: int = 3) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        os.path.abspath(directory),
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True,
+        ),
+    )
+
+
+def save(directory: str, state: TrainState, step: int | None = None,
+         wait: bool = True) -> int:
+    """Save a checkpoint; returns the step it was saved under."""
+    step = int(state.step) if step is None else step
+    mgr = _manager(directory)
+    mgr.save(step, args=ocp.args.StandardSave(state._asdict()))
+    if wait:
+        mgr.wait_until_finished()
+    mgr.close()
+    return step
+
+
+def latest_step(directory: str) -> int | None:
+    if not os.path.isdir(directory):
+        return None
+    mgr = _manager(directory)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore(directory: str, template: TrainState,
+            step: int | None = None) -> TrainState:
+    """Restore into the template's structure/shardings.
+
+    ``template`` is a TrainState of the right pytree structure (e.g. from
+    ``init_state`` + ``device_put`` with shardings); restored arrays land
+    with the template's shardings.
+    """
+    mgr = _manager(directory)
+    step = step if step is not None else mgr.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint in {directory}")
+
+    def to_restore_args(leaf):
+        if hasattr(leaf, "sharding"):
+            return ocp.type_handlers.ArrayRestoreArgs(
+                sharding=leaf.sharding, dtype=leaf.dtype,
+            )
+        return ocp.RestoreArgs()
+
+    restored = mgr.restore(
+        step,
+        args=ocp.args.StandardRestore(template._asdict()),
+    )
+    mgr.close()
+    return TrainState(**restored)

@@ -1,0 +1,76 @@
+"""Per-device expert-FFN throughput probe.
+
+The reference measures each GPU's expert throughput at bootstrap with a
+synthetic workload: 64 warmup + 16 timed runs of the standalone ``expert``
+kernel, median latency -> ``WorkerAttribute.throughput`` in experts/ms
+(``csrc/include/flashmoe/throughput.cuh:51-170``), feeding the Decider's
+rate-proportional expert assignment.
+
+The TPU version times the same synthetic grouped FFN through the real
+kernel path.  Because remote-tunneled backends make single-dispatch timing
+meaningless (host round-trip >> kernel), iterations are chained inside one
+jit and differenced — see ``bench.py`` for the same technique.  Results are
+cached per (device-kind, config shape) since homogeneous slices need one
+probe, not one per chip.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.models.reference import init_moe_params
+from flashmoe_tpu.ops import expert as exp
+
+_cache: dict = {}
+
+
+def measure_expert_throughput(cfg: MoEConfig, *, experts: int | None = None,
+                              rows_per_expert: int = 256,
+                              chain: int = 8, trials: int = 3) -> float:
+    """Median throughput in experts/ms for this device kind."""
+    e = experts or min(cfg.num_experts, 8)
+    key = (jax.devices()[0].device_kind, e, rows_per_expert,
+           cfg.hidden_size, cfg.intermediate_size, str(cfg.dtype))
+    if key in _cache:
+        return _cache[key]
+
+    pcfg = cfg.replace(num_experts=e, num_shared_experts=0)
+    params = init_moe_params(jax.random.PRNGKey(0), pcfg)
+    params = jax.tree_util.tree_map(lambda p: p.astype(cfg.dtype), params)
+    xs = jax.random.normal(
+        jax.random.PRNGKey(1), (e, rows_per_expert, cfg.hidden_size),
+        cfg.dtype,
+    )
+
+    def chained(n):
+        def run(p, xs):
+            def body(xs, _):
+                if jax.default_backend() == "tpu":
+                    y = exp.capacity_buffer_ffn_pallas(xs, p, pcfg)
+                else:
+                    y = exp.expert_ffn_dense(xs, p, pcfg)
+                return y.astype(xs.dtype), None
+            xs, _ = jax.lax.scan(body, xs, None, length=n)
+            return xs.astype(jnp.float32).sum()
+        return jax.jit(run)
+
+    def med(f):
+        float(f(params, xs))  # compile+warm
+        ts = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            float(f(params, xs))
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return ts[len(ts) // 2]
+
+    t1, tn = med(chained(1)), med(chained(chain))
+    per_iter = max((tn - t1) / (chain - 1), 1e-9)
+    throughput = e / (per_iter * 1e3)  # experts per ms
+    _cache[key] = throughput
+    return throughput

@@ -1,0 +1,93 @@
+"""Tracing / profiling / metrics.
+
+The reference instruments with NVTX scoped ranges in a "Flashmoe" domain
+around every host phase (``csrc/include/flashmoe/telemetry.cuh:16-21``,
+used throughout ``bootstrap.cuh``/``moe.cuh``), inline ``%globaltimer``
+reads inside kernels, and cudaEvent kernel timing.  TPU equivalents:
+
+  * :func:`trace_span` — ``jax.profiler.TraceAnnotation`` +
+    ``jax.named_scope``: shows up both in host traces and as HLO op-name
+    prefixes in xprof;
+  * :func:`start_trace` / :func:`stop_trace` — whole-program profiler
+    capture for tensorboard/xprof (the SM-utilization analogue: MXU
+    utilization comes from the captured trace);
+  * :class:`Metrics` — lightweight host-side counters/timers with JSONL
+    export (the reference's per-rank ``fmt::println`` timings, structured).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from collections import defaultdict
+
+import jax
+
+
+@contextlib.contextmanager
+def trace_span(name: str):
+    """Named scope visible in xprof traces and HLO metadata."""
+    with jax.profiler.TraceAnnotation(name):
+        with jax.named_scope(name):
+            yield
+
+
+def start_trace(log_dir: str):
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace():
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def capture_trace(log_dir: str):
+    start_trace(log_dir)
+    try:
+        yield
+    finally:
+        stop_trace()
+
+
+class Metrics:
+    """Host-side metrics registry: counters, gauges, and wall timers."""
+
+    def __init__(self):
+        self.counters: dict[str, float] = defaultdict(float)
+        self.gauges: dict[str, float] = {}
+        self.times: dict[str, list[float]] = defaultdict(list)
+
+    def count(self, name: str, inc: float = 1.0):
+        self.counters[name] += inc
+
+    def gauge(self, name: str, value: float):
+        self.gauges[name] = float(value)
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.times[name].append(time.perf_counter() - t0)
+
+    def summary(self) -> dict:
+        out: dict[str, float] = dict(self.counters)
+        out.update(self.gauges)
+        for k, v in self.times.items():
+            if v:
+                s = sorted(v)
+                out[f"{k}_ms_p50"] = s[len(s) // 2] * 1e3
+                out[f"{k}_ms_sum"] = sum(v) * 1e3
+                out[f"{k}_calls"] = len(v)
+        return out
+
+    def dump_jsonl(self, path: str, **extra):
+        rec = dict(self.summary(), **extra)
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        return rec
+
+
+metrics = Metrics()

@@ -1,0 +1,67 @@
+"""Native (C++) decider: builds, loads, and agrees with the Python one."""
+
+import numpy as np
+import pytest
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.parallel import _native
+from flashmoe_tpu.parallel.decider import decide
+from flashmoe_tpu.parallel.topology import Adjacency, WorkerAttr
+
+
+def _island_adj(n=8, cut=4, slow_alpha=0.5, slow_beta=0.05):
+    alpha = np.full((n, n), 0.01)
+    beta = np.full((n, n), 0.001)
+    for i in range(n):
+        for j in range(n):
+            if (i < cut) != (j < cut):
+                alpha[i, j] = slow_alpha
+                beta[i, j] = slow_beta
+        alpha[i, i] = beta[i, i] = 0
+    return Adjacency(alpha, beta)
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    lib = _native.load()
+    if lib is None:
+        pytest.skip("g++ unavailable; native decider not built")
+    return lib
+
+
+def test_builds_and_loads(native_lib):
+    assert native_lib.flashmoe_native_abi_version() == 1
+
+
+@pytest.mark.parametrize("scenario", ["uniform", "islands", "hetero"])
+def test_native_matches_python(native_lib, scenario):
+    n = 8
+    cfg = MoEConfig(num_experts=16, expert_top_k=2, hidden_size=1024,
+                    intermediate_size=1024, sequence_len=8192,
+                    mini_batch=4 if scenario == "islands" else 1)
+    if scenario == "islands":
+        adj = _island_adj(slow_alpha=1000.0, slow_beta=100.0)
+        cfg = cfg.replace(hidden_size=4096)
+    else:
+        adj = _island_adj()
+    if scenario == "hetero":
+        workers = [WorkerAttr(throughput=3.0 if d < 2 else 1.0,
+                              memory_gb=16.0) for d in range(n)]
+    else:
+        workers = [WorkerAttr(throughput=1.0, memory_gb=16.0)
+                   for _ in range(n)]
+
+    py = decide(adj, workers, cfg, native=False)
+    cc = decide(adj, workers, cfg, native=True)
+    assert py.groups == cc.groups, (py.groups, cc.groups)
+    assert py.local_experts == cc.local_experts
+
+
+def test_native_memory_forcing(native_lib):
+    cfg = MoEConfig(num_experts=64, expert_top_k=2, hidden_size=4096,
+                    intermediate_size=4096)
+    workers = [WorkerAttr(throughput=1.0, memory_gb=2.0) for _ in range(8)]
+    adj = _island_adj(slow_alpha=1000.0, slow_beta=100.0)
+    py = decide(adj, workers, cfg, native=False)
+    cc = decide(adj, workers, cfg, native=True)
+    assert py.groups == cc.groups

@@ -1,0 +1,43 @@
+"""Telemetry metrics + throughput probe."""
+
+import json
+
+import jax.numpy as jnp
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.runtime.throughput import measure_expert_throughput
+from flashmoe_tpu.utils.telemetry import Metrics, trace_span
+
+
+def test_metrics_registry(tmp_path):
+    m = Metrics()
+    m.count("steps")
+    m.count("steps")
+    m.gauge("lr", 3e-4)
+    with m.timer("fwd"):
+        pass
+    s = m.summary()
+    assert s["steps"] == 2
+    assert s["lr"] == 3e-4
+    assert "fwd_ms_p50" in s and s["fwd_calls"] == 1
+    rec = m.dump_jsonl(str(tmp_path / "m.jsonl"), rank=0)
+    assert rec["rank"] == 0
+    line = json.loads((tmp_path / "m.jsonl").read_text().strip())
+    assert line["steps"] == 2
+
+
+def test_trace_span_noop():
+    with trace_span("unit-test"):
+        x = jnp.ones((4, 4)).sum()
+    assert float(x) == 16.0
+
+
+def test_throughput_probe_cached():
+    cfg = MoEConfig(num_experts=4, hidden_size=128, intermediate_size=256,
+                    dtype=jnp.float32, param_dtype=jnp.float32)
+    t1 = measure_expert_throughput(cfg, experts=2, rows_per_expert=32,
+                                   chain=2, trials=1)
+    assert t1 > 0
+    t2 = measure_expert_throughput(cfg, experts=2, rows_per_expert=32,
+                                   chain=2, trials=1)
+    assert t1 == t2  # cache hit
