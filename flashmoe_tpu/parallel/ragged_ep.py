@@ -1,0 +1,279 @@
+"""Distributed dropless MoE: ragged all-to-all expert parallelism.
+
+The capacity-based EP layer (:mod:`flashmoe_tpu.parallel.ep`) pads every
+(rank, expert) slab to a fixed capacity — simple, static, but with
+``drop_tokens=False`` it ships ``E x S_loc`` rows per rank regardless of
+routing.  The reference ships exactly ``routedTokens`` per packet (the
+dynamic size rides in the signal payload, ``types.cuh:299-334``) and its
+receivers decode variable-size packets.  This module is that capability on
+TPU: variable-size expert transfers under static *bounds* instead of static
+*shapes*.
+
+Per rank: assignments sort by global expert id (destination-major), so each
+destination's rows are contiguous; counts exchange over the ``ep`` axis
+establishes every pairwise transfer size; ``jax.lax.ragged_all_to_all``
+moves exactly the routed rows (TPU path — XLA:CPU lacks the op, so tests
+exercise the same layout logic through a dense-padded ``all_to_all``
+fallback); arithmetic (no sort) regroups the received source-major rows
+into tile-padded expert-major segments for the grouped Pallas FFN; the
+whole dance then runs in reverse.
+
+All shapes are static upper bounds; ``recv_bound`` defaults to the true
+worst case (every token in the ep group routed to one rank).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from flashmoe_tpu.config import BLOCK_M, MoEConfig
+from flashmoe_tpu.ops import expert as exp
+from flashmoe_tpu.ops import ragged as rag
+from flashmoe_tpu.ops.gate import router
+from flashmoe_tpu.ops.moe import MoEOutput
+
+
+def _searchsorted_rows(boundaries, values):
+    """boundaries: [K] ascending; values: [M]. Returns for each value the
+    count of boundaries <= value (vectorized 'which segment am I in')."""
+    return jnp.sum(
+        values[:, None] >= boundaries[None, :], axis=1
+    ).astype(jnp.int32)
+
+
+def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
+                     use_pallas: bool, interpret: bool, exchange: str,
+                     block_m: int, reduce_axes):
+    d = jax.lax.axis_size(axis)
+    s_loc, h = x.shape
+    e = cfg.num_experts
+    nlx = e // d
+    n_assign = s_loc * cfg.expert_top_k
+    recv_bound = d * n_assign  # worst case: everyone routes to me
+
+    r = router(x, params["gate_w"], cfg, use_pallas=use_pallas,
+               interpret=interpret)
+
+    # ---- local expert-sorted layout (contiguous, unpadded: block "1") ----
+    plan = rag.make_ragged_plan(r.expert_idx, cfg, 1)
+    xs = rag.ragged_dispatch(x.astype(cfg.dtype), plan, cfg, 1)  # [nA+, H]
+    xs = xs[:n_assign]  # block_m=1 upper bound equals exact total
+    counts = plan.counts  # [E] rows per global expert
+    cmat = counts.reshape(d, nlx)  # [dest, local expert]
+    send_sizes = jnp.sum(cmat, axis=1).astype(jnp.int32)  # [D]
+    input_offsets = (jnp.cumsum(send_sizes) - send_sizes).astype(jnp.int32)
+
+    # ---- exchange sizes ----
+    # all ranks' send matrices: S[s, d] = rows s sends to d
+    all_send = jax.lax.all_gather(send_sizes, axis)  # [D, D]
+    my = jax.lax.axis_index(axis)
+    recv_sizes = all_send[:, my].astype(jnp.int32)  # [D] rows from each src
+    recv_offsets = (jnp.cumsum(recv_sizes) - recv_sizes).astype(jnp.int32)
+    # where my block starts on each destination = sum of earlier sources
+    out_offsets = (
+        jnp.cumsum(all_send, axis=0) - all_send
+    )[my].astype(jnp.int32)  # [D]
+    # per-(src, my local expert) counts, for regrouping
+    recv_cmat = jax.lax.all_to_all(
+        cmat.reshape(d, 1, nlx), axis, split_axis=0, concat_axis=0,
+        tiled=False,
+    ).reshape(d, nlx)
+
+    # ---- forward data exchange: src-major ragged layout ----
+    if exchange == "ragged":
+        x_recv = jax.lax.ragged_all_to_all(
+            xs, jnp.zeros((recv_bound, h), xs.dtype),
+            input_offsets, send_sizes, out_offsets, recv_sizes,
+            axis_name=axis,
+        )
+    else:
+        # dense fallback: pad each src->dst block to n_assign rows
+        blocks = jnp.zeros((d, n_assign, h), xs.dtype)
+
+        def fill(dst, blocks):
+            rows = jax.lax.dynamic_slice(
+                jnp.pad(xs, ((0, n_assign), (0, 0))),
+                (input_offsets[dst], 0), (n_assign, h),
+            )
+            mask = (jnp.arange(n_assign) < send_sizes[dst])[:, None]
+            return blocks.at[dst].set(jnp.where(mask, rows, 0))
+
+        blocks = jax.lax.fori_loop(0, d, fill, blocks)
+        got = jax.lax.all_to_all(
+            blocks.reshape(d, 1, n_assign, h), axis, split_axis=0,
+            concat_axis=0, tiled=False,
+        ).reshape(d, n_assign, h)
+        # compact the padded blocks into the ragged src-major layout
+        x_recv = jnp.zeros((recv_bound, h), xs.dtype)
+
+        def compact(src, buf):
+            rows = got[src]
+            idx = jnp.where(
+                jnp.arange(n_assign) < recv_sizes[src],
+                recv_offsets[src] + jnp.arange(n_assign),
+                recv_bound,  # dropped
+            )
+            return buf.at[idx].set(rows, mode="drop")
+
+        x_recv = jax.lax.fori_loop(0, d, compact, x_recv)
+
+    # ---- regroup src-major -> tile-padded expert-major (arithmetic) ----
+    # per-expert totals and padded segment starts
+    etot = jnp.sum(recv_cmat, axis=0)  # [nlx]
+    epad = ((etot + block_m - 1) // block_m) * block_m
+    eseg = (jnp.cumsum(epad) - epad).astype(jnp.int32)  # [nlx]
+    pre = (jnp.cumsum(recv_cmat, axis=0) - recv_cmat)  # [D, nlx] rows before src s
+    intra = (jnp.cumsum(recv_cmat, axis=1) - recv_cmat)  # [D, nlx] within-src starts
+
+    rows = jnp.arange(recv_bound, dtype=jnp.int32)
+    src_of = _searchsorted_rows(
+        (recv_offsets + recv_sizes).astype(jnp.int32), rows
+    )  # count of block-ends <= row  == src index
+    src_of = jnp.clip(src_of, 0, d - 1)
+    w = rows - recv_offsets[src_of]  # offset within the src block
+    cum_intra = jnp.cumsum(recv_cmat, axis=1)  # [D, nlx] ends
+    e_of = jnp.sum(
+        w[:, None] >= cum_intra[src_of], axis=1
+    ).astype(jnp.int32)
+    e_of = jnp.clip(e_of, 0, nlx - 1)
+    i_of = w - intra[src_of, e_of]
+    total_recv = jnp.sum(recv_sizes)
+    target = jnp.where(
+        rows < total_recv,
+        eseg[e_of] + pre[src_of, e_of] + i_of,
+        recv_bound,  # out of range -> dropped
+    )
+
+    grouped_rows = recv_bound + ((nlx * block_m + block_m - 1) //
+                                 block_m) * block_m
+    x_grp = jnp.zeros((grouped_rows, h), xs.dtype)
+    x_grp = x_grp.at[target].set(x_recv, mode="drop")
+
+    # tile group ids from padded segment ends
+    n_tiles = grouped_rows // block_m
+    tile_starts = jnp.arange(n_tiles, dtype=jnp.int32) * block_m
+    seg_ends = eseg + epad
+    tile_gid = jnp.clip(
+        jnp.sum(tile_starts[:, None] >= seg_ends[None, :], axis=1),
+        0, nlx - 1,
+    ).astype(jnp.int32)
+
+    # ---- expert FFN on the local shard of weights ----
+    if use_pallas:
+        y_grp = exp.grouped_ffn(
+            x_grp, tile_gid,
+            params["w_up"].astype(cfg.dtype), params["b_up"],
+            params["w_down"].astype(cfg.dtype), params["b_down"],
+            params.get("w_gate", None) if cfg.gated_ffn else None,
+            act_name=cfg.hidden_act, gated=cfg.gated_ffn,
+            block_m=block_m, interpret=interpret,
+        )
+    else:
+        # XLA fallback: per-row weight selection via one-hot (test path)
+        sel = jax.nn.one_hot(
+            jnp.repeat(tile_gid, block_m), nlx, dtype=x_grp.dtype
+        )  # [rows, nlx]
+        up_w = jnp.einsum("rn,nhi->rhi", sel, params["w_up"].astype(x_grp.dtype))
+        up = jnp.einsum("rh,rhi->ri", x_grp, up_w) + sel @ params["b_up"].astype(x_grp.dtype)
+        from flashmoe_tpu.models.reference import activation_fn
+        act = activation_fn(cfg.hidden_act)
+        if cfg.gated_ffn:
+            g_w = jnp.einsum("rn,nhi->rhi", sel,
+                             params["w_gate"].astype(x_grp.dtype))
+            hid = act(jnp.einsum("rh,rhi->ri", x_grp, g_w)) * up
+        else:
+            hid = act(up)
+        dn_w = jnp.einsum("rn,nih->rih", sel,
+                          params["w_down"].astype(x_grp.dtype))
+        y_grp = (jnp.einsum("ri,rih->rh", hid, dn_w)
+                 + sel @ params["b_down"].astype(x_grp.dtype))
+
+    # ---- return path: expert-major -> src-major -> ragged back ----
+    y_src_major = y_grp[target.clip(0, grouped_rows - 1)]
+    y_src_major = jnp.where(
+        (rows < total_recv)[:, None], y_src_major, 0
+    ).astype(xs.dtype)
+
+    if exchange == "ragged":
+        # returned rows must land where the source originally staged them:
+        # on rank s that's s's input_offsets[my] = exclusive row-cumsum of
+        # its send sizes — derivable from the gathered send matrix
+        rev_out_offsets = (
+            jnp.cumsum(all_send, axis=1) - all_send
+        )[:, my].astype(jnp.int32)
+        ys = jax.lax.ragged_all_to_all(
+            y_src_major, jnp.zeros((n_assign, h), xs.dtype),
+            recv_offsets, recv_sizes, rev_out_offsets, send_sizes,
+            axis_name=axis,
+        )
+    else:
+        blocks = jnp.zeros((d, n_assign, h), xs.dtype)
+
+        def fill_y(src, blocks):
+            rws = jax.lax.dynamic_slice(
+                jnp.pad(y_src_major, ((0, n_assign), (0, 0))),
+                (recv_offsets[src], 0), (n_assign, h),
+            )
+            mask = (jnp.arange(n_assign) < recv_sizes[src])[:, None]
+            return blocks.at[src].set(jnp.where(mask, rws, 0))
+
+        blocks = jax.lax.fori_loop(0, d, fill_y, blocks)
+        got_y = jax.lax.all_to_all(
+            blocks.reshape(d, 1, n_assign, h), axis, split_axis=0,
+            concat_axis=0, tiled=False,
+        ).reshape(d, n_assign, h)
+        ys = jnp.zeros((n_assign, h), xs.dtype)
+
+        def compact_y(dst, buf):
+            rws = got_y[dst]
+            idx = jnp.where(
+                jnp.arange(n_assign) < send_sizes[dst],
+                input_offsets[dst] + jnp.arange(n_assign),
+                n_assign,
+            )
+            return buf.at[idx].set(rws, mode="drop")
+
+        ys = jax.lax.fori_loop(0, d, compact_y, ys)
+
+    # ---- combine in the original expert-sorted layout ----
+    out = rag.ragged_combine(ys, plan, r.combine_weights, cfg)
+
+    aux = jax.lax.pmean(r.aux_loss, reduce_axes) * cfg.aux_loss_coef
+    z = jax.lax.pmean(r.z_loss, reduce_axes)
+    cnts = jax.lax.psum(r.expert_counts, reduce_axes)
+    return MoEOutput(out.astype(cfg.dtype), aux, z, cnts)
+
+
+def ragged_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
+                        use_pallas: bool = False, interpret: bool = False,
+                        exchange: str | None = None,
+                        block_m: int = BLOCK_M,
+                        token_axes: tuple[str, ...] = ("ep",)) -> MoEOutput:
+    """Dropless expert-parallel MoE over the ``ep`` axis.
+
+    ``exchange``: "ragged" (TPU ``ragged_all_to_all``) or "dense" (padded
+    ``all_to_all`` fallback — same layout logic, used on backends without
+    the ragged op).  Default picks by backend.
+    """
+    if cfg.num_shared_experts:
+        raise NotImplementedError("shared experts stay outside this layer")
+    if exchange is None:
+        exchange = "ragged" if jax.default_backend() == "tpu" else "dense"
+
+    body = functools.partial(
+        _ragged_ep_shard, cfg=cfg, axis="ep", use_pallas=use_pallas,
+        interpret=interpret, exchange=exchange, block_m=block_m,
+        reduce_axes=token_axes,
+    )
+    pspecs = {k: P("ep") if k != "gate_w" else P() for k in params}
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pspecs, P(token_axes, None)),
+        out_specs=MoEOutput(P(token_axes, None), P(), P(), P()),
+        check_vma=False,
+    )
+    return fn(params, x)
