@@ -37,14 +37,6 @@ from flashmoe_tpu.ops.gate import router
 from flashmoe_tpu.ops.moe import MoEOutput
 
 
-def _searchsorted_rows(boundaries, values):
-    """boundaries: [K] ascending; values: [M]. Returns for each value the
-    count of boundaries <= value (vectorized 'which segment am I in')."""
-    return jnp.sum(
-        values[:, None] >= boundaries[None, :], axis=1
-    ).astype(jnp.int32)
-
-
 def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
                      use_pallas: bool, interpret: bool, exchange: str,
                      block_m: int, reduce_axes):
@@ -130,10 +122,13 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
     intra = (jnp.cumsum(recv_cmat, axis=1) - recv_cmat)  # [D, nlx] within-src starts
 
     rows = jnp.arange(recv_bound, dtype=jnp.int32)
-    src_of = _searchsorted_rows(
-        (recv_offsets + recv_sizes).astype(jnp.int32), rows
-    )  # count of block-ends <= row  == src index
-    src_of = jnp.clip(src_of, 0, d - 1)
+    src_of = jnp.clip(
+        jnp.searchsorted(
+            (recv_offsets + recv_sizes).astype(jnp.int32), rows,
+            side="right",
+        ).astype(jnp.int32),
+        0, d - 1,
+    )
     w = rows - recv_offsets[src_of]  # offset within the src block
     cum_intra = jnp.cumsum(recv_cmat, axis=1)  # [D, nlx] ends
     e_of = jnp.sum(
@@ -142,14 +137,20 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
     e_of = jnp.clip(e_of, 0, nlx - 1)
     i_of = w - intra[src_of, e_of]
     total_recv = jnp.sum(recv_sizes)
+
+    # grouped buffer: per-expert tile padding can push targets past
+    # recv_bound, so the buffer is recv_bound (tile-rounded) plus one tile
+    # per expert, and the dropped-row sentinel is grouped_rows itself —
+    # strictly out of range for the scatter's drop mode
+    grouped_rows = (
+        ((recv_bound + block_m - 1) // block_m) * block_m
+        + nlx * block_m
+    )
     target = jnp.where(
         rows < total_recv,
         eseg[e_of] + pre[src_of, e_of] + i_of,
-        recv_bound,  # out of range -> dropped
+        grouped_rows,  # out of range -> dropped
     )
-
-    grouped_rows = recv_bound + ((nlx * block_m + block_m - 1) //
-                                 block_m) * block_m
     x_grp = jnp.zeros((grouped_rows, h), xs.dtype)
     x_grp = x_grp.at[target].set(x_recv, mode="drop")
 

@@ -69,6 +69,42 @@ def test_gated_ffn(devices):
     )
 
 
+def test_sentinel_no_collision_with_padded_targets(devices):
+    """Regression: tile padding can push a real row's target to exactly
+    recv_bound; the dropped-row sentinel must be out of range, not
+    recv_bound, or the scatter zeroes a real token."""
+    cfg = MoEConfig(num_experts=4, expert_top_k=1, hidden_size=64,
+                    intermediate_size=128, sequence_len=128, ep=2, **F32)
+    params, x = _setup(cfg, seed=3)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:2])
+    # try several routings; with block_m=16 the padded segments force the
+    # collision case the review repro found
+    for seed in range(3):
+        xs = jax.random.normal(
+            jax.random.PRNGKey(100 + seed), (cfg.tokens, 64), jnp.float32
+        )
+        out = ragged_ep_moe_layer(params, xs, cfg, mesh, exchange="dense",
+                                  block_m=16)
+        want, _ = reference_moe(params, xs, cfg)
+        np.testing.assert_allclose(
+            np.asarray(out.out), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_token_count_not_multiple_of_block(devices):
+    """Regression: recv_bound not divisible by block_m must not crash."""
+    cfg = MoEConfig(num_experts=4, expert_top_k=1, hidden_size=64,
+                    intermediate_size=128, sequence_len=72, ep=2, **F32)
+    params, x = _setup(cfg)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:2])
+    out = ragged_ep_moe_layer(params, x, cfg, mesh, exchange="dense",
+                              block_m=16)
+    want, _ = reference_moe(params, x, cfg)
+    np.testing.assert_allclose(
+        np.asarray(out.out), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_pallas_grouped_ffn_path(devices):
     """The grouped Pallas kernel runs on the regrouped ragged buffer."""
     cfg = MoEConfig(num_experts=4, expert_top_k=2, hidden_size=128,
