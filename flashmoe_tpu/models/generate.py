@@ -1,0 +1,137 @@
+"""Autoregressive generation with a KV cache.
+
+Inference support for the flagship transformer (the reference is
+forward-only over random tensors; a complete framework serves models).
+Decode runs as a ``lax.scan`` over steps with a static-shape KV cache —
+one token per step through the same parameter tree as training, MoE layers
+included (top-k routing per decoded token).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.models.transformer import _rope, rms_norm
+from flashmoe_tpu.ops.attention import attention_xla
+from flashmoe_tpu.ops.moe import moe_layer
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [L, B, N_kv, T_max, D]
+    v: jax.Array
+
+
+def init_cache(cfg: MoEConfig, batch: int, max_len: int) -> KVCache:
+    nkv, dh = cfg.resolved_num_kv_heads, cfg.resolved_head_dim
+    shape = (cfg.num_layers, batch, nkv, max_len, dh)
+    return KVCache(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+def _decode_step(params, cfg: MoEConfig, x, cache: KVCache, pos):
+    """One token through all layers. x: [B, 1, H]; pos: [] current index."""
+    b = x.shape[0]
+    nh, nkv, dh = cfg.num_heads, cfg.resolved_num_kv_heads, cfg.resolved_head_dim
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h_in = rms_norm(x, layer["attn_norm"])
+        q = (h_in @ layer["wq"].astype(x.dtype)).reshape(b, 1, nh, dh)
+        k = (h_in @ layer["wk"].astype(x.dtype)).reshape(b, 1, nkv, dh)
+        v = (h_in @ layer["wv"].astype(x.dtype)).reshape(b, 1, nkv, dh)
+        positions = jnp.broadcast_to(pos[None, None], (b, 1))
+        q, k = _rope(q, k, positions, cfg.rope_theta)
+
+        ck = jax.lax.dynamic_update_slice(
+            cache.k[li], k.transpose(0, 2, 1, 3), (0, 0, pos, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cache.v[li], v.transpose(0, 2, 1, 3), (0, 0, pos, 0)
+        )
+        new_k.append(ck)
+        new_v.append(cv)
+
+        kk, vv = ck, cv
+        if nkv != nh:
+            rep = nh // nkv
+            kk = jnp.repeat(kk, rep, axis=1)
+            vv = jnp.repeat(vv, rep, axis=1)
+        qh = q.transpose(0, 2, 1, 3)  # [B, N, 1, D]
+        t_max = kk.shape[2]
+        logits = jnp.einsum(
+            "bntd,bnsd->bnts", qh, kk, preferred_element_type=jnp.float32
+        ) * (dh ** -0.5)
+        mask = (jnp.arange(t_max) <= pos)[None, None, None, :]
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        ctx = jnp.einsum(
+            "bnts,bnsd->bntd", probs, vv, preferred_element_type=jnp.float32
+        ).transpose(0, 2, 1, 3).reshape(b, 1, nh * dh).astype(x.dtype)
+        x = x + ctx @ layer["wo"].astype(x.dtype)
+
+        f_in = rms_norm(x, layer["ffn_norm"])
+        layer_cfg = cfg if li in cfg.moe_layer_indices else cfg.replace(
+            num_experts=1, expert_top_k=1, num_shared_experts=0
+        )
+        o = moe_layer(
+            layer["moe"], f_in.reshape(b, -1), layer_cfg, use_pallas=False
+        )
+        x = x + o.out.reshape(b, 1, -1).astype(x.dtype)
+
+    cache = KVCache(jnp.stack(new_k), jnp.stack(new_v))
+    h = rms_norm(x, params["final_norm"])
+    logits = jnp.dot(
+        h.astype(cfg.dtype), params["lm_head"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )[:, 0]  # [B, V]
+    return logits, cache
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature"),
+)
+def generate(params, prompt, cfg: MoEConfig, *, max_new_tokens: int = 32,
+             temperature: float = 0.0, key=None):
+    """Greedy (temperature=0) or sampled decoding.
+
+    prompt: [B, T0] int32.  Returns [B, T0 + max_new_tokens].
+    """
+    b, t0 = prompt.shape
+    max_len = t0 + max_new_tokens
+    cache = init_cache(cfg, b, max_len)
+    key = key if key is not None else jax.random.PRNGKey(0)
+
+    # prefill one token at a time (simple, correct; batched prefill is an
+    # optimization for later rounds)
+    def prefill(i, carry):
+        cache, _ = carry
+        x = params["embed"].astype(cfg.dtype)[prompt[:, i]][:, None, :]
+        logits, cache = _decode_step(params, cfg, x, cache, i)
+        return cache, logits
+
+    cache, logits = jax.lax.fori_loop(
+        0, t0, prefill, (cache, jnp.zeros((b, cfg.vocab_size), jnp.float32))
+    )
+
+    def sample(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            k, logits / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    def step(carry, i):
+        cache, logits, key = carry
+        key, sub = jax.random.split(key)
+        tok = sample(logits, sub)
+        x = params["embed"].astype(cfg.dtype)[tok][:, None, :]
+        logits, cache = _decode_step(params, cfg, x, cache, t0 + i)
+        return (cache, logits, key), tok
+
+    (_, logits, _), toks = jax.lax.scan(
+        step, (cache, logits, key), jnp.arange(max_new_tokens)
+    )
+    return jnp.concatenate([prompt, toks.T], axis=1)

@@ -53,7 +53,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 from flashmoe_tpu.config import MoEConfig
-from flashmoe_tpu.models.reference import activation_fn
+from flashmoe_tpu.models.reference import activation_fn, shared_expert_ffn
 from flashmoe_tpu.ops import dispatch as dsp
 from flashmoe_tpu.ops.gate import router
 from flashmoe_tpu.ops.moe import MoEOutput
@@ -66,14 +66,13 @@ def _fused_kernel(
     xs_vmem, wup_vmem, wdn_vmem, acc, yv, # VMEM scratch
     bup_vmem, bdn_vmem,
     copy_sems, send_x_sems, recv_x_sems, send_y_sems, recv_y_sems,
-    *, axis, act_name, cm, bi,
+    *, axis, act_name, cm, bi, gated,
 ):
     """One grid step = one source slab (ring order)."""
     s = pl.program_id(0)
     d_world = pl.num_programs(0)
     my = jax.lax.axis_index(axis)
     nlx, cap, h = x_send.shape[1], x_send.shape[2], x_send.shape[3]
-    i_dim = w_up.shape[2]
     act = activation_fn(act_name)
 
     # ---- phase 0/1 (first step only): barrier, then start every send ----
@@ -124,7 +123,7 @@ def _fused_kernel(
         ).wait()
 
     n_row_tiles = cap // cm
-    n_i_chunks = i_dim // bi
+    n_i_chunks = w_down.shape[1] // bi
 
     def expert_body(e, _):
         # stream this expert's biases once
@@ -146,9 +145,14 @@ def _fused_kernel(
             xd.wait()
             acc[:] = jnp.zeros_like(acc)
 
+            # gated mode: w_up holds [gate_chunk | up_chunk] interleaved on a
+            # doubled chunk axis (see fused_ep_moe_layer), so one DMA streams
+            # both halves of the SwiGLU
+            up_chunk = 2 * bi if gated else bi
+
             def chunk_body(j, _):
                 wu = pltpu.make_async_copy(
-                    w_up.at[e, :, pl.ds(j * bi, bi)], wup_vmem,
+                    w_up.at[e, :, pl.ds(j * up_chunk, up_chunk)], wup_vmem,
                     copy_sems.at[1],
                 )
                 wd = pltpu.make_async_copy(
@@ -157,12 +161,22 @@ def _fused_kernel(
                 )
                 wu.start(); wd.start()
                 wu.wait()
-                up = jnp.dot(
-                    xs_vmem[:], wup_vmem[:],
-                    preferred_element_type=jnp.float32,
-                )
-                up = up + bup_vmem[0, pl.ds(j * bi, bi)].astype(jnp.float32)
-                hidden = act(up).astype(xs_vmem.dtype)
+                if gated:
+                    g = jnp.dot(
+                        xs_vmem[:], wup_vmem[:, :bi],
+                        preferred_element_type=jnp.float32,
+                    )
+                    up = jnp.dot(
+                        xs_vmem[:], wup_vmem[:, bi:],
+                        preferred_element_type=jnp.float32,
+                    ) + bup_vmem[0, pl.ds(j * bi, bi)].astype(jnp.float32)
+                    hidden = (act(g) * up).astype(xs_vmem.dtype)
+                else:
+                    up = jnp.dot(
+                        xs_vmem[:], wup_vmem[:],
+                        preferred_element_type=jnp.float32,
+                    ) + bup_vmem[0, pl.ds(j * bi, bi)].astype(jnp.float32)
+                    hidden = act(up).astype(xs_vmem.dtype)
                 wd.wait()
                 acc[:] += jnp.dot(
                     hidden, wdn_vmem[:], preferred_element_type=jnp.float32
@@ -230,18 +244,29 @@ def _fused_kernel(
 
 
 def _fused_shard(x_send, w_up, b_up, w_down, b_down, *, cfg: MoEConfig,
-                 axis: str, interpret, collective_id: int):
+                 axis: str, interpret, collective_id: int,
+                 detect_races: bool = False, w_gate=None):
     d_world, nlx, cap, h = x_send.shape
-    i_dim = w_up.shape[2]
+    i_dim = w_down.shape[1]
+    gated = w_gate is not None
     cm = min(cap, 256)
     if cap % cm:
         raise ValueError(f"capacity {cap} not divisible by row tile {cm}")
     bi = min(512 if cm <= 128 else 256, i_dim)
     if i_dim % bi:
         raise ValueError(f"intermediate {i_dim} not divisible by {bi}")
+    if gated:
+        # interleave per-chunk: [nlx, H, nj*2*bi] as [gate_chunk | up_chunk]
+        nj = i_dim // bi
+        wg = w_gate.reshape(nlx, h, nj, bi)
+        wu = w_up.reshape(nlx, h, nj, bi)
+        w_up = jnp.concatenate([wg, wu], axis=-1).reshape(
+            nlx, h, nj * 2 * bi
+        )
 
     kernel = functools.partial(
         _fused_kernel, axis=axis, act_name=cfg.hidden_act, cm=cm, bi=bi,
+        gated=gated,
     )
     out_shapes = [
         jax.ShapeDtypeStruct((d_world, nlx, cap, h), x_send.dtype),  # x_recv
@@ -250,8 +275,11 @@ def _fused_shard(x_send, w_up, b_up, w_down, b_down, *, cfg: MoEConfig,
     ]
     interp = False
     if interpret:
+        # the interpreter's vector-clock race detector is the framework's
+        # lock-free-protocol sanitizer (the reference relies on manual
+        # fence discipline with no tooling — SURVEY §5)
         interp = pltpu.InterpretParams(
-            dma_execution_mode="eager", detect_races=False,
+            dma_execution_mode="eager", detect_races=detect_races,
         )
     _, y_recv, _ = pl.pallas_call(
         kernel,
@@ -271,7 +299,8 @@ def _fused_shard(x_send, w_up, b_up, w_down, b_down, *, cfg: MoEConfig,
         out_shape=out_shapes,
         scratch_shapes=[
             pltpu.VMEM((cm, h), x_send.dtype),        # xs
-            pltpu.VMEM((h, bi), x_send.dtype),        # w_up chunk
+            pltpu.VMEM((h, 2 * bi if gated else bi),
+                       x_send.dtype),                 # w_up (+gate) chunk
             pltpu.VMEM((bi, h), x_send.dtype),        # w_down chunk
             pltpu.VMEM((cm, h), jnp.float32),         # acc
             pltpu.VMEM((cm, h), x_send.dtype),        # y tile
@@ -295,16 +324,15 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
                        interpret: bool = False,
                        use_pallas_gate: bool | None = None,
                        token_axes: tuple[str, ...] = ("ep",),
-                       collective_id: int = 7) -> MoEOutput:
+                       collective_id: int = 7,
+                       detect_races: bool = False) -> MoEOutput:
     """Expert-parallel MoE with the fused in-kernel all-to-all.
 
-    Same contract as :func:`flashmoe_tpu.parallel.ep.ep_moe_layer`; gated
-    FFN and shared experts are not yet supported on this path.
+    Same contract as :func:`flashmoe_tpu.parallel.ep.ep_moe_layer`.  Gated
+    (SwiGLU) experts stream through the kernel with chunk-interleaved
+    gate|up weights; shared experts run XLA-side on the local token shard
+    (they are replicated dense compute, not communication).
     """
-    if cfg.gated_ffn or cfg.num_shared_experts:
-        raise NotImplementedError(
-            "fused path does not support gated/shared experts yet"
-        )
 
     def body(params, x):
         d = jax.lax.axis_size("ep")
@@ -328,17 +356,24 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
             params["w_up"].astype(cfg.dtype), params["b_up"],
             params["w_down"].astype(cfg.dtype), params["b_down"],
             cfg=cfg, axis="ep", interpret=interpret,
-            collective_id=collective_id,
+            collective_id=collective_id, detect_races=detect_races,
+            w_gate=(params["w_gate"].astype(cfg.dtype)
+                    if cfg.gated_ffn else None),
         )
         ybuf = y_recv.reshape(cfg.num_experts, cap, h)
         out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)
+        if cfg.num_shared_experts:
+            out = out + shared_expert_ffn(
+                x.astype(cfg.dtype), params, cfg
+            ).astype(out.dtype)
 
         aux = jax.lax.pmean(r.aux_loss, token_axes) * cfg.aux_loss_coef
         z = jax.lax.pmean(r.z_loss, token_axes)
         counts = jax.lax.psum(r.expert_counts, token_axes)
         return MoEOutput(out.astype(cfg.dtype), aux, z, counts)
 
-    pspecs = {k: P("ep") if k != "gate_w" else P() for k in params}
+    pspecs = {k: P("ep") if k != "gate_w" and not k.startswith("shared")
+              else P() for k in params}
     fn = jax.shard_map(
         body, mesh=mesh,
         in_specs=(pspecs, P(token_axes, None)),

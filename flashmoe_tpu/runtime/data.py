@@ -1,0 +1,148 @@
+"""Training input pipeline: binary token shards with background prefetch.
+
+Native path: the C++ loader (``csrc/dataloader.cpp``) decodes and shuffles
+[batch, seq_len+1] windows on a background thread.  Fallback: a NumPy
+implementation with identical window/shuffle semantics (same xorshift
+order), so both paths produce the same batches for the same seed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from flashmoe_tpu.parallel import _native
+
+
+def write_token_file(path: str, tokens: np.ndarray):
+    """Write a flat int32 little-endian token stream."""
+    np.asarray(tokens, dtype="<i4").tofile(path)
+
+
+def _xorshift_order(n: int, seed: int, epoch: int) -> np.ndarray:
+    """The C++ loader's epoch shuffle, replicated exactly."""
+    s = (seed + 0x51ED270B * (epoch + 1)) & 0xFFFFFFFFFFFFFFFF
+    if s == 0:
+        s = 0x9E3779B97F4A7C15
+
+    def nxt():
+        nonlocal s
+        s ^= (s << 13) & 0xFFFFFFFFFFFFFFFF
+        s ^= s >> 7
+        s ^= (s << 17) & 0xFFFFFFFFFFFFFFFF
+        return s
+
+    order = np.arange(n, dtype=np.int64)
+    for i in range(n - 1, 0, -1):
+        j = nxt() % (i + 1)
+        order[i], order[j] = order[j], order[i]
+    return order
+
+
+class TokenLoader:
+    """Iterator of {"tokens": [batch, seq_len+1] int32} batches."""
+
+    def __init__(self, path: str, batch: int, seq_len: int, *,
+                 seed: int = 0, shuffle: bool = True,
+                 native: str | bool = "auto"):
+        self.path, self.batch, self.seq_len = path, batch, seq_len
+        self.seed, self.shuffle = seed, shuffle
+        self._handle = None
+        self._lib = None
+        if native != False:  # noqa: E712
+            lib = _native.load()
+            if lib is not None:
+                self._bind(lib)
+                h = lib.flashmoe_loader_open(
+                    path.encode(), seq_len, batch, seed, int(shuffle)
+                )
+                if h:
+                    self._handle = h
+                    self._lib = lib
+                elif native is True:
+                    raise RuntimeError(f"native loader failed to open {path}")
+            elif native is True:
+                raise RuntimeError("native library unavailable")
+        if self._handle is None:
+            toks = np.fromfile(path, dtype="<i4")
+            w = seq_len + 1
+            n = len(toks) // w
+            if n < 1:
+                raise ValueError(f"{path}: fewer tokens than one window")
+            self._windows = toks[: n * w].reshape(n, w)
+            self._epoch = 0
+            self._cursor = 0
+            self._order = (
+                _xorshift_order(n, seed, 0) if shuffle
+                else np.arange(n, dtype=np.int64)
+            )
+
+    @staticmethod
+    def _bind(lib):
+        if getattr(lib, "_loader_bound", False):
+            return
+        lib.flashmoe_loader_open.restype = ctypes.c_void_p
+        lib.flashmoe_loader_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.flashmoe_loader_next.restype = ctypes.c_int
+        lib.flashmoe_loader_next.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.int32, flags="C"),
+        ]
+        lib.flashmoe_loader_num_windows.restype = ctypes.c_int64
+        lib.flashmoe_loader_num_windows.argtypes = [ctypes.c_void_p]
+        lib.flashmoe_loader_close.restype = None
+        lib.flashmoe_loader_close.argtypes = [ctypes.c_void_p]
+        lib._loader_bound = True
+
+    @property
+    def is_native(self) -> bool:
+        return self._handle is not None
+
+    @property
+    def num_windows(self) -> int:
+        if self._handle is not None:
+            return int(self._lib.flashmoe_loader_num_windows(self._handle))
+        return len(self._windows)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        w = self.seq_len + 1
+        if self._handle is not None:
+            out = np.empty((self.batch, w), np.int32)
+            rc = self._lib.flashmoe_loader_next(
+                self._handle, out.reshape(-1)
+            )
+            if rc != 0:
+                raise StopIteration
+            return {"tokens": jnp.asarray(out)}
+        rows = []
+        for _ in range(self.batch):
+            if self._cursor >= len(self._order):
+                self._epoch += 1
+                self._cursor = 0
+                if self.shuffle:
+                    self._order = _xorshift_order(
+                        len(self._windows), self.seed, self._epoch
+                    )
+            rows.append(self._windows[self._order[self._cursor]])
+            self._cursor += 1
+        return {"tokens": jnp.asarray(np.stack(rows))}
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.flashmoe_loader_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

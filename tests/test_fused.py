@@ -52,7 +52,32 @@ def test_fused_matches_ep_layer_with_drops(devices):
     )
 
 
-def test_fused_rejects_unsupported():
-    cfg = MoEConfig(num_experts=4, gated_ffn=True, ep=2, **F32)
-    with pytest.raises(NotImplementedError):
-        fused_ep_moe_layer({}, jnp.zeros((8, 1024)), cfg, None)
+def test_fused_race_detector_clean(devices):
+    """The interpreter's vector-clock race detector over the fused kernel's
+    RDMA/semaphore protocol — the sanitizer the reference never had."""
+    cfg = MoEConfig(num_experts=4, expert_top_k=2, hidden_size=128,
+                    intermediate_size=256, sequence_len=256,
+                    drop_tokens=False, ep=2, **F32)
+    params, x = _setup(cfg)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:2])
+    out = fused_ep_moe_layer(params, x, cfg, mesh, interpret=True,
+                             detect_races=True)
+    want, _ = reference_moe(params, x, cfg)
+    np.testing.assert_allclose(
+        np.asarray(out.out), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_fused_gated_with_shared_experts(devices):
+    """SwiGLU experts stream through the kernel; shared experts add in."""
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                    intermediate_size=256, sequence_len=256,
+                    drop_tokens=False, ep=4, gated_ffn=True,
+                    hidden_act="silu", num_shared_experts=1, **F32)
+    params, x = _setup(cfg)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:4])
+    out = fused_ep_moe_layer(params, x, cfg, mesh, interpret=True)
+    want, _ = reference_moe(params, x, cfg)
+    np.testing.assert_allclose(
+        np.asarray(out.out), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
