@@ -49,11 +49,14 @@ def local_capacity(cfg: MoEConfig, s_local: int) -> int:
 
 
 def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
-                  reduce_axes: tuple[str, ...] = ("ep",)):
+                  reduce_axes: tuple[str, ...] = ("ep",),
+                  tp_axis: str | None = None):
     """Per-rank body (runs inside shard_map over the ep axis).
 
     x: [S_loc, H] local tokens; params: expert weights sharded on axis 0
-    (leading dim nLx), gate replicated.
+    (leading dim nLx), gate replicated.  With ``tp_axis``, each expert's
+    intermediate dimension is additionally Megatron-split across tp ranks
+    (column-parallel up/gate, row-parallel down, one psum per FFN).
     """
     d = jax.lax.axis_size(axis)
     s_loc, h = x.shape
@@ -71,10 +74,18 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
     )  # [D, nLx, C, H] — dim 0 now indexes source rank
     ybuf_in = recv.transpose(1, 0, 2, 3).reshape(nlx, d * cap, h)
 
+    ffn_params = params
+    if tp_axis is not None:
+        # row-parallel down bias: each tp rank contributes 1/tp of it so
+        # the psum reconstructs it exactly once
+        tp = jax.lax.axis_size(tp_axis)
+        ffn_params = dict(params, b_down=params["b_down"] / tp)
     if use_pallas:
-        yloc = exp.capacity_buffer_ffn_pallas(ybuf_in, params, cfg)
+        yloc = exp.capacity_buffer_ffn_pallas(ybuf_in, ffn_params, cfg)
     else:
-        yloc = exp.expert_ffn_dense(ybuf_in, params, cfg)
+        yloc = exp.expert_ffn_dense(ybuf_in, ffn_params, cfg)
+    if tp_axis is not None:
+        yloc = jax.lax.psum(yloc, tp_axis)
 
     # reverse: [nLx, D*C, H] -> [D, nLx, C, H] -> all_to_all -> [E, C, H]
     ysend = yloc.reshape(nlx, d, cap, h).transpose(1, 0, 2, 3)
@@ -97,13 +108,16 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
 
 def ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
                  use_pallas: bool = False,
-                 token_axes: tuple[str, ...] = ("ep",)) -> MoEOutput:
+                 token_axes: tuple[str, ...] = ("ep",),
+                 tp: bool | None = None) -> MoEOutput:
     """Expert-parallel MoE layer over a global token batch.
 
     x: [S, H] global tokens, sharded over ``token_axes`` (e.g.
     ``('dp', 'ep')`` inside a data-parallel model — the all-to-all then
     runs within each dp group).  Expert params shard over 'ep' and are
-    replicated across the other axes.
+    replicated across the other axes, except with ``tp`` (default: on when
+    the mesh's tp axis > 1), where each expert's intermediate dimension is
+    Megatron-split over 'tp' as well.
     """
     if cfg.num_experts == 1:
         return MoEOutput(
@@ -112,11 +126,27 @@ def ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
             jnp.full((1,), x.shape[0], jnp.int32),
         )
 
-    pspecs = {k: P("ep") if k != "gate_w" and not k.startswith("shared")
-              else P() for k in params}
+    use_tp = tp if tp is not None else (
+        "tp" in mesh.shape and mesh.shape["tp"] > 1
+    )
+    tp_specs = {
+        "w_up": P("ep", None, "tp"),
+        "w_gate": P("ep", None, "tp"),
+        "b_up": P("ep", "tp"),
+        "w_down": P("ep", "tp", None),
+        "b_down": P("ep", None),
+    }
+    pspecs = {}
+    for k in params:
+        if k == "gate_w" or k.startswith("shared"):
+            pspecs[k] = P()
+        elif use_tp and k in tp_specs:
+            pspecs[k] = tp_specs[k]
+        else:
+            pspecs[k] = P("ep")
     body = functools.partial(
         _ep_moe_shard, cfg=cfg, axis="ep", use_pallas=use_pallas,
-        reduce_axes=token_axes,
+        reduce_axes=token_axes, tp_axis="tp" if use_tp else None,
     )
     fn = jax.shard_map(
         body, mesh=mesh,

@@ -74,6 +74,22 @@ def test_ep_matches_single_device_with_drops(devices):
     )
 
 
+def test_ep_with_tensor_parallel_experts(devices):
+    """EP x TP: experts over ep, each expert's intermediate dim Megatron-
+    split over tp (one psum per FFN)."""
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=64,
+                    intermediate_size=128, sequence_len=256,
+                    drop_tokens=False, ep=2, tp=2, gated_ffn=True,
+                    hidden_act=Activation.SILU, **F32)
+    params, x = _setup(cfg)
+    mesh = make_mesh(cfg)  # dp=2, ep=2, tp=2 on 8 devices
+    out = ep_moe_layer(params, x, cfg, mesh, token_axes=("dp", "ep"))
+    want, _ = reference_moe(params, x, cfg)
+    np.testing.assert_allclose(
+        np.asarray(out.out), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_ep_grad(devices):
     """EP layer must be differentiable end-to-end (training path)."""
     cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=64,
