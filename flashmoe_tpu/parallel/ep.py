@@ -48,9 +48,43 @@ def local_capacity(cfg: MoEConfig, s_local: int) -> int:
     return cfg.capacity_for(s_local)
 
 
+def _hierarchical_a2a(t, axis: str, d: int, inner: int, *, reverse: bool):
+    """Two-stage all-to-all over a (outer x inner) factorization of the ep
+    axis — the multi-slice pattern: the inner stage rides ICI within a
+    slice, the outer stage sends one aggregated message per slice pair
+    over DCN instead of ``inner**2`` small ones (the ICI-vs-DCN duality of
+    the reference's P2P-vs-IBGDA transports, ``bootstrap.cuh:442-446``).
+
+    t: [D, ...] dest-major slabs (rank = outer * inner + inner_idx).
+    Returns [D, ...] source-major, identical to a flat all_to_all.
+    """
+    outer = d // inner
+    inner_groups = [
+        [o * inner + i for i in range(inner)] for o in range(outer)
+    ]
+    outer_groups = [
+        [o * inner + j for o in range(outer)] for j in range(inner)
+    ]
+    rest = t.shape[1:]
+    t = t.reshape((outer, inner) + rest)
+    stages = [
+        (1, inner_groups),  # within-slice exchange over the inner coord
+        (0, outer_groups),  # cross-slice exchange over the outer coord
+    ]
+    if reverse:
+        stages = stages[::-1]
+    for ax, groups in stages:
+        t = jax.lax.all_to_all(
+            t, axis, split_axis=ax, concat_axis=ax, tiled=False,
+            axis_index_groups=groups,
+        )
+    return t.reshape((d,) + rest)
+
+
 def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
                   reduce_axes: tuple[str, ...] = ("ep",),
-                  tp_axis: str | None = None):
+                  tp_axis: str | None = None,
+                  dcn_inner: int | None = None):
     """Per-rank body (runs inside shard_map over the ep axis).
 
     x: [S_loc, H] local tokens; params: expert weights sharded on axis 0
@@ -68,10 +102,15 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
     xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)  # [E, C, H]
 
     # exchange expert-major slabs: [E, C, H] -> [D, nLx, C, H] received
-    recv = jax.lax.all_to_all(
-        xbuf.reshape(d, nlx, cap, h), axis, split_axis=0, concat_axis=0,
-        tiled=False,
-    )  # [D, nLx, C, H] — dim 0 now indexes source rank
+    if dcn_inner is not None and 1 < dcn_inner < d:
+        recv = _hierarchical_a2a(
+            xbuf.reshape(d, nlx, cap, h), axis, d, dcn_inner, reverse=False,
+        )
+    else:
+        recv = jax.lax.all_to_all(
+            xbuf.reshape(d, nlx, cap, h), axis, split_axis=0, concat_axis=0,
+            tiled=False,
+        )  # [D, nLx, C, H] — dim 0 now indexes source rank
     ybuf_in = recv.transpose(1, 0, 2, 3).reshape(nlx, d * cap, h)
 
     ffn_params = params
@@ -89,9 +128,12 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
 
     # reverse: [nLx, D*C, H] -> [D, nLx, C, H] -> all_to_all -> [E, C, H]
     ysend = yloc.reshape(nlx, d, cap, h).transpose(1, 0, 2, 3)
-    yback = jax.lax.all_to_all(
-        ysend, axis, split_axis=0, concat_axis=0, tiled=False
-    )  # [D, nLx, C, H] — dim 0 indexes expert-owner rank
+    if dcn_inner is not None and 1 < dcn_inner < d:
+        yback = _hierarchical_a2a(ysend, axis, d, dcn_inner, reverse=True)
+    else:
+        yback = jax.lax.all_to_all(
+            ysend, axis, split_axis=0, concat_axis=0, tiled=False
+        )  # [D, nLx, C, H] — dim 0 indexes expert-owner rank
     ybuf = yback.reshape(e, cap, h)
 
     out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)
@@ -109,7 +151,8 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
 def ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
                  use_pallas: bool = False,
                  token_axes: tuple[str, ...] = ("ep",),
-                 tp: bool | None = None) -> MoEOutput:
+                 tp: bool | None = None,
+                 dcn_inner: int | None = None) -> MoEOutput:
     """Expert-parallel MoE layer over a global token batch.
 
     x: [S, H] global tokens, sharded over ``token_axes`` (e.g.
@@ -118,6 +161,10 @@ def ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
     replicated across the other axes, except with ``tp`` (default: on when
     the mesh's tp axis > 1), where each expert's intermediate dimension is
     Megatron-split over 'tp' as well.
+
+    ``dcn_inner``: ranks per slice when the ep axis spans slices — the
+    all-to-all then runs as a two-stage (intra-slice, inter-slice)
+    decomposition aggregating DCN traffic per slice pair.
     """
     if cfg.num_experts == 1:
         return MoEOutput(
@@ -147,6 +194,7 @@ def ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
     body = functools.partial(
         _ep_moe_shard, cfg=cfg, axis="ep", use_pallas=use_pallas,
         reduce_axes=token_axes, tp_axis="tp" if use_tp else None,
+        dcn_inner=dcn_inner,
     )
     fn = jax.shard_map(
         body, mesh=mesh,

@@ -90,6 +90,22 @@ def test_ep_with_tensor_parallel_experts(devices):
     )
 
 
+@pytest.mark.parametrize("inner", [2, 4])
+def test_hierarchical_dcn_a2a_matches_flat(inner, devices):
+    """Two-stage (intra-slice, inter-slice) all-to-all must be
+    bit-identical to the flat exchange."""
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=64,
+                    intermediate_size=128, sequence_len=256,
+                    drop_tokens=False, ep=8, **F32)
+    params, x = _setup(cfg)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:8])
+    flat = ep_moe_layer(params, x, cfg, mesh)
+    hier = ep_moe_layer(params, x, cfg, mesh, dcn_inner=inner)
+    np.testing.assert_array_equal(
+        np.asarray(flat.out), np.asarray(hier.out)
+    )
+
+
 def test_ep_grad(devices):
     """EP layer must be differentiable end-to-end (training path)."""
     cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=64,
