@@ -1,0 +1,142 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over the ``pp``
+mesh axis.
+
+The reference has no pipeline parallelism (SURVEY §2.6 — ``num_layers`` /
+``moe_frequency`` only feed its Decider's stage-count constant γ).  A
+complete framework needs the axis to be real, so this module implements the
+schedule the Decider's γ models: contiguous layer stages, M microbatches,
+a ``lax.scan`` over M + P - 1 ticks in which every stage processes one
+in-flight microbatch and hands its activation to the successor via
+``jax.lax.ppermute`` (ICI neighbour transfer; XLA overlaps it with the next
+tick's compute).  Stage 0 owns the embedding, the last stage owns the final
+norm + LM head and the loss.
+
+Composition: tokens shard over ``dp`` (each dp group runs its own
+pipeline); experts are replicated within a stage in this schedule (ep/tp
+composition with PP is a later-round optimization).  Stages must be
+structurally uniform (same layer pattern), which holds when every layer is
+MoE (``moe_frequency == 1``) or every layer dense.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.models import transformer as tfm
+from flashmoe_tpu.ops.moe import moe_layer
+
+
+def stack_stage_params(params, cfg: MoEConfig, pp: int):
+    """Re-shape init_params output into per-stage stacked pytrees.
+
+    Returns (stage_layers, io_params): ``stage_layers`` has every leaf
+    stacked as [pp, layers_per_stage, ...]; ``io_params`` carries embed /
+    final_norm / lm_head (replicated; stage roles select what they use).
+    """
+    if cfg.num_layers % pp:
+        raise ValueError(f"num_layers {cfg.num_layers} not divisible by pp={pp}")
+    lps = cfg.num_layers // pp
+    moe_set = set(cfg.moe_layer_indices)
+    uniform = all(i in moe_set for i in range(cfg.num_layers)) or not moe_set
+    if not uniform:
+        raise ValueError(
+            "pipeline stages need a uniform layer pattern "
+            "(moe_frequency=1 or num_experts=1)"
+        )
+    layers = params["layers"]
+    stage_layers = jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls).reshape((pp, lps) + ls[0].shape), *layers
+    )
+    io_params = {k: params[k] for k in ("embed", "final_norm", "lm_head")}
+    return stage_layers, io_params
+
+
+def _stage_apply(stage_layers, x, cfg: MoEConfig, lps: int):
+    """Run this rank's ``lps`` layers on x: [B, T, H]."""
+    aux = jnp.zeros((), cfg.accum_dtype)
+    for li in range(lps):
+        layer = jax.tree_util.tree_map(lambda a: a[li], stage_layers)
+        x, moe_loss = tfm.block(layer, x, cfg, 0 if cfg.num_experts == 1
+                                else cfg.moe_layer_indices[0])
+        aux = aux + moe_loss
+    return x, aux
+
+
+def pipeline_loss(params, batch, cfg: MoEConfig, mesh: Mesh, *,
+                  num_microbatches: int = 2):
+    """Pipelined loss over the pp axis. batch["tokens"]: [B, T+1] with
+    B % (dp * num_microbatches) == 0."""
+    pp = mesh.shape["pp"]
+    if pp <= 1:
+        raise ValueError("pipeline_loss needs a pp>1 mesh")
+    lps = cfg.num_layers // pp
+    stage_layers, io_params = stack_stage_params(params, cfg, pp)
+
+    def body(stage_layers, io_params, tokens):
+        # in_specs P("pp") leaves a leading singleton stage dim per rank
+        stage_layers = jax.tree_util.tree_map(lambda a: a[0], stage_layers)
+        s = jax.lax.axis_index("pp")
+        p = jax.lax.axis_size("pp")
+        m = num_microbatches
+        b, t1 = tokens.shape
+        bm = b // m
+        tlen = t1 - 1
+        inp = tokens[:, :-1].reshape(m, bm, tlen)
+        tgt = tokens[:, 1:].reshape(m, bm, tlen)
+
+        def tick(carry, t):
+            act_in, loss_sum, aux_sum, cnt = carry
+            mb = jnp.clip(t - s, 0, m - 1)
+            active = (t - s >= 0) & (t - s < m)
+            inject = io_params["embed"].astype(cfg.dtype)[inp[mb]]
+            x = jnp.where(s == 0, inject, act_in)
+            y, aux = _stage_apply(stage_layers, x, cfg, lps)
+            # last stage: loss on the completed microbatch
+            h = tfm.rms_norm(y, io_params["final_norm"])
+            logits = jnp.dot(
+                h.astype(cfg.dtype), io_params["lm_head"].astype(cfg.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(
+                logp, tgt[mb][..., None], axis=-1
+            )[..., 0]
+            is_last = s == p - 1
+            use = active & is_last
+            loss_sum = loss_sum + jnp.where(use, jnp.mean(nll), 0.0)
+            aux_sum = aux_sum + jnp.where(active, aux, 0.0)
+            cnt = cnt + jnp.where(use, 1.0, 0.0)
+            act_out = jax.lax.ppermute(
+                y, "pp", [(i, (i + 1) % p) for i in range(p)]
+            )
+            return (act_out, loss_sum, aux_sum, cnt), None
+
+        zero_act = jnp.zeros((bm, tlen, cfg.hidden_size), cfg.dtype)
+        (_, loss_sum, aux_sum, cnt), _ = jax.lax.scan(
+            tick, (zero_act, jnp.zeros((), jnp.float32),
+                   jnp.zeros((), cfg.accum_dtype),
+                   jnp.zeros((), jnp.float32)),
+            jnp.arange(m + p - 1),
+        )
+        # only the last stage accumulated CE; broadcast it everywhere
+        ce = jax.lax.psum(loss_sum, "pp") / jnp.maximum(
+            jax.lax.psum(cnt, "pp"), 1.0
+        )
+        aux = jax.lax.psum(aux_sum, "pp") / m
+        ce = jax.lax.pmean(ce, "dp")
+        aux = jax.lax.pmean(aux, "dp")
+        return ce + aux, ce, aux
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P("pp"), P(), P("dp", None)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    total, ce, aux = fn(stage_layers, io_params, batch["tokens"])
+    return total, {"ce": ce, "aux": aux}
