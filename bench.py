@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import signal
+import sys
 import time
 
 import jax
@@ -72,32 +74,68 @@ def bench_moe_layer(cfg: MoEConfig, trials: int, chain: int = 16):
     return out["fused"], out["xla"]
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--config", default="reference",
-                    choices=sorted(BENCH_CONFIGS.keys()))
-    ap.add_argument("--trials", type=int, default=7)
-    ap.add_argument("--chain", type=int, default=16)
-    args = ap.parse_args()
-
-    cfg = BENCH_CONFIGS[args.config]
-    if cfg.ep > 1 and len(jax.devices()) < cfg.ep:
-        cfg = cfg.replace(ep=1)
-
-    t_fused, t_xla = bench_moe_layer(cfg, args.trials, args.chain)
-    tokens_per_sec = cfg.tokens / t_fused
+def _emit(cfg, name, t_fused, t_xla):
     print(json.dumps({
-        "metric": f"moe_layer_fwd_ms[{args.config}:E={cfg.num_experts},"
+        "metric": f"moe_layer_fwd_ms[{name}:E={cfg.num_experts},"
                   f"k={cfg.expert_top_k},H={cfg.hidden_size},"
                   f"I={cfg.intermediate_size},S={cfg.tokens},"
                   f"{jnp.dtype(cfg.dtype).name}]",
         "value": round(t_fused * 1e3, 3),
         "unit": "ms",
         "vs_baseline": round(t_xla / t_fused, 3),
-        "tokens_per_sec_per_chip": round(tokens_per_sec),
+        "tokens_per_sec_per_chip": round(cfg.tokens / t_fused),
         "xla_path_ms": round(t_xla * 1e3, 3),
         "backend": jax.default_backend(),
-    }))
+    }), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="reference",
+                    choices=sorted(BENCH_CONFIGS.keys()))
+    ap.add_argument("--trials", type=int, default=7)
+    ap.add_argument("--chain", type=int, default=16)
+    ap.add_argument("--sweep", choices=["tokens", "experts"], default=None,
+                    help="emit one JSON line per point instead of the "
+                         "single headline number")
+    ap.add_argument("--deadline", type=int, default=480,
+                    help="wall-clock watchdog (s); emits an error record "
+                         "instead of hanging on a wedged backend")
+    args = ap.parse_args()
+
+    def on_deadline(signum, frame):
+        print(json.dumps({
+            "metric": f"moe_layer_fwd_ms[{args.config}]",
+            "value": -1, "unit": "ms", "vs_baseline": 0,
+            "error": f"deadline {args.deadline}s exceeded "
+                     f"(backend hung or compile stalled)",
+        }), flush=True)
+        sys.exit(2)
+
+    if args.deadline > 0:
+        signal.signal(signal.SIGALRM, on_deadline)
+        signal.alarm(args.deadline)
+
+    cfg = BENCH_CONFIGS[args.config]
+    if cfg.ep > 1 and len(jax.devices()) < cfg.ep:
+        cfg = cfg.replace(ep=1)
+
+    if args.sweep == "tokens":
+        for s in (1024, 2048, 4096, 8192, 16384):
+            c = cfg.replace(sequence_len=s)
+            tf, tx = bench_moe_layer(c, args.trials, args.chain)
+            _emit(c, f"{args.config}/S={s}", tf, tx)
+        return
+    if args.sweep == "experts":
+        for e in (8, 16, 32, 64, 128):
+            c = cfg.replace(num_experts=e,
+                            expert_top_k=min(cfg.expert_top_k, e))
+            tf, tx = bench_moe_layer(c, args.trials, args.chain)
+            _emit(c, f"{args.config}/E={e}", tf, tx)
+        return
+
+    t_fused, t_xla = bench_moe_layer(cfg, args.trials, args.chain)
+    _emit(cfg, args.config, t_fused, t_xla)
 
 
 if __name__ == "__main__":

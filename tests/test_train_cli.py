@@ -1,0 +1,46 @@
+"""End-to-end training CLI as a subprocess."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def _run(args, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    return subprocess.run(
+        [sys.executable, "-m", "flashmoe_tpu.runtime.train_cli", *args],
+        capture_output=True, text=True, env=env, timeout=timeout,
+        cwd=__import__("pathlib").Path(__file__).parent.parent,
+    )
+
+
+SMALL = ["--steps", "2", "--batch", "2",
+         "--set", "sequence_len=32", "--set", "hidden_size=64",
+         "--set", "intermediate_size=128", "--set", "vocab_size=256",
+         "--set", "num_heads=2", "--set", "num_layers=1",
+         "--set", "moe_frequency=1", "--set", "num_experts=4",
+         "--set", "dtype=float32", "--set", "param_dtype=float32"]
+
+
+def test_synthetic_training(devices):
+    out = _run(SMALL + ["--synthetic"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["steps"] == 2
+    assert rec["final_loss"] is not None
+
+
+def test_with_data_and_checkpointing(devices, tmp_path):
+    import numpy as np
+    from flashmoe_tpu.runtime.data import write_token_file
+    data = tmp_path / "toks.bin"
+    write_token_file(str(data), np.arange(33 * 8, dtype=np.int32) % 256)
+    ck = tmp_path / "ck"
+    out = _run(SMALL + ["--data", str(data), "--checkpoint-dir", str(ck),
+                        "--checkpoint-every", "1"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert (ck / "2").exists()  # checkpoint at final step
