@@ -11,7 +11,12 @@ __version__ = "0.1.0"
 
 from flashmoe_tpu.config import Activation, MoEConfig, BENCH_CONFIGS
 from flashmoe_tpu.ops.moe import moe_layer, MoEOutput
-from flashmoe_tpu.api import get_compiled_config, get_num_local_experts, run_moe
+from flashmoe_tpu.api import (
+    get_bookkeeping,
+    get_compiled_config,
+    get_num_local_experts,
+    run_moe,
+)
 
 __all__ = [
     "Activation",
@@ -20,6 +25,7 @@ __all__ = [
     "moe_layer",
     "MoEOutput",
     "run_moe",
+    "get_bookkeeping",
     "get_compiled_config",
     "get_num_local_experts",
 ]

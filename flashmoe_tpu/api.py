@@ -46,3 +46,22 @@ def get_compiled_config() -> dict:
 def get_num_local_experts() -> int:
     """Reference ``get_num_local_experts`` (``python_bindings.cu:187``)."""
     return bootstrap.get_runtime().num_local_experts
+
+
+def get_bookkeeping() -> dict:
+    """Runtime state summary — the spiritual analogue of the reference's
+    ``get_bookkeeping`` binding (``python_bindings.cu:180-184``, which
+    exposes bookkeeping-derived state) extended to the full runtime view:
+    mesh geometry, placement, process info.  Returns copies; mutating the
+    result never touches the live Runtime."""
+    rt = bootstrap.get_runtime()
+    return {
+        "mesh": dict(rt.mesh.shape),
+        "groups": [list(g) for g in rt.placement.groups],
+        "local_experts": {
+            int(k): list(v) for k, v in rt.placement.local_experts.items()
+        },
+        "num_processes": rt.num_processes,
+        "process_id": rt.process_id,
+        "num_local_experts": rt.num_local_experts,
+    }

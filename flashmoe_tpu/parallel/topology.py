@@ -63,6 +63,19 @@ class Adjacency:
     def transfer_ms(self, i: int, j: int, mbytes: float) -> float:
         return float(self.alpha[i, j] + self.beta[i, j] * mbytes)
 
+    def export(self, path: str, rank: int = 0):
+        """Dump the adjacency to text (the reference's ``exportTopo``
+        debug dump, ``bootstrap.cuh:69-96``, which writes
+        ``adjMatrix_Rank{r}.txt`` per rank)."""
+        with open(path, "w") as f:
+            f.write(f"# adjacency rank={rank} n={self.n}\n")
+            f.write("# alpha (ms)\n")
+            for row in self.alpha:
+                f.write(" ".join(f"{v:.6f}" for v in row) + "\n")
+            f.write("# beta (ms/MB)\n")
+            for row in self.beta:
+                f.write(" ".join(f"{v:.6f}" for v in row) + "\n")
+
 
 def _torus_hops(a, b, dims):
     """Minimal hop count between coords on a (possibly wrap-around) torus."""

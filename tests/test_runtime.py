@@ -51,6 +51,24 @@ def test_api_facade(devices):
     bootstrap.finalize()
 
 
+def test_bookkeeping_and_topo_export(devices, tmp_path):
+    import flashmoe_tpu as fm
+    from flashmoe_tpu.parallel.topology import ici_adjacency
+
+    bootstrap.initialize(MoEConfig(num_experts=8, hidden_size=128,
+                                   intermediate_size=256))
+    bk = fm.get_bookkeeping()
+    assert bk["mesh"]["ep"] == 8
+    assert sorted(e for v in bk["local_experts"].values() for e in v) == \
+        list(range(8))
+    adj = ici_adjacency()
+    p = tmp_path / "adj.txt"
+    adj.export(str(p))
+    text = p.read_text()
+    assert "alpha" in text and "beta" in text
+    bootstrap.finalize()
+
+
 def test_worker_cli(devices):
     """The worker runs end-to-end as a subprocess (reference worker.py)."""
     import os
