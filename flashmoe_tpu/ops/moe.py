@@ -18,8 +18,10 @@ all-to-all.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 from flashmoe_tpu.config import BLOCK_M, MoEConfig
@@ -55,27 +57,11 @@ def dense_ffn(params, x, cfg: MoEConfig):
     return down.astype(x.dtype)
 
 
-def moe_layer(params, x, cfg: MoEConfig, *, use_pallas: bool | None = None,
-              capacity: int | None = None,
-              interpret: bool = False) -> MoEOutput:
-    """One MoE layer over a token shard x: [S, H].
-
-    ``use_pallas`` selects the fused Pallas gate + grouped-FFN kernels;
-    ``None`` (default) auto-selects: Pallas on TPU (or when ``interpret``),
-    XLA elsewhere.  The XLA path is the oracle in tests.
-    """
-    import jax
-
-    if use_pallas is None:
-        use_pallas = interpret or jax.default_backend() == "tpu"
-    s, h = x.shape
-    zero = jnp.zeros((), cfg.accum_dtype)
-    if cfg.num_experts == 1:
-        out = dense_ffn(params, x, cfg)
-        return MoEOutput(out, zero, zero, jnp.full((1,), s, jnp.int32))
-
+def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
+                    capacity: int | None, interpret: bool) -> MoEOutput:
     r = router(x, params["gate_w"], cfg, use_pallas=use_pallas,
                interpret=interpret)
+    s, h = x.shape
     if use_pallas and not cfg.drop_tokens and capacity is None:
         # dropless: ragged expert-sorted grouping + block-sparse grouped FFN
         # (S*K + E*block rows instead of the capacity path's E*S)
@@ -113,3 +99,53 @@ def moe_layer(params, x, cfg: MoEConfig, *, use_pallas: bool | None = None,
         r.z_loss,
         r.expert_counts,
     )
+
+
+# Pallas kernels do not autodifferentiate, so the fused path is wrapped in a
+# custom VJP: forward runs the fused kernels, backward recomputes through
+# the (mathematically identical) XLA path and differentiates that — the
+# same rematerialization cost profile as checkpointed training, with fused
+# forward speed.  Fully fused backward kernels are a later-round item.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _moe_layer_fused_ad(params, x, cfg: MoEConfig, capacity, interpret):
+    return _moe_layer_impl(params, x, cfg, True, capacity, interpret)
+
+
+def _moe_layer_fused_fwd(params, x, cfg, capacity, interpret):
+    out = _moe_layer_impl(params, x, cfg, True, capacity, interpret)
+    return out, (params, x)
+
+
+def _moe_layer_fused_bwd(cfg, capacity, interpret, res, ct):
+    params, x = res
+    _, vjp_fn = jax.vjp(
+        lambda p, xx: _moe_layer_impl(p, xx, cfg, False, capacity, False),
+        params, x,
+    )
+    return vjp_fn(ct)
+
+
+_moe_layer_fused_ad.defvjp(_moe_layer_fused_fwd, _moe_layer_fused_bwd)
+
+
+def moe_layer(params, x, cfg: MoEConfig, *, use_pallas: bool | None = None,
+              capacity: int | None = None,
+              interpret: bool = False) -> MoEOutput:
+    """One MoE layer over a token shard x: [S, H].
+
+    ``use_pallas`` selects the fused Pallas gate + grouped-FFN kernels;
+    ``None`` (default) auto-selects: Pallas on TPU (or when ``interpret``),
+    XLA elsewhere.  The XLA path is the oracle in tests.  Both paths are
+    differentiable (the fused path via a custom VJP that recomputes the
+    backward through XLA).
+    """
+    if use_pallas is None:
+        use_pallas = interpret or jax.default_backend() == "tpu"
+    s, h = x.shape
+    zero = jnp.zeros((), cfg.accum_dtype)
+    if cfg.num_experts == 1:
+        out = dense_ffn(params, x, cfg)
+        return MoEOutput(out, zero, zero, jnp.full((1,), s, jnp.int32))
+    if use_pallas:
+        return _moe_layer_fused_ad(params, x, cfg, capacity, interpret)
+    return _moe_layer_impl(params, x, cfg, False, capacity, interpret)

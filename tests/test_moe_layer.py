@@ -92,6 +92,26 @@ def test_integrated_pallas_path_interpret():
     )
 
 
+def test_fused_path_grad_matches_xla_grad():
+    """The fused path's custom VJP (pallas fwd, XLA-recompute bwd) must
+    produce the same gradients as differentiating the XLA path."""
+    cfg = MoEConfig(num_experts=4, expert_top_k=2, hidden_size=64,
+                    intermediate_size=128, sequence_len=64, **NODROP)
+    params, x = _setup(cfg)
+
+    def loss(p, use_pallas, interpret):
+        o = moe_layer(p, x, cfg, use_pallas=use_pallas, interpret=interpret)
+        return jnp.sum(o.out ** 2) + o.aux_loss
+
+    gp = jax.grad(lambda p: loss(p, True, True))(params)
+    gx = jax.grad(lambda p: loss(p, False, False))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(gp),
+                    jax.tree_util.tree_leaves(gx)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4
+        )
+
+
 def test_jit_and_grad():
     """The layer must be jittable and differentiable (training path)."""
     cfg = MoEConfig(num_experts=4, expert_top_k=2, hidden_size=64,
