@@ -186,6 +186,31 @@ def grouped_ffn(x, tile_gid, w_up, b_up, w_down, b_down, w_gate=None, *,
     )(tile_gid, x, w_up_eff, b_up3, w_down, b_down3)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def capacity_buffer_ffn_ad(xs, params, cfg: MoEConfig,
+                           interpret: bool = False):
+    """Differentiable wrapper over the grouped kernel on [E, C, H]:
+    Pallas forward, backward recomputed through the batched XLA FFN
+    (pallas_call has no autodiff rule)."""
+    return capacity_buffer_ffn_pallas(xs, params, cfg, interpret=interpret)
+
+
+def _cap_ffn_fwd(xs, params, cfg, interpret):
+    return capacity_buffer_ffn_pallas(xs, params, cfg,
+                                      interpret=interpret), (xs, params)
+
+
+def _cap_ffn_bwd(cfg, interpret, res, ct):
+    xs, params = res
+    _, vjp_fn = jax.vjp(
+        lambda xx, p: expert_ffn_dense(xx, p, cfg), xs, params
+    )
+    return vjp_fn(ct)
+
+
+capacity_buffer_ffn_ad.defvjp(_cap_ffn_fwd, _cap_ffn_bwd)
+
+
 def capacity_buffer_ffn_pallas(xs, params, cfg: MoEConfig, *,
                                interpret: bool = False):
     """Run the grouped kernel on an [E, C, H] capacity buffer.

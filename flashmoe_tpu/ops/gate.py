@@ -194,10 +194,31 @@ def router_pallas(x, gate_w, cfg: MoEConfig, interpret: bool = False
     return _finish(cfg, top_p, top_i, probs_sum, counts, zsum, s)
 
 
+# The kernel has no autodiff rule; under AD the fused router runs its
+# forward and recomputes the backward through router_xla (identical math).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _router_pallas_ad(x, gate_w, cfg: MoEConfig, interpret: bool):
+    return router_pallas(x, gate_w, cfg, interpret=interpret)
+
+
+def _router_fwd(x, gate_w, cfg, interpret):
+    return router_pallas(x, gate_w, cfg, interpret=interpret), (x, gate_w)
+
+
+def _router_bwd(cfg, interpret, res, ct):
+    x, gate_w = res
+    _, vjp_fn = jax.vjp(lambda xx, w: router_xla(xx, w, cfg), x, gate_w)
+    return vjp_fn(ct)
+
+
+_router_pallas_ad.defvjp(_router_fwd, _router_bwd)
+
+
 def router(x, gate_w, cfg: MoEConfig, use_pallas: bool = True,
            interpret: bool = False) -> RouterOutput:
-    """Dispatch to the fused kernel on TPU, XLA fallback elsewhere."""
+    """Dispatch to the fused kernel on TPU, XLA fallback elsewhere.
+    Differentiable on both paths."""
     on_tpu = interpret or jax.default_backend() == "tpu"
     if use_pallas and x.shape[0] % 8 == 0 and on_tpu:
-        return router_pallas(x, gate_w, cfg, interpret=interpret)
+        return _router_pallas_ad(x, gate_w, cfg, interpret)
     return router_xla(x, gate_w, cfg)

@@ -84,7 +84,8 @@ def _hierarchical_a2a(t, axis: str, d: int, inner: int, *, reverse: bool):
 def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
                   reduce_axes: tuple[str, ...] = ("ep",),
                   tp_axis: str | None = None,
-                  dcn_inner: int | None = None):
+                  dcn_inner: int | None = None,
+                  interpret: bool = False):
     """Per-rank body (runs inside shard_map over the ep axis).
 
     x: [S_loc, H] local tokens; params: expert weights sharded on axis 0
@@ -97,7 +98,8 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
     e, nlx = cfg.num_experts, cfg.num_experts // d
     cap = local_capacity(cfg, s_loc)
 
-    r = router(x, params["gate_w"], cfg, use_pallas=use_pallas)
+    r = router(x, params["gate_w"], cfg, use_pallas=use_pallas,
+               interpret=interpret)
     plan = dsp.make_plan(r.expert_idx, cfg, cap)
     xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)  # [E, C, H]
 
@@ -120,7 +122,8 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
         tp = jax.lax.axis_size(tp_axis)
         ffn_params = dict(params, b_down=params["b_down"] / tp)
     if use_pallas:
-        yloc = exp.capacity_buffer_ffn_pallas(ybuf_in, ffn_params, cfg)
+        yloc = exp.capacity_buffer_ffn_ad(ybuf_in, ffn_params, cfg,
+                                          interpret)
     else:
         yloc = exp.expert_ffn_dense(ybuf_in, ffn_params, cfg)
     if tp_axis is not None:
@@ -152,7 +155,8 @@ def ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
                  use_pallas: bool = False,
                  token_axes: tuple[str, ...] = ("ep",),
                  tp: bool | None = None,
-                 dcn_inner: int | None = None) -> MoEOutput:
+                 dcn_inner: int | None = None,
+                 interpret: bool = False) -> MoEOutput:
     """Expert-parallel MoE layer over a global token batch.
 
     x: [S, H] global tokens, sharded over ``token_axes`` (e.g.
@@ -194,7 +198,7 @@ def ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
     body = functools.partial(
         _ep_moe_shard, cfg=cfg, axis="ep", use_pallas=use_pallas,
         reduce_axes=token_axes, tp_axis="tp" if use_tp else None,
-        dcn_inner=dcn_inner,
+        dcn_inner=dcn_inner, interpret=interpret,
     )
     fn = jax.shard_map(
         body, mesh=mesh,
