@@ -1,0 +1,105 @@
+"""Real-hardware validation sweep: drives every Pallas kernel and layer
+path on the actual TPU chip and checks against the dense-math oracle.
+
+Run: python scripts/tpu_validate.py        (needs the TPU backend live)
+
+This is the hardware half of the verification story: the CPU interpreter
+cannot catch Mosaic layout/lowering errors, so any kernel change must pass
+here before it counts (see .claude/skills/verify/SKILL.md).
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def deadline(seconds: int):
+    def handler(signum, frame):
+        print(f"FAIL: deadline {seconds}s exceeded (backend hung?)",
+              flush=True)
+        sys.exit(2)
+    signal.signal(signal.SIGALRM, handler)
+    signal.alarm(seconds)
+
+
+def main() -> int:
+    deadline(560)
+    import flashmoe_tpu as fm
+    from flashmoe_tpu.models.reference import init_moe_params, reference_moe
+    from flashmoe_tpu.ops.attention import attention_xla, flash_attention
+
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    failures = []
+
+    def check(name, err, tol):
+        ok = err < tol
+        print(f"{'ok  ' if ok else 'FAIL'} {name}: err={err:.3e} tol={tol}",
+              flush=True)
+        if not ok:
+            failures.append(name)
+
+    # 1. capacity path, f32 (exact-ish)
+    cfg = fm.MoEConfig(num_experts=8, expert_top_k=2, hidden_size=512,
+                       intermediate_size=1024, sequence_len=256,
+                       capacity_factor=4.0, drop_tokens=True,
+                       dtype=jnp.float32, param_dtype=jnp.float32)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (256, 512), jnp.float32)
+    t0 = time.time()
+    got = fm.moe_layer(params, x, cfg, use_pallas=True)
+    want, _ = reference_moe(params, x, cfg)
+    check("capacity_f32", float(jnp.max(jnp.abs(got.out - want))), 1e-4)
+    print(f"  (compile+run {time.time()-t0:.1f}s)")
+
+    # 2. dropless ragged path
+    cfg2 = cfg.replace(drop_tokens=False)
+    got2 = fm.moe_layer(params, x, cfg2, use_pallas=True)
+    want2, _ = reference_moe(params, x, cfg2)
+    check("dropless_ragged_f32", float(jnp.max(jnp.abs(got2.out - want2))),
+          1e-4)
+
+    # 3. gated bf16 (Mixtral-style)
+    cfg3 = fm.MoEConfig(num_experts=8, expert_top_k=2, hidden_size=512,
+                        intermediate_size=1024, sequence_len=256,
+                        gated_ffn=True, hidden_act="silu",
+                        drop_tokens=False)
+    p3 = init_moe_params(jax.random.PRNGKey(2), cfg3)
+    x3 = jax.random.normal(jax.random.PRNGKey(3), (256, 512), jnp.bfloat16)
+    g3 = fm.moe_layer(p3, x3, cfg3, use_pallas=True)
+    w3, _ = reference_moe(p3, x3, cfg3)
+    rel = float(jnp.max(jnp.abs(g3.out.astype(jnp.float32)
+                                - w3.astype(jnp.float32)))
+                / jnp.max(jnp.abs(w3.astype(jnp.float32))))
+    check("gated_bf16_rel", rel, 0.05)
+
+    # 4. flash attention kernel
+    q = jax.random.normal(jax.random.PRNGKey(4), (1, 4, 512, 64),
+                          jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(5), (1, 4, 512, 64),
+                          jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(6), (1, 4, 512, 64),
+                          jnp.float32)
+    fa = flash_attention(q, k, v, causal=True)
+    wa = attention_xla(q, k, v, causal=True)
+    check("flash_attention", float(jnp.max(jnp.abs(fa - wa))), 1e-4)
+
+    # 5. training grad through the fused path
+    def loss(p):
+        o = fm.moe_layer(p, x, cfg2, use_pallas=True)
+        return jnp.sum(o.out ** 2) + o.aux_loss
+    g = jax.grad(loss)(params)
+    finite = all(bool(jnp.isfinite(l).all())
+                 for l in jax.tree_util.tree_leaves(g))
+    check("fused_grad_finite", 0.0 if finite else 1.0, 0.5)
+
+    print("ALL OK" if not failures else f"FAILURES: {failures}", flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
