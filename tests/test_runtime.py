@@ -69,6 +69,37 @@ def test_bookkeeping_and_topo_export(devices, tmp_path):
     bootstrap.finalize()
 
 
+def test_multiprocess_launcher(devices, tmp_path):
+    """Two real processes form a jax.distributed cluster through the
+    launcher + bootstrap env protocol (the nvshmrun-equivalent path) and
+    run the MoE worker end-to-end."""
+    import os
+    from flashmoe_tpu.runtime.launcher import run_workers
+
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps({
+        "num_experts": 2, "expert_top_k": 1, "hidden_size": 128,
+        "intermediate_size": 256, "sequence_len": 128,
+    }))
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "",  # 1 CPU device per process -> 2 global
+    }
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        rc = run_workers(2, config_path=str(cfg),
+                         coordinator="127.0.0.1:9917")
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert rc == 0
+
+
 def test_worker_cli(devices):
     """The worker runs end-to-end as a subprocess (reference worker.py)."""
     import os
