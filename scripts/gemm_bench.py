@@ -1,0 +1,110 @@
+"""Grouped-GEMM sweep bench — the reference's ``gb`` benchmark
+(``csrc/benchmarks/gemm_bench.cu``: sweeps sizes comparing the custom tile
+GEMM against cuBLAS/MatX with isclose error % + times) re-done for the
+grouped Pallas FFN kernel vs the XLA batched einsum.
+
+Usage:
+  python scripts/gemm_bench.py                  # real TPU, timed
+  python scripts/gemm_bench.py --correctness    # any backend, error % only
+
+Prints one JSON line per size point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+from flashmoe_tpu.config import MoEConfig  # noqa: E402
+from flashmoe_tpu.models.reference import init_moe_params  # noqa: E402
+from flashmoe_tpu.ops.expert import (  # noqa: E402
+    capacity_buffer_ffn_pallas, expert_ffn_dense,
+)
+
+RTOL, ATOL = 2e-2, 2e-3  # the reference's isclose tolerances
+
+
+def _bench_point(e, c, h, i, dtype, correctness, trials=3, chain=8):
+    cfg = MoEConfig(num_experts=e, expert_top_k=1, hidden_size=h,
+                    intermediate_size=i, dtype=dtype,
+                    param_dtype=jnp.float32)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    params = jax.tree_util.tree_map(lambda p: p.astype(dtype), params)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (e, c, h), dtype)
+
+    interpret = jax.default_backend() != "tpu"
+    got = capacity_buffer_ffn_pallas(xs, params, cfg, interpret=interpret)
+    want = expert_ffn_dense(xs, params, cfg)
+    g32, w32 = got.astype(jnp.float32), want.astype(jnp.float32)
+    mism = float(jnp.mean(
+        (jnp.abs(g32 - w32) > ATOL + RTOL * jnp.abs(w32)).astype(jnp.float32)
+    )) * 100.0
+    rec = {
+        "E": e, "rows": c, "H": h, "I": i,
+        "dtype": jnp.dtype(dtype).name,
+        "mismatch_pct": round(mism, 4),
+        "backend": jax.default_backend(),
+    }
+    if not correctness and not interpret:
+        def timed(fn):
+            def run(p, xs):
+                def body(xs, _):
+                    return fn(xs, p, cfg).astype(xs.dtype), None
+                xs, _ = jax.lax.scan(body, xs, None, length=chain)
+                return xs.astype(jnp.float32).sum()
+            f = jax.jit(run)
+            float(f(params, xs))
+            ts = []
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                float(f(params, xs))
+                ts.append(time.perf_counter() - t0)
+            return sorted(ts)[len(ts) // 2] / chain
+
+        tp = timed(lambda xs, p, c_: capacity_buffer_ffn_pallas(xs, p, c_))
+        tx = timed(expert_ffn_dense)
+        flops = 2 * e * c * 2 * h * i
+        rec.update(
+            pallas_ms=round(tp * 1e3, 3), xla_ms=round(tx * 1e3, 3),
+            pallas_tflops=round(flops / tp / 1e12, 1),
+        )
+    print(json.dumps(rec), flush=True)
+    return mism
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--correctness", action="store_true",
+                    help="error check only (works on CPU interpret)")
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["bfloat16", "float32"])
+    args = ap.parse_args()
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+
+    sizes = [
+        (4, 128, 256, 256),
+        (8, 256, 512, 512),
+        (8, 256, 1024, 4096),
+        (16, 256, 2048, 2048),
+        (64, 256, 2048, 2048),   # the reference's headline shape
+        (8, 512, 4096, 14336),   # Mixtral expert shape
+    ]
+    if jax.default_backend() != "tpu":
+        sizes = sizes[:2]  # interpreter-mode DMAs are slow; small shapes only
+    worst = 0.0
+    for e, c, h, i in sizes:
+        worst = max(worst, _bench_point(e, c, h, i, dtype, args.correctness))
+    print(json.dumps({"worst_mismatch_pct": round(worst, 4)}), flush=True)
+    return 0 if worst < 1.0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
