@@ -61,6 +61,7 @@ from flashmoe_tpu.parallel.ep import local_capacity
 
 
 def _fused_kernel(
+    send_cnt, recv_cnt,                   # SMEM int32 [D, nLx] tile counts
     x_send, w_up, b_up, w_down, b_down,   # inputs (ANY/VMEM)
     x_recv, y_recv, y_stage,              # outputs (ANY; first two remote-written)
     xs_vmem, wup_vmem, wdn_vmem, acc, yv, # VMEM scratch
@@ -68,12 +69,26 @@ def _fused_kernel(
     copy_sems, send_x_sems, recv_x_sems, send_y_sems, recv_y_sems,
     *, axis, act_name, cm, bi, gated,
 ):
-    """One grid step = one source slab (ring order)."""
+    """One grid step = one source slab (ring order).
+
+    Transfers are tile-granular and count-aware: both sides share the
+    routed-count matrices (exchanged XLA-side), so only row tiles that
+    actually hold tokens are sent, waited on, computed, and returned —
+    the TPU form of the reference's ``routedTokens``-sized packets and
+    zero-token noop signals (``packet.cuh:99-259``), with the noop made
+    unnecessary because counts are pre-shared.
+    """
     s = pl.program_id(0)
     d_world = pl.num_programs(0)
     my = jax.lax.axis_index(axis)
     nlx, cap, h = x_send.shape[1], x_send.shape[2], x_send.shape[3]
     act = activation_fn(act_name)
+    n_row_tiles = cap // cm
+    n_i_chunks = w_down.shape[1] // bi
+
+    def tiles_of(cnt):
+        """Present row tiles for a (rank, expert) count."""
+        return jax.lax.div(cnt + (cm - 1), cm)
 
     # ---- phase 0/1 (first step only): barrier, then start every send ----
     @pl.when(s == 0)
@@ -94,18 +109,28 @@ def _fused_kernel(
 
         def send(step, c):
             dst = jax.lax.rem(my + step + 1, d_world)
-            pltpu.make_async_remote_copy(
-                src_ref=x_send.at[dst],
-                dst_ref=x_recv.at[my],
-                send_sem=send_x_sems.at[dst],
-                recv_sem=recv_x_sems.at[my],
-                device_id=dst,
-                device_id_type=pltpu.DeviceIdType.LOGICAL,
-            ).start()
+
+            def per_expert(e, c2):
+                def per_tile(t, c3):
+                    @pl.when(t < tiles_of(send_cnt[dst, e]))
+                    def _():
+                        pltpu.make_async_remote_copy(
+                            src_ref=x_send.at[dst, e, pl.ds(t * cm, cm), :],
+                            dst_ref=x_recv.at[my, e, pl.ds(t * cm, cm), :],
+                            send_sem=send_x_sems.at[dst],
+                            recv_sem=recv_x_sems.at[my],
+                            device_id=dst,
+                            device_id_type=pltpu.DeviceIdType.LOGICAL,
+                        ).start()
+                    return c3
+
+                return jax.lax.fori_loop(0, n_row_tiles, per_tile, c2)
+
+            jax.lax.fori_loop(0, nlx, per_expert, 0)
             return c
 
         jax.lax.fori_loop(0, d_world - 1, send, 0)
-        # own slab: plain local copy
+        # own slab: plain local copy (full; local bandwidth is cheap)
         own = pltpu.make_async_copy(
             x_send.at[my], x_recv.at[my], copy_sems.at[0]
         )
@@ -117,13 +142,22 @@ def _fused_kernel(
 
     @pl.when(s != 0)
     def _():
-        # wait for this source's slab (sender signalled recv_x_sems[src])
-        pltpu.make_async_copy(
-            x_recv.at[src], x_recv.at[src], recv_x_sems.at[src]
-        ).wait()
+        # wait for exactly the tiles this source sent (tile-sized waits
+        # against the data-carrying recv semaphore)
+        def per_expert(e, c):
+            def per_tile(t, c2):
+                @pl.when(t < tiles_of(recv_cnt[src, e]))
+                def _():
+                    pltpu.make_async_copy(
+                        x_recv.at[src, e, pl.ds(t * cm, cm), :],
+                        x_recv.at[src, e, pl.ds(t * cm, cm), :],
+                        recv_x_sems.at[src],
+                    ).wait()
+                return c2
 
-    n_row_tiles = cap // cm
-    n_i_chunks = w_down.shape[1] // bi
+            return jax.lax.fori_loop(0, n_row_tiles, per_tile, c)
+
+        jax.lax.fori_loop(0, nlx, per_expert, 0)
 
     def expert_body(e, _):
         # stream this expert's biases once
@@ -136,7 +170,7 @@ def _fused_kernel(
         bup_dma.start(); bdn_dma.start()
         bup_dma.wait(); bdn_dma.wait()
 
-        def row_tile_body(t, _):
+        def row_tile_body(t, carry):
             xd = pltpu.make_async_copy(
                 x_recv.at[src, e, pl.ds(t * cm, cm), :],
                 xs_vmem, copy_sems.at[0],
@@ -192,26 +226,29 @@ def _fused_kernel(
             )
             st.start()
             st.wait()
-            return _
+            # return immediately: tile-granular send back to the source
+            # (y_stage is indexed by src, so later steps never overwrite a
+            # slab whose asynchronous return is still in flight)
+            @pl.when(src != my)
+            def _():
+                pltpu.make_async_remote_copy(
+                    src_ref=y_stage.at[src, e, pl.ds(t * cm, cm), :],
+                    dst_ref=y_recv.at[my, e, pl.ds(t * cm, cm), :],
+                    send_sem=send_y_sems.at[src],
+                    recv_sem=recv_y_sems.at[my],
+                    device_id=src,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                ).start()
+            return carry
 
-        jax.lax.fori_loop(0, n_row_tiles, row_tile_body, 0)
+        # only the row tiles this source actually routed here
+        jax.lax.fori_loop(
+            0, jnp.minimum(tiles_of(recv_cnt[src, e]), n_row_tiles),
+            row_tile_body, 0,
+        )
         return _
 
     jax.lax.fori_loop(0, nlx, expert_body, 0)
-
-    # ---- return path: send results back to the source rank ----
-    # y_stage is indexed by src so step s+1 never overwrites a slab whose
-    # (asynchronous) return transfer is still in flight.
-    @pl.when(src != my)
-    def _():
-        pltpu.make_async_remote_copy(
-            src_ref=y_stage.at[src],
-            dst_ref=y_recv.at[my],
-            send_sem=send_y_sems.at[src],
-            recv_sem=recv_y_sems.at[my],
-            device_id=src,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
-        ).start()
 
     @pl.when(src == my)
     def _():
@@ -221,30 +258,49 @@ def _fused_kernel(
         own.start()
         own.wait()
 
-    # ---- phase 3 (last step): drain all semaphores ----
+    # ---- phase 3 (last step): drain all semaphores, tile-accounted ----
     @pl.when(s == d_world - 1)
     def _():
         def drain(d, c):
             @pl.when(d != my)
             def _():
-                # sends: wait local send semaphores
-                pltpu.make_async_copy(
-                    x_send.at[d], x_send.at[d], send_x_sems.at[d]
-                ).wait()
-                pltpu.make_async_copy(
-                    y_stage.at[d], y_stage.at[d], send_y_sems.at[d]
-                ).wait()
-                # returns: wait remote-written result slabs
-                pltpu.make_async_copy(
-                    y_recv.at[d], y_recv.at[d], recv_y_sems.at[d]
-                ).wait()
+                def per_expert(e, c2):
+                    def per_tile(t, c3):
+                        # x sends I started toward d
+                        @pl.when(t < tiles_of(send_cnt[d, e]))
+                        def _():
+                            pltpu.make_async_copy(
+                                x_send.at[d, e, pl.ds(t * cm, cm), :],
+                                x_send.at[d, e, pl.ds(t * cm, cm), :],
+                                send_x_sems.at[d],
+                            ).wait()
+                            # y tiles coming back from owner d (same
+                            # predicate: they are the tiles I sent)
+                            pltpu.make_async_copy(
+                                y_recv.at[d, e, pl.ds(t * cm, cm), :],
+                                y_recv.at[d, e, pl.ds(t * cm, cm), :],
+                                recv_y_sems.at[d],
+                            ).wait()
+                        # y sends I started toward source d
+                        @pl.when(t < tiles_of(recv_cnt[d, e]))
+                        def _():
+                            pltpu.make_async_copy(
+                                y_stage.at[d, e, pl.ds(t * cm, cm), :],
+                                y_stage.at[d, e, pl.ds(t * cm, cm), :],
+                                send_y_sems.at[d],
+                            ).wait()
+                        return c3
+
+                    return jax.lax.fori_loop(0, n_row_tiles, per_tile, c2)
+
+                jax.lax.fori_loop(0, nlx, per_expert, 0)
             return c
 
         jax.lax.fori_loop(0, d_world, drain, 0)
 
 
-def _fused_shard(x_send, w_up, b_up, w_down, b_down, *, cfg: MoEConfig,
-                 axis: str, interpret, collective_id: int,
+def _fused_shard(send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down, *,
+                 cfg: MoEConfig, axis: str, interpret, collective_id: int,
                  detect_races: bool = False, w_gate=None):
     d_world, nlx, cap, h = x_send.shape
     i_dim = w_down.shape[1]
@@ -285,6 +341,8 @@ def _fused_shard(x_send, w_up, b_up, w_down, b_down, *, cfg: MoEConfig,
         kernel,
         grid=(d_world,),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # send_cnt
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # recv_cnt
             pl.BlockSpec(memory_space=pltpu.ANY),  # x_send
             pl.BlockSpec(memory_space=pltpu.ANY),  # w_up
             pl.BlockSpec(memory_space=pltpu.ANY),  # b_up
@@ -316,7 +374,7 @@ def _fused_shard(x_send, w_up, b_up, w_down, b_down, *, cfg: MoEConfig,
             has_side_effects=True, collective_id=collective_id,
         ),
         interpret=interp,
-    )(x_send, w_up, b_up, w_down, b_down)
+    )(send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down)
     return y_recv
 
 
@@ -351,8 +409,19 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
         xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)
         x_send = xbuf.reshape(d, nlx, cap, h)
 
+        # routed-count matrices: what I send each (dest, expert) and what
+        # each source sends my experts — shared knowledge on both ends, so
+        # the kernel can skip absent tiles without noop signals
+        send_cnt = jnp.minimum(plan.counts, cap).astype(jnp.int32).reshape(
+            d, nlx
+        )
+        recv_cnt = jax.lax.all_to_all(
+            send_cnt.reshape(d, 1, nlx), "ep", split_axis=0, concat_axis=0,
+            tiled=False,
+        ).reshape(d, nlx)
+
         y_recv = _fused_shard(
-            x_send,
+            send_cnt, recv_cnt, x_send,
             params["w_up"].astype(cfg.dtype), params["b_up"],
             params["w_down"].astype(cfg.dtype), params["b_down"],
             cfg=cfg, axis="ep", interpret=interpret,

@@ -68,6 +68,26 @@ def test_fused_race_detector_clean(devices):
     )
 
 
+def test_fused_skewed_tile_skipping(devices):
+    """All tokens to one remote expert: most slabs/tiles are empty and
+    must be skipped on both send and wait sides without deadlock, while
+    the loaded expert's tiles all arrive."""
+    cfg = MoEConfig(num_experts=8, expert_top_k=1, hidden_size=128,
+                    intermediate_size=256, sequence_len=512,
+                    drop_tokens=False, ep=4, **F32)
+    params, x = _setup(cfg)
+    params["gate_w"] = jnp.zeros_like(params["gate_w"]).at[:, 5].set(1.0)
+    x = jnp.abs(x) + 0.1
+    mesh = make_mesh(cfg, dp=1, devices=devices[:4])
+    out = fused_ep_moe_layer(params, x, cfg, mesh, interpret=True,
+                             detect_races=True)
+    want, _ = reference_moe(params, x, cfg)
+    np.testing.assert_allclose(
+        np.asarray(out.out), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+    assert int(out.expert_counts[5]) == cfg.tokens
+
+
 def test_fused_gated_with_shared_experts(devices):
     """SwiGLU experts stream through the kernel; shared experts add in."""
     cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
