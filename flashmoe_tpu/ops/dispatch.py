@@ -106,6 +106,10 @@ def combine(expert_out, plan: DispatchPlan, combine_weights, cfg: MoEConfig,
         0,
     ).reshape(-1)
     gathered = expert_out.reshape(e * c, h)[flat].reshape(s, k, h)
+    # dropped slots read flat index 0, which may be UNWRITTEN buffer memory
+    # (the count-aware fused kernel skips empty tiles entirely) — zero the
+    # values, not just the weights, or NaN garbage * 0.0 = NaN propagates
+    gathered = jnp.where(plan.valid[..., None], gathered, 0)
     w = jnp.where(plan.valid, combine_weights, 0.0).astype(jnp.float32)
     # renormalize over surviving slots so dropped tokens keep unit weight
     # across their remaining experts (matches reference 1/sum(w) scaling).

@@ -111,20 +111,41 @@ def _fused_kernel(
             dst = jax.lax.rem(my + step + 1, d_world)
 
             def per_expert(e, c2):
-                def per_tile(t, c3):
-                    @pl.when(t < tiles_of(send_cnt[dst, e]))
-                    def _():
-                        pltpu.make_async_remote_copy(
-                            src_ref=x_send.at[dst, e, pl.ds(t * cm, cm), :],
-                            dst_ref=x_recv.at[my, e, pl.ds(t * cm, cm), :],
-                            send_sem=send_x_sems.at[dst],
-                            recv_sem=recv_x_sems.at[my],
-                            device_id=dst,
-                            device_id_type=pltpu.DeviceIdType.LOGICAL,
-                        ).start()
-                    return c3
+                nt = tiles_of(send_cnt[dst, e])
 
-                return jax.lax.fori_loop(0, n_row_tiles, per_tile, c2)
+                # fast path: full expert block in one DMA descriptor when
+                # every tile is present (semaphore waits count bytes, so
+                # the decomposition on the wait side need not match)
+                @pl.when(nt == n_row_tiles)
+                def _():
+                    pltpu.make_async_remote_copy(
+                        src_ref=x_send.at[dst, e],
+                        dst_ref=x_recv.at[my, e],
+                        send_sem=send_x_sems.at[dst],
+                        recv_sem=recv_x_sems.at[my],
+                        device_id=dst,
+                        device_id_type=pltpu.DeviceIdType.LOGICAL,
+                    ).start()
+
+                @pl.when(nt < n_row_tiles)
+                def _():
+                    def per_tile(t, c3):
+                        @pl.when(t < nt)
+                        def _():
+                            pltpu.make_async_remote_copy(
+                                src_ref=x_send.at[dst, e,
+                                                  pl.ds(t * cm, cm), :],
+                                dst_ref=x_recv.at[my, e,
+                                                  pl.ds(t * cm, cm), :],
+                                send_sem=send_x_sems.at[dst],
+                                recv_sem=recv_x_sems.at[my],
+                                device_id=dst,
+                                device_id_type=pltpu.DeviceIdType.LOGICAL,
+                            ).start()
+                        return c3
+
+                    jax.lax.fori_loop(0, n_row_tiles, per_tile, 0)
+                return c2
 
             jax.lax.fori_loop(0, nlx, per_expert, 0)
             return c
@@ -242,10 +263,9 @@ def _fused_kernel(
             return carry
 
         # only the row tiles this source actually routed here
-        jax.lax.fori_loop(
-            0, jnp.minimum(tiles_of(recv_cnt[src, e]), n_row_tiles),
-            row_tile_body, 0,
-        )
+        # (tiles_of(cnt) <= n_row_tiles by construction: counts are clamped
+        # to cap and cap % cm == 0)
+        jax.lax.fori_loop(0, tiles_of(recv_cnt[src, e]), row_tile_body, 0)
         return _
 
     jax.lax.fori_loop(0, nlx, expert_body, 0)
