@@ -61,13 +61,6 @@ def restore(directory: str, template: TrainState,
     if step is None:
         raise FileNotFoundError(f"no checkpoint in {directory}")
 
-    def to_restore_args(leaf):
-        if hasattr(leaf, "sharding"):
-            return ocp.type_handlers.ArrayRestoreArgs(
-                sharding=leaf.sharding, dtype=leaf.dtype,
-            )
-        return ocp.RestoreArgs()
-
     restored = mgr.restore(
         step,
         args=ocp.args.StandardRestore(template._asdict()),

@@ -56,12 +56,17 @@ def _run_step(step_fn, state, batch, timeout_s):
         out = step_fn(state, batch)
         jax.block_until_ready(out)
         return out
-    with _fut.ThreadPoolExecutor(max_workers=1) as ex:
-        f = ex.submit(lambda: jax.block_until_ready(step_fn(state, batch)))
-        try:
-            return f.result(timeout=timeout_s)
-        except _fut.TimeoutError as e:
-            raise StepFailure(f"step exceeded {timeout_s}s deadline") from e
+    ex = _fut.ThreadPoolExecutor(max_workers=1)
+    f = ex.submit(lambda: jax.block_until_ready(step_fn(state, batch)))
+    try:
+        return f.result(timeout=timeout_s)
+    except _fut.TimeoutError as e:
+        raise StepFailure(f"step exceeded {timeout_s}s deadline") from e
+    finally:
+        # wait=False: a worker genuinely stuck in a hung collective must be
+        # abandoned, not joined — shutdown(wait=True) would re-stall the
+        # caller on the very hang the deadline just detected.
+        ex.shutdown(wait=False)
 
 
 def resilient_train(state: TrainState, step_fn: Callable,
@@ -90,6 +95,27 @@ def resilient_train(state: TrainState, step_fn: Callable,
 
     i = int(state.step)
     retries = 0
+    # retries are counted against the step that failed, not reset by any
+    # success: recovery may rewind to an earlier step that succeeds again,
+    # and that must not refill the budget for a deterministically failing
+    # later step (it would livelock)
+    last_fail_step = -1
+    # In-memory recovery point for failures BEFORE the first checkpoint
+    # exists: the jitted step donates its input state (trainer.py
+    # donate_argnums), so a post-dispatch failure can leave ``state`` with
+    # deleted buffers — retrying needs an undonated copy.  Dropped once a
+    # checkpoint is on disk (holding a full host copy of params+moments
+    # for the whole run would cost host RAM for nothing): restores then
+    # use an abstract shape/dtype/sharding template instead.
+    shardings = jax.tree_util.tree_map(
+        lambda x: getattr(x, "sharding", None), state)
+    abstract = jax.tree_util.tree_map(
+        lambda x, sh: jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=sh
+        ) if hasattr(x, "shape") else x,
+        state, shardings,
+    )
+    safe_state = jax.device_get(state)
     while i < num_steps:
         batch = next(data_iter)
         try:
@@ -101,23 +127,29 @@ def resilient_train(state: TrainState, step_fn: Callable,
             loss = float(m["loss"])
             if not np.isfinite(loss):
                 raise StepFailure(f"non-finite loss at step {i}: {loss}")
-        except StepFailure:
-            raise
-        except Exception as e:  # device error, injected fault, ...
+        except Exception as e:  # timeout, NaN, device error, injected fault
             metrics.count("failures")
-            retries += 1
+            if i == last_fail_step:
+                retries += 1
+            else:
+                retries, last_fail_step = 1, i
             if retries > rcfg.max_retries:
                 raise StepFailure(
                     f"step {i} failed {retries} times; last error: {e}"
                 ) from e
             last = ckpt.latest_step(rcfg.checkpoint_dir)
             if last is not None:
-                state = ckpt.restore(rcfg.checkpoint_dir, state)
-                i = int(state.step)
-                metrics.count("restores")
+                template = (jax.device_put(safe_state, shardings)
+                            if safe_state is not None else abstract)
+                state = ckpt.restore(rcfg.checkpoint_dir, template)
+            else:
+                state = jax.device_put(safe_state, shardings)
+            i = int(state.step)
+            metrics.count("restores")
             continue
 
-        retries = 0
+        if i > last_fail_step:
+            retries = 0
         state = new_state
         metrics.count("steps")
         metrics.times["step"].append(time.perf_counter() - t0)
@@ -125,5 +157,6 @@ def resilient_train(state: TrainState, step_fn: Callable,
         i += 1
         if i % rcfg.checkpoint_every == 0 or i == num_steps:
             ckpt.save(rcfg.checkpoint_dir, state, step=i)
+            safe_state = None  # durable copy exists; free the host mirror
             metrics.count("checkpoints")
     return state, history

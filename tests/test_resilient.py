@@ -63,6 +63,32 @@ def test_recovers_from_transient_failure(devices, tmp_path):
     assert all(np.isfinite(h["loss"]) for h in hist)
 
 
+def test_nan_loss_recovers_before_first_checkpoint(devices, tmp_path):
+    """A non-finite loss is a StepFailure — it must go through restore-and-
+    retry, not re-raise (advisor finding, round 1).  The failure lands
+    before any checkpoint exists AND after the jitted step donated the
+    input state, so recovery must come from the undonated in-memory copy."""
+    state, step, data = _fixture(devices)
+    rcfg = ResilienceConfig(checkpoint_dir=str(tmp_path / "ck4"),
+                            checkpoint_every=100, max_retries=2)
+    metrics = Metrics()
+    calls = {"n": 0}
+
+    def nan_once_step(s, b):
+        ns, m = step(s, b)
+        calls["n"] += 1
+        if calls["n"] == 1:
+            m = dict(m, loss=jnp.float32("nan"))
+        return ns, m
+
+    final, hist = resilient_train(state, nan_once_step, data, num_steps=3,
+                                  rcfg=rcfg, metrics=metrics)
+    assert int(final.step) == 3
+    assert metrics.counters["failures"] == 1
+    assert metrics.counters["restores"] == 1
+    assert all(np.isfinite(h["loss"]) for h in hist)
+
+
 def test_retry_budget_exhausted(devices, tmp_path):
     state, step, data = _fixture(devices)
     rcfg = ResilienceConfig(checkpoint_dir=str(tmp_path / "ck2"),
