@@ -72,22 +72,34 @@ def make_plan(expert_idx, cfg: MoEConfig, capacity: int) -> DispatchPlan:
 
 
 def dispatch(x, plan: DispatchPlan, cfg: MoEConfig, capacity: int):
-    """Scatter tokens into the per-expert capacity buffer.
+    """Gather tokens into the per-expert capacity buffer.
 
     x: [S, H] -> [E, C, H].  Dropped/empty slots are zero (so the expert
     GEMM over them contributes nothing after combine masks them out).
+
+    Formulated as sort + row-GATHER rather than a row-scatter: an H-wide
+    scatter serializes on TPU, while a stable argsort over the [K*S] expert
+    ids (k-major, so priority order matches :func:`make_plan`) followed by
+    one [E*C]-row dynamic gather runs at HBM bandwidth — the slabs are
+    built from token rows directly, the way the reference's super-blocks
+    gather from ``tokenIds`` (``packet.cuh:99-206``).
     """
     s, h = x.shape
+    k = plan.expert_idx.shape[1]
     e = cfg.num_experts
-    flat = jnp.where(
-        plan.valid,
-        plan.expert_idx * capacity + plan.position,
-        e * capacity,  # out of bounds -> dropped by scatter
-    ).reshape(-1)
-    src = jnp.broadcast_to(x[:, None, :], (s, plan.expert_idx.shape[1], h))
-    buf = jnp.zeros((e * capacity, h), x.dtype)
-    buf = buf.at[flat].set(src.reshape(-1, h), mode="drop")
-    return buf.reshape(e, capacity, h)
+    # k-major flattening: index = kk*S + ss; stable sort groups by expert
+    # while preserving (k, token) priority order within each expert, so the
+    # c-th entry of expert e's run is exactly the selection with position c.
+    ef = plan.expert_idx.T.reshape(-1)
+    order = jnp.argsort(ef, stable=True)
+    tok_sorted = (order % s).astype(jnp.int32)  # token id per sorted entry
+    offsets = jnp.cumsum(plan.counts) - plan.counts  # [E] exclusive
+    slot = offsets[:, None] + jnp.arange(capacity, dtype=jnp.int32)[None, :]
+    present = jnp.arange(capacity, dtype=jnp.int32)[None, :] < \
+        plan.counts[:, None]
+    src_tok = tok_sorted[jnp.clip(slot, 0, s * k - 1)]  # [E, C]
+    buf = jnp.where(present[..., None], x[src_tok], 0)
+    return buf.astype(x.dtype)
 
 
 def combine(expert_out, plan: DispatchPlan, combine_weights, cfg: MoEConfig,

@@ -183,13 +183,35 @@ def _fused_kernel(
     def expert_body(e, _):
         # stream this expert's biases once
         bup_dma = pltpu.make_async_copy(
-            b_up.at[pl.ds(e, 1), :], bup_vmem, copy_sems.at[1]
+            b_up.at[pl.ds(e, 1), :], bup_vmem, copy_sems.at[0]
         )
         bdn_dma = pltpu.make_async_copy(
-            b_down.at[pl.ds(e, 1), :], bdn_vmem, copy_sems.at[2]
+            b_down.at[pl.ds(e, 1), :], bdn_vmem, copy_sems.at[1]
         )
         bup_dma.start(); bdn_dma.start()
         bup_dma.wait(); bdn_dma.wait()
+
+        # gated mode: w_up holds [gate_chunk | up_chunk] interleaved on a
+        # doubled chunk axis (see fused_ep_moe_layer), so one DMA streams
+        # both halves of the SwiGLU
+        up_chunk = 2 * bi if gated else bi
+
+        # weight-chunk DMA descriptors, double-buffered over two VMEM slots
+        # (sems 2+slot / 4+slot): chunk j+1 streams HBM->VMEM while chunk j
+        # runs on the MXU — the reference's multistage cp.async operand
+        # pipeline (``mmaConfig.cuh:19-171``) expressed as slot-alternating
+        # async copies.
+        def wu_dma(j, slot):
+            return pltpu.make_async_copy(
+                w_up.at[e, :, pl.ds(j * up_chunk, up_chunk)],
+                wup_vmem.at[slot], copy_sems.at[2 + slot],
+            )
+
+        def wd_dma(j, slot):
+            return pltpu.make_async_copy(
+                w_down.at[e, pl.ds(j * bi, bi), :],
+                wdn_vmem.at[slot], copy_sems.at[4 + slot],
+            )
 
         def row_tile_body(t, carry):
             xd = pltpu.make_async_copy(
@@ -197,46 +219,42 @@ def _fused_kernel(
                 xs_vmem, copy_sems.at[0],
             )
             xd.start()
+            wu_dma(0, 0).start()
+            wd_dma(0, 0).start()
             xd.wait()
             acc[:] = jnp.zeros_like(acc)
 
-            # gated mode: w_up holds [gate_chunk | up_chunk] interleaved on a
-            # doubled chunk axis (see fused_ep_moe_layer), so one DMA streams
-            # both halves of the SwiGLU
-            up_chunk = 2 * bi if gated else bi
+            def chunk_body(j, carry_c):
+                slot = jax.lax.rem(j, 2)
 
-            def chunk_body(j, _):
-                wu = pltpu.make_async_copy(
-                    w_up.at[e, :, pl.ds(j * up_chunk, up_chunk)], wup_vmem,
-                    copy_sems.at[1],
-                )
-                wd = pltpu.make_async_copy(
-                    w_down.at[e, pl.ds(j * bi, bi), :], wdn_vmem,
-                    copy_sems.at[2],
-                )
-                wu.start(); wd.start()
-                wu.wait()
+                @pl.when(j + 1 < n_i_chunks)
+                def _prefetch():
+                    wu_dma(j + 1, 1 - slot).start()
+                    wd_dma(j + 1, 1 - slot).start()
+
+                wu_dma(j, slot).wait()
                 if gated:
                     g = jnp.dot(
-                        xs_vmem[:], wup_vmem[:, :bi],
+                        xs_vmem[:], wup_vmem[slot, :, :bi],
                         preferred_element_type=jnp.float32,
                     )
                     up = jnp.dot(
-                        xs_vmem[:], wup_vmem[:, bi:],
+                        xs_vmem[:], wup_vmem[slot, :, bi:],
                         preferred_element_type=jnp.float32,
                     ) + bup_vmem[0, pl.ds(j * bi, bi)].astype(jnp.float32)
                     hidden = (act(g) * up).astype(xs_vmem.dtype)
                 else:
                     up = jnp.dot(
-                        xs_vmem[:], wup_vmem[:],
+                        xs_vmem[:], wup_vmem[slot],
                         preferred_element_type=jnp.float32,
                     ) + bup_vmem[0, pl.ds(j * bi, bi)].astype(jnp.float32)
                     hidden = act(up).astype(xs_vmem.dtype)
-                wd.wait()
+                wd_dma(j, slot).wait()
                 acc[:] += jnp.dot(
-                    hidden, wdn_vmem[:], preferred_element_type=jnp.float32
+                    hidden, wdn_vmem[slot],
+                    preferred_element_type=jnp.float32,
                 )
-                return _
+                return carry_c
 
             jax.lax.fori_loop(0, n_i_chunks, chunk_body, 0)
             yv[:] = (
@@ -325,9 +343,12 @@ def _fused_shard(send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down, *,
     d_world, nlx, cap, h = x_send.shape
     i_dim = w_down.shape[1]
     gated = w_gate is not None
-    cm = min(cap, 256)
-    if cap % cm:
-        raise ValueError(f"capacity {cap} not divisible by row tile {cm}")
+    # largest row tile that divides the capacity (callers pad cap to a
+    # 32-multiple, so an awkward capacity degrades the tile size instead of
+    # being rejected)
+    cm = next((t for t in (256, 128, 64, 32, 16, 8) if cap % t == 0), None)
+    if cm is None:
+        raise ValueError(f"capacity {cap} not a multiple of 8 rows")
     bi = min(512 if cm <= 128 else 256, i_dim)
     if i_dim % bi:
         raise ValueError(f"intermediate {i_dim} not divisible by {bi}")
@@ -363,28 +384,28 @@ def _fused_shard(send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down, *,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # send_cnt
             pl.BlockSpec(memory_space=pltpu.SMEM),  # recv_cnt
-            pl.BlockSpec(memory_space=pltpu.ANY),  # x_send
-            pl.BlockSpec(memory_space=pltpu.ANY),  # w_up
-            pl.BlockSpec(memory_space=pltpu.ANY),  # b_up
-            pl.BlockSpec(memory_space=pltpu.ANY),  # w_down
-            pl.BlockSpec(memory_space=pltpu.ANY),  # b_down
+            pl.BlockSpec(memory_space=pl.ANY),  # x_send
+            pl.BlockSpec(memory_space=pl.ANY),  # w_up
+            pl.BlockSpec(memory_space=pl.ANY),  # b_up
+            pl.BlockSpec(memory_space=pl.ANY),  # w_down
+            pl.BlockSpec(memory_space=pl.ANY),  # b_down
         ],
         out_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_shape=out_shapes,
         scratch_shapes=[
             pltpu.VMEM((cm, h), x_send.dtype),        # xs
-            pltpu.VMEM((h, 2 * bi if gated else bi),
-                       x_send.dtype),                 # w_up (+gate) chunk
-            pltpu.VMEM((bi, h), x_send.dtype),        # w_down chunk
+            pltpu.VMEM((2, h, 2 * bi if gated else bi),
+                       x_send.dtype),                 # w_up (+gate) 2 slots
+            pltpu.VMEM((2, bi, h), x_send.dtype),     # w_down chunk 2 slots
             pltpu.VMEM((cm, h), jnp.float32),         # acc
             pltpu.VMEM((cm, h), x_send.dtype),        # y tile
             pltpu.VMEM((1, i_dim), b_up.dtype),       # bias up
             pltpu.VMEM((1, h), b_down.dtype),         # bias down
-            pltpu.SemaphoreType.DMA((4,)),            # local copy sems
+            pltpu.SemaphoreType.DMA((6,)),            # local copy + wt sems
             pltpu.SemaphoreType.DMA((d_world,)),      # send x
             pltpu.SemaphoreType.DMA((d_world,)),      # recv x
             pltpu.SemaphoreType.DMA((d_world,)),      # send y
@@ -417,6 +438,10 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
         s_loc, h = x.shape
         nlx = cfg.num_experts // d
         cap = local_capacity(cfg, s_loc)
+        # pad the capacity buffer to a row-tile multiple (e.g. CF=1.25 can
+        # give cap=320 -> padded 320, cap=40 -> 64); counts stay clamped to
+        # the real cap, so padded rows are never transferred or computed
+        cap_pad = -(-cap // 32) * 32
 
         use_gate_pallas = (
             use_pallas_gate
@@ -427,7 +452,9 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
                    interpret=interpret)
         plan = dsp.make_plan(r.expert_idx, cfg, cap)
         xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)
-        x_send = xbuf.reshape(d, nlx, cap, h)
+        if cap_pad != cap:
+            xbuf = jnp.pad(xbuf, ((0, 0), (0, cap_pad - cap), (0, 0)))
+        x_send = xbuf.reshape(d, nlx, cap_pad, h)
 
         # routed-count matrices: what I send each (dest, expert) and what
         # each source sends my experts — shared knowledge on both ends, so
@@ -449,8 +476,8 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
             w_gate=(params["w_gate"].astype(cfg.dtype)
                     if cfg.gated_ffn else None),
         )
-        ybuf = y_recv.reshape(cfg.num_experts, cap, h)
-        out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)
+        ybuf = y_recv.reshape(cfg.num_experts, cap_pad, h)
+        out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap_pad)
         if cfg.num_shared_experts:
             out = out + shared_expert_ffn(
                 x.astype(cfg.dtype), params, cfg

@@ -88,6 +88,22 @@ def test_fused_skewed_tile_skipping(devices):
     assert int(out.expert_counts[5]) == cfg.tokens
 
 
+def test_fused_non_tile_multiple_capacity(devices):
+    """capacity_factor=1.25 gives cap=320 — not a multiple of 256.  The
+    kernel must degrade its row tile / pad rather than raise (advisor
+    finding, round 1), and still match the collective EP path."""
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                    intermediate_size=256, sequence_len=2048,
+                    capacity_factor=1.25, drop_tokens=True, ep=2, **F32)
+    params, x = _setup(cfg)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:2])
+    got = fused_ep_moe_layer(params, x, cfg, mesh, interpret=True)
+    want = ep_moe_layer(params, x, cfg, mesh, use_pallas=False)
+    np.testing.assert_allclose(
+        np.asarray(got.out), np.asarray(want.out), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_fused_gated_with_shared_experts(devices):
     """SwiGLU experts stream through the kernel; shared experts add in."""
     cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
