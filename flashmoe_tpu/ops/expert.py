@@ -128,9 +128,7 @@ def grouped_ffn(x, tile_gid, w_up, b_up, w_down, b_down, w_gate=None, *,
     e, _, i = w_up.shape
     if t % block_m:
         raise ValueError(f"rows {t} must be a multiple of block_m={block_m}")
-    bi = min(block_i, i)
-    if i % bi:
-        raise ValueError(f"intermediate {i} must be a multiple of {bi}")
+    bi = _auto_block(i, block_i)
     nt, nj = t // block_m, i // bi
 
     if gated:
@@ -186,29 +184,415 @@ def grouped_ffn(x, tile_gid, w_up, b_up, w_down, b_down, w_gate=None, *,
     )(tile_gid, x, w_up_eff, b_up3, w_down, b_down3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+# ----------------------------------------------------------------------
+# Grouped matmul / transposed grouped matmul — the backward kernels
+# ----------------------------------------------------------------------
+
+def _auto_block(dim: int, cap: int) -> int:
+    """Largest chunk <= cap that divides dim (config validation keeps dims
+    64-multiples, so this lands on an MXU-friendly size instead of
+    rejecting e.g. H=768)."""
+    for b in (512, 448, 384, 320, 256, 192, 128, 64, 32, 16, 8):
+        if b <= cap and dim % b == 0:
+            return b
+    raise ValueError(f"dimension {dim} not a multiple of 8")
+
+def _gmm_kernel(gid_ref, x_ref, w_ref, out_ref, acc_ref, *, transpose_w):
+    """One (row-tile, K-chunk) grid step of out = x @ w[gid] (or @ w[gid]^T
+    when ``transpose_w`` — the weight block is then [N, bk] and the
+    contraction runs over its last dim, so no transposed weight copy is
+    ever materialized in HBM)."""
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    if transpose_w:
+        acc_ref[:] += jax.lax.dot_general(
+            x_ref[:], w_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        acc_ref[:] += jnp.dot(
+            x_ref[:], w_ref[0], preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == nj - 1)
+    def _():
+        out_ref[:] = acc_ref[:].astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("transpose_w", "block_m", "block_k",
+                              "out_dtype", "interpret"),
+)
+def grouped_matmul(x, tile_gid, w, *, transpose_w: bool = False,
+                   block_m: int = BLOCK_M, block_k: int = 512,
+                   out_dtype=None, interpret: bool = False):
+    """out[T, N] = x[T, K] @ w[gid(tile), K, N]   (transpose_w: w is
+    [E, N, K] and contracts on its last dim).
+
+    The grouped-GEMM primitive of the backward pass: dA and dX are grouped
+    matmuls against the *forward* weight layouts with ``transpose_w=True``.
+    """
+    t, k = x.shape
+    if transpose_w:
+        e, n, kw = w.shape
+    else:
+        e, kw, n = w.shape
+    if kw != k:
+        raise ValueError(f"contraction mismatch: x K={k}, w K={kw}")
+    if t % block_m:
+        raise ValueError(f"rows {t} must be a multiple of {block_m}")
+    bk = _auto_block(k, block_k)
+    nt, nk = t // block_m, k // bk
+
+    if transpose_w:
+        w_spec = pl.BlockSpec((1, n, bk), lambda ti, j, gid: (gid[ti], 0, j),
+                              memory_space=pltpu.VMEM)
+    else:
+        w_spec = pl.BlockSpec((1, bk, n), lambda ti, j, gid: (gid[ti], j, 0),
+                              memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt, nk),
+        in_specs=[
+            pl.BlockSpec((block_m, bk), lambda ti, j, gid: (ti, j),
+                         memory_space=pltpu.VMEM),
+            w_spec,
+        ],
+        out_specs=pl.BlockSpec((block_m, n), lambda ti, j, gid: (ti, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((block_m, n), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_gmm_kernel, transpose_w=transpose_w),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, n), out_dtype or x.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * t * k * n,
+            bytes_accessed=x.size * x.dtype.itemsize
+            + w.size * w.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(tile_gid, x, w)
+
+
+def _tgmm_kernel(gid_ref, x_ref, dy_ref, out_ref):
+    """One (K-chunk, N-chunk, row-tile) step of dW[e] += x_tile^T @ dy_tile.
+
+    Row tiles sweep fastest and ``tile_gid`` is nondecreasing (both the
+    capacity and the ragged layouts are expert-major), so all tiles of one
+    expert revisit the same output block consecutively — the accumulation
+    lives in the block's VMEM copy and flushes once per expert."""
+    t = pl.program_id(2)
+    contrib = jax.lax.dot_general(
+        x_ref[:], dy_ref[:], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    first = jnp.logical_or(
+        t == 0, gid_ref[jnp.maximum(t - 1, 0)] != gid_ref[t]
+    )
+
+    @pl.when(first)
+    def _():
+        out_ref[0] = contrib.astype(out_ref.dtype)
+
+    @pl.when(jnp.logical_not(first))
+    def _():
+        out_ref[0] += contrib.astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_experts", "block_m", "block_k",
+                              "block_n", "interpret"),
+)
+def tgmm(x, dy, tile_gid, num_experts: int, *, block_m: int = BLOCK_M,
+         block_k: int = 512, block_n: int = 512,
+         interpret: bool = False):
+    """dW[E, K, N] = segment-sum over row tiles of x[T, K]^T @ dy[T, N].
+
+    The weight-gradient kernel (megablox's transposed grouped GEMM):
+    ``tile_gid`` MUST be nondecreasing.  Returns float32.
+    """
+    t, k = x.shape
+    t2, n = dy.shape
+    if t != t2:
+        raise ValueError(f"row mismatch {t} vs {t2}")
+    if t % block_m:
+        raise ValueError(f"rows {t} must be a multiple of {block_m}")
+    bk, bn = _auto_block(k, block_k), _auto_block(n, block_n)
+    nt, nk, nn = t // block_m, k // bk, n // bn
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nk, nn, nt),
+        in_specs=[
+            pl.BlockSpec((block_m, bk), lambda jk, jn, ti, gid: (ti, jk),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_m, bn), lambda jk, jn, ti, gid: (ti, jn),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, bk, bn), lambda jk, jn, ti, gid: (gid[ti], jk, jn),
+            memory_space=pltpu.VMEM,
+        ),
+    )
+    out = pl.pallas_call(
+        _tgmm_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_experts, k, n), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * t * k * n,
+            bytes_accessed=(x.size + dy.size) * x.dtype.itemsize
+            + num_experts * k * n * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(tile_gid, x, dy)
+    # experts absent from tile_gid (zero routed tokens on the ragged path)
+    # have blocks the kernel never visited — UNINITIALIZED memory, not
+    # zeros.  Select, don't multiply: NaN garbage * 0 would stay NaN.
+    present = jnp.zeros((num_experts,), jnp.bool_).at[tile_gid].set(True)
+    return jnp.where(present[:, None, None], out, 0.0)
+
+
+def _segment_bias_grad(d, tile_gid, num_experts: int, block_m: int):
+    """db[E, N] = per-expert row sum of d[T, N] (tiny; XLA einsum)."""
+    nt = d.shape[0] // block_m
+    per_tile = d.reshape(nt, block_m, -1).sum(axis=1)
+    oh = jax.nn.one_hot(tile_gid, num_experts, dtype=per_tile.dtype)
+    return jnp.einsum("tn,te->en", per_tile, oh)
+
+
+# ----------------------------------------------------------------------
+# Residual-saving forward + custom VJP: the fused backward path
+# ----------------------------------------------------------------------
+
+def _ffn_res_kernel(gid_ref, x_ref, wup_ref, bup_ref, wdn_ref, bdn_ref,
+                    out_ref, u_out_ref, g_out_ref, acc_ref, *,
+                    act_name, gated):
+    """Same as :func:`_ffn_kernel` but additionally writes the
+    pre-activation up (and gate) chunks — the residuals the backward needs,
+    saved on the way through instead of recomputed."""
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    act = activation_fn(act_name)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[:]
+    if gated:
+        half = wup_ref.shape[2] // 2
+        g = jnp.dot(x, wup_ref[0, :, :half],
+                    preferred_element_type=jnp.float32)
+        up = jnp.dot(x, wup_ref[0, :, half:],
+                     preferred_element_type=jnp.float32)
+        up = up + bup_ref[0, 0, :].astype(jnp.float32)
+        g_out_ref[:] = g.astype(g_out_ref.dtype)
+        u_out_ref[:] = up.astype(u_out_ref.dtype)
+        hidden = act(g) * up
+    else:
+        up = jnp.dot(x, wup_ref[0], preferred_element_type=jnp.float32)
+        up = up + bup_ref[0, 0, :].astype(jnp.float32)
+        u_out_ref[:] = up.astype(u_out_ref.dtype)
+        hidden = act(up)
+    acc_ref[:] += jnp.dot(
+        hidden.astype(x.dtype), wdn_ref[0], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(j == nj - 1)
+    def _():
+        out_ref[:] = (
+            acc_ref[:] + bdn_ref[0, 0, :].astype(jnp.float32)
+        ).astype(out_ref.dtype)
+
+
+def _grouped_ffn_res(x, tile_gid, w_up, b_up, w_down, b_down, w_gate, *,
+                     act_name, gated, block_m, block_i, interpret):
+    """Forward returning (y, u, g): u/g are the [T, I] pre-activation
+    buffers (g is a zero-row placeholder when not gated)."""
+    t, h = x.shape
+    e, _, i = w_up.shape
+    if t % block_m:
+        raise ValueError(f"rows {t} must be a multiple of block_m={block_m}")
+    bi = _auto_block(i, block_i)
+    nt, nj = t // block_m, i // bi
+
+    if gated:
+        wg = w_gate.reshape(e, h, nj, bi)
+        wu = w_up.reshape(e, h, nj, bi)
+        w_up_eff = jnp.concatenate([wg, wu], axis=-1).reshape(
+            e, h, nj * 2 * bi)
+        up_block = (1, h, 2 * bi)
+    else:
+        w_up_eff = w_up
+        up_block = (1, h, bi)
+    b_up3 = b_up.reshape(e, 1, i)
+    b_down3 = b_down.reshape(e, 1, h)
+
+    g_spec = (
+        pl.BlockSpec((block_m, bi), lambda ti, j, gid: (ti, j),
+                     memory_space=pltpu.VMEM)
+        if gated else
+        # not gated: the kernel never writes the gate residual — collapse
+        # it to one block so no [T, I] buffer is allocated for garbage
+        pl.BlockSpec((block_m, bi), lambda ti, j, gid: (0, 0),
+                     memory_space=pltpu.VMEM)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt, nj),
+        in_specs=[
+            pl.BlockSpec((block_m, h), lambda ti, j, gid: (ti, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(up_block, lambda ti, j, gid: (gid[ti], 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bi), lambda ti, j, gid: (gid[ti], 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bi, h), lambda ti, j, gid: (gid[ti], j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, h), lambda ti, j, gid: (gid[ti], 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_m, h), lambda ti, j, gid: (ti, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_m, bi), lambda ti, j, gid: (ti, j),
+                         memory_space=pltpu.VMEM),
+            g_spec,
+        ],
+        scratch_shapes=[pltpu.VMEM((block_m, h), jnp.float32)],
+    )
+    y, u, g = pl.pallas_call(
+        functools.partial(_ffn_res_kernel, act_name=act_name, gated=gated),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((t, h), x.dtype),
+            jax.ShapeDtypeStruct((t, i), x.dtype),
+            jax.ShapeDtypeStruct((t, i) if gated else (block_m, bi),
+                                 x.dtype),
+        ],
+        interpret=interpret,
+    )(tile_gid, x, w_up_eff, b_up3, w_down, b_down3)
+    return y, u, (g if gated else None)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+def grouped_ffn_ad(x, tile_gid, w_up, b_up, w_down, b_down, w_gate,
+                   act_name, gated, block_m, block_i, interpret):
+    """Differentiable grouped FFN: Pallas forward AND Pallas backward.
+
+    The backward's four large GEMMs run on kernels (dA and dX via
+    :func:`grouped_matmul` ``transpose_w=True`` against the forward weight
+    layouts; dW_up/dW_down via :func:`tgmm`), with pre-activations saved
+    from the forward instead of recomputed — unlike the reference, which
+    has no backward at all (SURVEY §2.6), and unlike round 1, which
+    recomputed the whole forward through XLA."""
+    return grouped_ffn(
+        x, tile_gid, w_up, b_up, w_down, b_down, w_gate,
+        act_name=act_name, gated=gated, block_m=block_m, block_i=block_i,
+        interpret=interpret,
+    )
+
+
+def _gffn_fwd(x, tile_gid, w_up, b_up, w_down, b_down, w_gate,
+              act_name, gated, block_m, block_i, interpret):
+    y, u, g = _grouped_ffn_res(
+        x, tile_gid, w_up, b_up, w_down, b_down, w_gate,
+        act_name=act_name, gated=gated, block_m=block_m, block_i=block_i,
+        interpret=interpret,
+    )
+    return y, (x, tile_gid, w_up, b_up, w_down, b_down, w_gate, u, g)
+
+
+def _gffn_bwd(act_name, gated, block_m, block_i, interpret, res, dy):
+    import numpy as np
+
+    x, tile_gid, w_up, b_up, w_down, b_down, w_gate, u, g = res
+    act = activation_fn(act_name)
+    e = w_up.shape[0]
+    dyc = dy.astype(x.dtype)
+
+    # dHidden = dY @ w_down^T   [T, I]
+    d_hidden = grouped_matmul(
+        dyc, tile_gid, w_down, transpose_w=True, block_m=block_m,
+        out_dtype=jnp.float32, interpret=interpret,
+    )
+    uf = u.astype(jnp.float32)
+    if gated:
+        gf = g.astype(jnp.float32)
+        act_g, act_vjp = jax.vjp(act, gf)
+        d_gate = act_vjp(d_hidden * uf)[0]
+        d_up = d_hidden * act_g
+        hidden = (act_g * uf).astype(x.dtype)
+        dx = grouped_matmul(
+            d_gate.astype(x.dtype), tile_gid, w_gate, transpose_w=True,
+            block_m=block_m, out_dtype=jnp.float32, interpret=interpret,
+        ) + grouped_matmul(
+            d_up.astype(x.dtype), tile_gid, w_up, transpose_w=True,
+            block_m=block_m, out_dtype=jnp.float32, interpret=interpret,
+        )
+        d_wg = tgmm(x, d_gate.astype(x.dtype), tile_gid, e,
+                    block_m=block_m, interpret=interpret)
+        ct_wg = d_wg.astype(w_gate.dtype)
+    else:
+        act_u, act_vjp = jax.vjp(act, uf)
+        d_up = act_vjp(d_hidden)[0]
+        hidden = act_u.astype(x.dtype)
+        dx = grouped_matmul(
+            d_up.astype(x.dtype), tile_gid, w_up, transpose_w=True,
+            block_m=block_m, out_dtype=jnp.float32, interpret=interpret,
+        )
+        ct_wg = None
+    d_wu = tgmm(x, d_up.astype(x.dtype), tile_gid, e,
+                block_m=block_m, interpret=interpret)
+    d_wd = tgmm(hidden, dyc, tile_gid, e,
+                block_m=block_m, interpret=interpret)
+    d_bu = _segment_bias_grad(d_up, tile_gid, e, block_m)
+    d_bd = _segment_bias_grad(dy.astype(jnp.float32), tile_gid, e, block_m)
+
+    ct_gid = np.zeros(tile_gid.shape, jax.dtypes.float0)
+    return (dx.astype(x.dtype), ct_gid, d_wu.astype(w_up.dtype),
+            d_bu.astype(b_up.dtype), d_wd.astype(w_down.dtype),
+            d_bd.astype(b_down.dtype), ct_wg)
+
+
+grouped_ffn_ad.defvjp(_gffn_fwd, _gffn_bwd)
+
+
 def capacity_buffer_ffn_ad(xs, params, cfg: MoEConfig,
                            interpret: bool = False):
-    """Differentiable wrapper over the grouped kernel on [E, C, H]:
-    Pallas forward, backward recomputed through the batched XLA FFN
-    (pallas_call has no autodiff rule)."""
-    return capacity_buffer_ffn_pallas(xs, params, cfg, interpret=interpret)
-
-
-def _cap_ffn_fwd(xs, params, cfg, interpret):
-    return capacity_buffer_ffn_pallas(xs, params, cfg,
-                                      interpret=interpret), (xs, params)
-
-
-def _cap_ffn_bwd(cfg, interpret, res, ct):
-    xs, params = res
-    _, vjp_fn = jax.vjp(
-        lambda xx, p: expert_ffn_dense(xx, p, cfg), xs, params
+    """Differentiable capacity-buffer FFN: the grouped Pallas kernel with
+    its fused Pallas backward (:func:`grouped_ffn_ad`) under the same
+    reshaping as :func:`capacity_buffer_ffn_pallas` — autodiff flows
+    through the reshapes natively."""
+    e, c, h = xs.shape
+    if c <= 512:
+        bm = ((c + 7) // 8) * 8
+    else:
+        bm = next(b for b in (512, 256, 128) if c % b == 0) if any(
+            c % b == 0 for b in (512, 256, 128)
+        ) else 512
+    cp = ((c + bm - 1) // bm) * bm
+    if cp != c:
+        xs = jnp.pad(xs, ((0, 0), (0, cp - c), (0, 0)))
+    x = xs.reshape(e * cp, h)
+    tiles_per_e = cp // bm
+    tile_gid = jnp.arange(e * tiles_per_e, dtype=jnp.int32) // tiles_per_e
+    block_i = 512 if bm <= 256 else 256
+    out = grouped_ffn_ad(
+        x, tile_gid, params["w_up"].astype(x.dtype), params["b_up"],
+        params["w_down"].astype(x.dtype), params["b_down"],
+        params.get("w_gate", None) if cfg.gated_ffn else None,
+        cfg.hidden_act, cfg.gated_ffn, bm, block_i, interpret,
     )
-    return vjp_fn(ct)
-
-
-capacity_buffer_ffn_ad.defvjp(_cap_ffn_fwd, _cap_ffn_bwd)
+    return out.reshape(e, cp, h)[:, :c, :]
 
 
 def capacity_buffer_ffn_pallas(xs, params, cfg: MoEConfig, *,

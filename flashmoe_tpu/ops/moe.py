@@ -18,7 +18,6 @@ all-to-all.
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax
@@ -68,13 +67,12 @@ def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
         bm = BLOCK_M if s >= BLOCK_M else max(8, ((s + 7) // 8) * 8)
         plan = rag.make_ragged_plan(r.expert_idx, cfg, bm)
         xbuf = rag.ragged_dispatch(x.astype(cfg.dtype), plan, cfg, bm)
-        ybuf = exp.grouped_ffn(
+        ybuf = exp.grouped_ffn_ad(
             xbuf, plan.tile_gid,
             params["w_up"].astype(cfg.dtype), params["b_up"],
             params["w_down"].astype(cfg.dtype), params["b_down"],
             params.get("w_gate", None) if cfg.gated_ffn else None,
-            act_name=cfg.hidden_act, gated=cfg.gated_ffn, block_m=bm,
-            interpret=interpret,
+            cfg.hidden_act, cfg.gated_ffn, bm, 512, interpret,
         )
         out = rag.ragged_combine(ybuf, plan, r.combine_weights, cfg)
     else:
@@ -84,8 +82,8 @@ def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
         plan = dsp.make_plan(r.expert_idx, cfg, cap)
         xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)  # [E, C, H]
         if use_pallas:
-            ybuf = exp.capacity_buffer_ffn_pallas(xbuf, params, cfg,
-                                                  interpret=interpret)
+            ybuf = exp.capacity_buffer_ffn_ad(xbuf, params, cfg,
+                                              interpret=interpret)
         else:
             ybuf = exp.expert_ffn_dense(xbuf, params, cfg)
         out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)  # [S,H] f32
@@ -101,33 +99,6 @@ def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
     )
 
 
-# Pallas kernels do not autodifferentiate, so the fused path is wrapped in a
-# custom VJP: forward runs the fused kernels, backward recomputes through
-# the (mathematically identical) XLA path and differentiates that — the
-# same rematerialization cost profile as checkpointed training, with fused
-# forward speed.  Fully fused backward kernels are a later-round item.
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def _moe_layer_fused_ad(params, x, cfg: MoEConfig, capacity, interpret):
-    return _moe_layer_impl(params, x, cfg, True, capacity, interpret)
-
-
-def _moe_layer_fused_fwd(params, x, cfg, capacity, interpret):
-    out = _moe_layer_impl(params, x, cfg, True, capacity, interpret)
-    return out, (params, x)
-
-
-def _moe_layer_fused_bwd(cfg, capacity, interpret, res, ct):
-    params, x = res
-    _, vjp_fn = jax.vjp(
-        lambda p, xx: _moe_layer_impl(p, xx, cfg, False, capacity, False),
-        params, x,
-    )
-    return vjp_fn(ct)
-
-
-_moe_layer_fused_ad.defvjp(_moe_layer_fused_fwd, _moe_layer_fused_bwd)
-
-
 def moe_layer(params, x, cfg: MoEConfig, *, use_pallas: bool | None = None,
               capacity: int | None = None,
               interpret: bool = False) -> MoEOutput:
@@ -136,8 +107,11 @@ def moe_layer(params, x, cfg: MoEConfig, *, use_pallas: bool | None = None,
     ``use_pallas`` selects the fused Pallas gate + grouped-FFN kernels;
     ``None`` (default) auto-selects: Pallas on TPU (or when ``interpret``),
     XLA elsewhere.  The XLA path is the oracle in tests.  Both paths are
-    differentiable (the fused path via a custom VJP that recomputes the
-    backward through XLA).
+    differentiable: the fused path composes per-component custom VJPs —
+    the dominant FFN gradients run through the Pallas backward kernels
+    (``grouped_matmul``/``tgmm`` with residuals saved in the forward,
+    :mod:`flashmoe_tpu.ops.expert`), while the cheap gate/dispatch/combine
+    stages differentiate through XLA.
     """
     if use_pallas is None:
         use_pallas = interpret or jax.default_backend() == "tpu"
@@ -146,6 +120,4 @@ def moe_layer(params, x, cfg: MoEConfig, *, use_pallas: bool | None = None,
     if cfg.num_experts == 1:
         out = dense_ffn(params, x, cfg)
         return MoEOutput(out, zero, zero, jnp.full((1,), s, jnp.int32))
-    if use_pallas:
-        return _moe_layer_fused_ad(params, x, cfg, capacity, interpret)
-    return _moe_layer_impl(params, x, cfg, False, capacity, interpret)
+    return _moe_layer_impl(params, x, cfg, use_pallas, capacity, interpret)
