@@ -40,6 +40,30 @@ _ICI_SPECS = {
 _DCN_SPEC = (10.0, 25.0)  # (latency_us, GB/s) per host NIC, conservative
 
 
+def tpu_generation(device) -> str:
+    """Map a device to a generation key for the spec tables.
+
+    ``device.platform`` is only 'tpu'/'cpu' — the generation lives in
+    ``device_kind`` (e.g. "TPU v5e", "TPU v5 lite", "TPU v5p") or, under
+    the tunneled backend, in ``PALLAS_AXON_TPU_GEN``."""
+    import os
+
+    if device.platform == "cpu":
+        return "cpu"
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    env = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for probe in (kind, env):
+        if "v5e" in probe or "v5 lite" in probe or "v5lite" in probe:
+            return "v5e"
+        if "v5p" in probe or probe == "v5" or "v5 pod" in probe:
+            return "v5p"
+        if "v6e" in probe or "v6 lite" in probe or "trillium" in probe:
+            return "v6e"
+        if "v4" in probe:
+            return "v4"
+    return "default"
+
+
 @dataclasses.dataclass
 class WorkerAttr:
     """Per-device attributes for the Decider (the reference's
@@ -94,7 +118,7 @@ def ici_adjacency(devices=None, platform: str | None = None) -> Adjacency:
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    plat = platform or devices[0].platform
+    plat = platform or tpu_generation(devices[0])
     lat_us, bw = _ICI_SPECS.get(plat, _ICI_SPECS["default"])
     dcn_lat_us, dcn_bw = _DCN_SPEC
 
@@ -130,37 +154,180 @@ def ici_adjacency(devices=None, platform: str | None = None) -> Adjacency:
     return Adjacency(alpha, beta)
 
 
-def probe_dcn_costs(mesh_devices, sizes_mb=(1.0, 64.0), trials: int = 3):
-    """Measure effective alpha/beta between processes by timing device_put
-    round-trips (the DCN analogue of the reference's timed puts).  Only
-    meaningful in multi-process jobs; returns None single-process."""
-    if jax.process_count() <= 1:
-        return None
-    import jax.numpy as jnp
+def probe_dcn_costs(sizes_mb=(0.25, 4.0), trials: int = 3,
+                    max_pairwise: int = 8):
+    """Measure the cross-process alpha-beta adjacency with timed transfers.
 
-    results = {}
-    for mb in sizes_mb:
-        x = jnp.zeros((int(mb * 1024 * 1024 // 4),), jnp.float32)
-        t0 = time.perf_counter()
+    The analogue of the reference's topology-discovery kernel
+    (``topo.cuh:207-262``): where each GPU rank times one-sided puts to
+    every peer and broadcasts its adjacency row, here each process pair is
+    timed with a real cross-process ``ppermute`` carrying only that pair's
+    payload (collectives being two-sided, every rank participates in each
+    probe anyway, so every process observes every pair's wall time and no
+    row broadcast is needed).  Two payload sizes give a slope-intercept
+    alpha (ms) / beta (ms/MB) fit per pair.
+
+    Up to ``max_pairwise`` processes every ordered pair is probed
+    individually (O(P^2) probes); beyond that, pairs at equal ring offset
+    are probed concurrently (O(P) probes — each rank sends to rank+k, so
+    the per-offset wall time upper-bounds every pair at that offset).
+
+    Returns (alpha[P, P], beta[P, P]) ndarrays, or None single-process.
+    """
+    import functools
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    p = jax.process_count()
+    if p <= 1:
+        return None
+    devs = jax.devices()
+    # one representative device per process (DCN cost is host-level)
+    rep = {}
+    for d in devs:
+        rep.setdefault(d.process_index, d)
+    reps = [rep[i] for i in sorted(rep)]
+    mesh = Mesh(np.array(reps), ("x",))
+    spec = NamedSharding(mesh, PartitionSpec("x"))
+
+    @functools.lru_cache(maxsize=None)
+    def probe_fn(perm, rows):
+        def body(s):
+            return jax.lax.ppermute(s, "x", perm=list(perm))
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=PartitionSpec("x", None),
+            out_specs=PartitionSpec("x", None), check_vma=False,
+        ))
+
+    def timed(perm, mb):
+        rows = max(1, int(mb * 1024 * 1024 // (4 * 128)))
+        x = jax.device_put(
+            jnp.zeros((p * rows, 128), jnp.float32), spec
+        )
+        f = probe_fn(perm, rows)
+        jax.block_until_ready(f(x))  # compile + warm
+        ts = []
         for _ in range(trials):
-            y = jax.device_put(x, mesh_devices[0])
-            jax.block_until_ready(y)
-        results[mb] = (time.perf_counter() - t0) / trials * 1e3
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(x))
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return ts[len(ts) // 2] * 1e3  # ms
+
+    alpha = np.zeros((p, p))
+    beta = np.zeros((p, p))
     small, large = sizes_mb[0], sizes_mb[-1]
-    beta = (results[large] - results[small]) / (large - small)
-    alpha = max(results[small] - beta * small, 0.0)
+    if p <= max_pairwise:
+        pairs = [(i, j) for i in range(p) for j in range(p) if i != j]
+        for i, j in pairs:
+            t_s = timed(((i, j),), small)
+            t_l = timed(((i, j),), large)
+            b = max((t_l - t_s) / (large - small), 0.0)
+            alpha[i, j] = max(t_s - b * small, 0.0)
+            beta[i, j] = b
+    else:
+        for k in range(1, p):
+            perm = tuple((i, (i + k) % p) for i in range(p))
+            t_s = timed(perm, small)
+            t_l = timed(perm, large)
+            b = max((t_l - t_s) / (large - small), 0.0)
+            a = max(t_s - b * small, 0.0)
+            for i in range(p):
+                alpha[i, (i + k) % p] = a
+                beta[i, (i + k) % p] = b
     return alpha, beta
 
 
-def measured_worker_attrs(devices=None) -> list[WorkerAttr]:
+def merge_dcn_costs(adj: Adjacency, dcn, devices=None) -> Adjacency:
+    """Replace the analytic cross-process entries of ``adj`` with measured
+    (alpha[P,P], beta[P,P]) DCN costs from :func:`probe_dcn_costs`."""
+    if dcn is None:
+        return adj
+    d_alpha, d_beta = dcn
+    devices = list(devices if devices is not None else jax.devices())
+    alpha, beta = adj.alpha.copy(), adj.beta.copy()
+    for i, di in enumerate(devices):
+        for j, dj in enumerate(devices):
+            pi, pj = di.process_index, dj.process_index
+            if pi != pj:
+                alpha[i, j] = d_alpha[pi, pj]
+                beta[i, j] = d_beta[pi, pj]
+    return Adjacency(alpha, beta)
+
+
+def device_memory_gb(device) -> float:
+    """Usable memory for one device, measured live when the runtime exposes
+    it (the reference's ``estimateMemory`` sizes capacity from actually-free
+    VRAM, ``bootstrap.cuh:98-111``), else a per-generation table.
+    ``FLASHMOE_MEMORY_GB`` overrides (tests / chaos drills)."""
+    import os
+
+    override = os.environ.get("FLASHMOE_MEMORY_GB")
+    if override:
+        return float(override)
+    try:
+        stats = device.memory_stats()
+        if stats:
+            limit = stats.get("bytes_limit") or stats.get(
+                "bytes_reservable_limit")
+            used = stats.get("bytes_in_use", 0)
+            if limit:
+                return (limit - used) / 1e9
+    except Exception:
+        pass
+    return {
+        "v4": 32.0, "v5e": 16.0, "v5p": 95.0, "v6e": 32.0,
+    }.get(tpu_generation(device), 16.0)
+
+
+def measured_worker_attrs(devices=None, cfg=None,
+                          probe: bool = False) -> list[WorkerAttr]:
     """Per-device throughput/memory attributes.
 
-    Homogeneous TPU slices get uniform attributes from the device kind; the
-    throughput probe (:mod:`flashmoe_tpu.runtime.throughput`) refines the
-    number with a timed grouped-GEMM when hardware is live.
+    With ``probe=True`` the expert-FFN throughput is *measured* on this
+    process's backend (:mod:`flashmoe_tpu.runtime.throughput`, the
+    reference's ``mT`` probe) and, in multi-process jobs, exchanged so
+    every process sees every worker's real rate — heterogeneous workers
+    then shift the Decider's rate-proportional expert assignment.
+    ``FLASHMOE_THROUGHPUT_SCALE`` scales this process's measured rate
+    (fault/skew injection for tests, like the reference's synthetic
+    ``testDecider`` workers).
     """
+    import os
+
     devices = list(devices if devices is not None else jax.devices())
-    mem = {
-        "v4": 32.0, "v5e": 16.0, "v5p": 95.0, "v6e": 32.0,
-    }.get(devices[0].platform, 16.0)
-    return [WorkerAttr(throughput=1.0, memory_gb=mem) for _ in devices]
+    throughput = 1.0
+    if probe:
+        from flashmoe_tpu.config import MoEConfig
+        from flashmoe_tpu.runtime.throughput import measure_expert_throughput
+
+        pcfg = cfg if cfg is not None else MoEConfig()
+        if devices[0].platform == "cpu":
+            # the virtual backend only needs *relative* rates; shrink the
+            # synthetic workload so bootstrap stays fast
+            pcfg = pcfg.replace(
+                hidden_size=min(512, pcfg.hidden_size),
+                intermediate_size=min(512, pcfg.intermediate_size),
+            )
+        throughput = measure_expert_throughput(
+            pcfg, experts=min(4, pcfg.num_experts), rows_per_expert=64,
+        )
+    throughput *= float(os.environ.get("FLASHMOE_THROUGHPUT_SCALE", "1.0"))
+
+    per_process = {jax.process_index(): throughput}
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        rates = multihost_utils.process_allgather(
+            np.array([throughput], np.float64)
+        ).reshape(-1)
+        per_process = {i: float(r) for i, r in enumerate(rates)}
+
+    return [
+        WorkerAttr(
+            throughput=per_process.get(d.process_index, throughput),
+            memory_gb=device_memory_gb(d),
+        )
+        for d in devices
+    ]

@@ -25,7 +25,7 @@ from flashmoe_tpu.config import MoEConfig
 from flashmoe_tpu.parallel.decider import Placement, decide, uniform_placement
 from flashmoe_tpu.parallel.mesh import make_mesh
 from flashmoe_tpu.parallel.topology import (
-    ici_adjacency, measured_worker_attrs,
+    ici_adjacency, measured_worker_attrs, merge_dcn_costs, probe_dcn_costs,
 )
 
 _runtime: Optional["Runtime"] = None
@@ -42,24 +42,45 @@ class Runtime:
     @property
     def num_local_experts(self) -> int:
         """nLx for this process's first device (reference
-        ``get_num_local_experts``, ``python_bindings.cu:187``)."""
-        first = len(jax.local_devices()) * self.process_id
-        return len(self.placement.local_experts.get(first, [])) or (
-            self.cfg.num_experts // max(1, self.cfg.ep)
-        )
+        ``get_num_local_experts``, ``python_bindings.cu:187``).
+
+        Placement keys are positions in the ``jax.devices()`` order, so the
+        first local device is located by identity — no assumption of
+        uniform per-process device counts or id ordering."""
+        local = jax.local_devices()
+        if local:
+            pos = {id(d): i for i, d in enumerate(jax.devices())}
+            first = pos.get(id(local[0]))
+            if first is None:
+                first = next(
+                    (i for i, d in enumerate(jax.devices())
+                     if d.id == local[0].id), 0,
+                )
+            got = self.placement.local_experts.get(first)
+            if got:
+                return len(got)
+        return self.cfg.num_experts // max(1, self.cfg.ep)
 
 
 def initialize(cfg: MoEConfig | dict | str | None = None, *,
                coordinator_address: str | None = None,
                num_processes: int | None = None,
                process_id: int | None = None,
-               use_decider: bool = True) -> Runtime:
+               use_decider: bool = True,
+               measure: bool | None = None) -> Runtime:
     """Bring up the distributed runtime (idempotent).
 
     Single-process callers get the local devices; multi-process jobs (env
     ``FLASHMOE_COORDINATOR`` / ``JAX_COORDINATOR_ADDRESS`` or explicit
     args) run ``jax.distributed.initialize`` first, like the reference's
     rank discovery from OMPI/PMI/SLURM env vars (``worker.py:24-29``).
+
+    ``measure`` runs the bootstrap probes the reference always runs
+    (``mT`` throughput, ``discoverTopology`` — ``bootstrap.cuh:278-529``):
+    per-worker expert throughput feeding rate-proportional assignment, and
+    timed pairwise DCN transfers replacing the analytic cross-process
+    costs.  Default (None): probe on real hardware and in multi-process
+    jobs; skip on the single-process virtual backend (analytic costs).
     """
     global _runtime
     if _runtime is not None:
@@ -95,9 +116,14 @@ def initialize(cfg: MoEConfig | dict | str | None = None, *,
     cfg = cfg.replace(ep=max(1, ep))
     mesh = make_mesh(cfg)
 
+    if measure is None:
+        measure = jax.process_count() > 1 or devices[0].platform != "cpu"
     if use_decider and n > 1:
         adj = ici_adjacency(devices)
-        placement = decide(adj, measured_worker_attrs(devices), cfg)
+        if measure and jax.process_count() > 1:
+            adj = merge_dcn_costs(adj, probe_dcn_costs(), devices)
+        attrs = measured_worker_attrs(devices, cfg, probe=measure)
+        placement = decide(adj, attrs, cfg)
     else:
         placement = uniform_placement(n, cfg)
 

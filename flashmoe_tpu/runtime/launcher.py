@@ -18,17 +18,21 @@ import sys
 def run_workers(n_processes: int = 1, *, config_path: str | None = None,
                 bench: bool = False, coordinator: str = "127.0.0.1:8476",
                 extra_env: dict | None = None,
+                per_rank_env: dict | None = None,
                 worker_module: str = "flashmoe_tpu.runtime.worker") -> int:
     """Launch N local worker processes (CPU backend: each gets the virtual
     device set; TPU: single process owns the local chips).
 
     Returns the worst exit code.  Mirrors ``nvshmrun_launcher``'s contract:
-    build the command, run it, surface stdout/stderr.
+    build the command, run it, surface stdout/stderr.  ``per_rank_env``
+    maps rank -> env overrides for that rank only (heterogeneity/fault
+    injection in tests).
     """
     procs = []
     for rank in range(n_processes):
         env = dict(os.environ)
         env.update(extra_env or {})
+        env.update((per_rank_env or {}).get(rank, {}))
         if n_processes > 1:
             env.update({
                 "FLASHMOE_COORDINATOR": coordinator,

@@ -60,25 +60,30 @@ def state_shardings(state: TrainState, cfg: MoEConfig, mesh: Mesh):
         is_leaf=lambda x: isinstance(x, P),
     )
 
-    def opt_sharding(leaf):
-        # moments have the same shape as params where they are pytrees of
-        # arrays; scalars replicate
+    # Optimizer moments mirror the param tree (optax states embed it as a
+    # subtree), so match by KEY PATH, not by array shape: a moment leaf
+    # whose trailing path equals a param's path (and shape agrees) gets
+    # that param's sharding; everything else (counts, scalars) replicates.
+    # Shape-only matching silently aliases two same-shaped params with
+    # different shardings (e.g. an ep-sharded and a replicated tensor).
+    flat_sh = jax.tree_util.tree_flatten_with_path(
+        param_sh, is_leaf=lambda x: isinstance(x, NamedSharding)
+    )[0]
+    flat_p = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    by_path = {
+        tuple(str(k) for k in path): (leaf.shape, sh)
+        for (path, leaf), (_, sh) in zip(flat_p, flat_sh)
+    }
+
+    def match(path, leaf):
+        key = tuple(str(k) for k in path)
+        for start in range(len(key)):
+            hit = by_path.get(key[start:])
+            if hit is not None and getattr(leaf, "shape", None) == hit[0]:
+                return hit[1]
         return NamedSharding(mesh, P())
 
-    # map optimizer state: arrays matching a param shape get the param's
-    # sharding, everything else replicates
-    flat_params, _ = jax.tree_util.tree_flatten(state.params)
-    flat_shard, _ = jax.tree_util.tree_flatten(param_sh)
-    shape_map = {}
-    for p, s in zip(flat_params, flat_shard):
-        shape_map.setdefault(p.shape, s)
-
-    def match(leaf):
-        if hasattr(leaf, "shape") and leaf.shape in shape_map and leaf.ndim > 0:
-            return shape_map[leaf.shape]
-        return NamedSharding(mesh, P())
-
-    opt_sh = jax.tree_util.tree_map(match, state.opt_state)
+    opt_sh = jax.tree_util.tree_map_with_path(match, state.opt_state)
     return TrainState(param_sh, opt_sh, NamedSharding(mesh, P()))
 
 

@@ -1,0 +1,37 @@
+"""Worker for the measured-placement test: run the REAL bootstrap path
+(throughput probe + DCN probe + Decider) and print the resulting expert
+counts.  Launched per-rank by ``tests/test_runtime.py`` with a throughput
+scale injected on one rank."""
+
+import json
+import os
+
+import jax.numpy as jnp
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.runtime import bootstrap
+
+
+def main():
+    cfg = MoEConfig(
+        num_experts=8, expert_top_k=2, hidden_size=256,
+        intermediate_size=256, sequence_len=128, is_training=False,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    rt = bootstrap.initialize(cfg, measure=True)
+    counts = {str(d): len(v) for d, v in rt.placement.local_experts.items()}
+    rec = json.dumps({
+        "rank": rt.process_id,
+        "counts": counts,
+        "groups": rt.placement.groups,
+    })
+    out = os.environ.get("FLASHMOE_PLACEMENT_OUT")
+    if out:
+        with open(f"{out}.rank{rt.process_id}.json", "w") as f:
+            f.write(rec)
+    print(rec, flush=True)
+    bootstrap.finalize()
+
+
+if __name__ == "__main__":
+    main()

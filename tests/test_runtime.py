@@ -100,6 +100,51 @@ def test_multiprocess_launcher(devices, tmp_path):
     assert rc == 0
 
 
+def test_slow_worker_shifts_placement(devices, tmp_path):
+    """Measured placement end to end: two real processes run the full
+    bootstrap (throughput probe + pairwise DCN probe + Decider); rank 1's
+    measured rate is scaled down 8x and per-device memory is capped so the
+    two workers must form one EP group — the Decider's rate-proportional
+    assignment must then give the slow worker visibly fewer experts
+    (reference: ``mT`` -> ``WorkerAttribute`` -> ``assign``,
+    ``throughput.cuh:99-170``, ``decider.cuh:273-329``)."""
+    import os
+    from flashmoe_tpu.runtime.launcher import run_workers
+
+    out = tmp_path / "placement"
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "",  # 1 CPU device per process -> 2 global
+        # each worker holds 3MB; 8 experts x 0.52MB need ~4.2MB -> a single
+        # worker is infeasible, the pair must merge into one EP group
+        "FLASHMOE_MEMORY_GB": "0.003",
+        "FLASHMOE_PLACEMENT_OUT": str(out),
+    }
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        rc = run_workers(
+            2, coordinator="127.0.0.1:9919",
+            per_rank_env={1: {"FLASHMOE_THROUGHPUT_SCALE": "0.125"}},
+            worker_module="tests._placement_worker",
+        )
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert rc == 0
+    rec = json.loads((tmp_path / "placement.rank0.json").read_text())
+    counts = {int(k): v for k, v in rec["counts"].items()}
+    assert rec["groups"] == [[0, 1]], rec  # memory forced one EP group
+    assert counts[0] + counts[1] == 8
+    assert counts[0] > counts[1], (
+        f"slow worker should hold fewer experts: {counts}"
+    )
+
+
 def test_worker_cli(devices):
     """The worker runs end-to-end as a subprocess (reference worker.py)."""
     import os
