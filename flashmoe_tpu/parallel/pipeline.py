@@ -11,9 +11,13 @@ in-flight microbatch and hands its activation to the successor via
 tick's compute).  Stage 0 owns the embedding, the last stage owns the final
 norm + LM head and the loss.
 
-Composition: tokens shard over ``dp`` (each dp group runs its own
-pipeline); experts are replicated within a stage in this schedule (ep/tp
-composition with PP is a later-round optimization).  Stages must be
+Composition: tokens shard over ``dp`` — and over ``ep`` when the mesh has
+one (each (dp, ep) slice runs its own pipeline, with ep doubling as data
+parallelism for the non-MoE sub-blocks, the standard DP x PP x EP layout).
+Inside a stage, MoE layers then run *expert-parallel*: expert weights
+shard over ``ep`` within the stage and the dispatch/combine all-to-all
+runs between that stage's ep peers (:func:`flashmoe_tpu.parallel.ep.
+_ep_moe_shard`, already an in-shard_map body).  Stages must be
 structurally uniform (same layer pattern), which holds when every layer is
 MoE (``moe_frequency == 1``) or every layer dense.
 """
@@ -29,6 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from flashmoe_tpu.config import MoEConfig
 from flashmoe_tpu.models import transformer as tfm
 from flashmoe_tpu.ops.moe import moe_layer
+from flashmoe_tpu.parallel.ep import _ep_moe_shard
 
 
 def stack_stage_params(params, cfg: MoEConfig, pp: int):
@@ -56,13 +61,36 @@ def stack_stage_params(params, cfg: MoEConfig, pp: int):
     return stage_layers, io_params
 
 
-def _stage_apply(stage_layers, x, cfg: MoEConfig, lps: int):
+def _block_in_stage(layer, x, cfg: MoEConfig, li: int, use_ep: bool):
+    """One transformer block inside the pipeline's shard_map body.
+
+    With ``use_ep`` the MoE sub-block runs expert-parallel over the
+    ``ep`` axis via the in-shard_map EP body (expert weights arrive
+    ep-sharded through the stage in_specs)."""
+    a = tfm.attention(layer, tfm.rms_norm(x, layer["attn_norm"]), cfg)
+    x = x + a
+    xf = tfm.rms_norm(x, layer["ffn_norm"])
+    b, t, h = xf.shape
+    flat = xf.reshape(b * t, h)
+    layer_cfg = cfg if li in cfg.moe_layer_indices else cfg.replace(
+        num_experts=1, expert_top_k=1, num_shared_experts=0
+    )
+    if use_ep and layer_cfg.num_experts > 1:
+        o = _ep_moe_shard(layer["moe"], flat, cfg=layer_cfg, axis="ep",
+                          use_pallas=False, reduce_axes=("ep",))
+    else:
+        o = moe_layer(layer["moe"], flat, layer_cfg)
+    return x + o.out.reshape(b, t, h).astype(x.dtype), o.aux_loss + o.z_loss
+
+
+def _stage_apply(stage_layers, x, cfg: MoEConfig, lps: int,
+                 use_ep: bool = False):
     """Run this rank's ``lps`` layers on x: [B, T, H]."""
     aux = jnp.zeros((), cfg.accum_dtype)
+    li0 = 0 if cfg.num_experts == 1 else cfg.moe_layer_indices[0]
     for li in range(lps):
         layer = jax.tree_util.tree_map(lambda a: a[li], stage_layers)
-        x, moe_loss = tfm.block(layer, x, cfg, 0 if cfg.num_experts == 1
-                                else cfg.moe_layer_indices[0])
+        x, moe_loss = _block_in_stage(layer, x, cfg, li0, use_ep)
         aux = aux + moe_loss
     return x, aux
 
@@ -74,8 +102,25 @@ def pipeline_loss(params, batch, cfg: MoEConfig, mesh: Mesh, *,
     pp = mesh.shape["pp"]
     if pp <= 1:
         raise ValueError("pipeline_loss needs a pp>1 mesh")
+    ep = mesh.shape.get("ep", 1)
+    use_ep = ep > 1 and cfg.num_experts > 1
+    if use_ep and cfg.num_experts % ep:
+        raise ValueError(f"E={cfg.num_experts} not divisible by ep={ep}")
     lps = cfg.num_layers // pp
     stage_layers, io_params = stack_stage_params(params, cfg, pp)
+
+    # expert-weight leaves additionally shard their expert dim (axis 2 of
+    # the [pp, lps, E, ...] stack) over ep; everything else replicates
+    # across ep within the stage
+    _EP_KEYS = {"w_up", "w_down", "w_gate", "b_up", "b_down"}
+
+    def _stage_spec(path, leaf):
+        keys = {getattr(k, "key", None) for k in path}
+        if use_ep and keys & {"moe"} and keys & _EP_KEYS:
+            return P("pp", None, "ep")
+        return P("pp")
+
+    stage_specs = jax.tree_util.tree_map_with_path(_stage_spec, stage_layers)
 
     def body(stage_layers, io_params, tokens):
         # in_specs P("pp") leaves a leading singleton stage dim per rank
@@ -95,7 +140,7 @@ def pipeline_loss(params, batch, cfg: MoEConfig, mesh: Mesh, *,
             active = (t - s >= 0) & (t - s < m)
             inject = io_params["embed"].astype(cfg.dtype)[inp[mb]]
             x = jnp.where(s == 0, inject, act_in)
-            y, aux = _stage_apply(stage_layers, x, cfg, lps)
+            y, aux = _stage_apply(stage_layers, x, cfg, lps, use_ep=use_ep)
             # last stage: loss on the completed microbatch
             h = tfm.rms_norm(y, io_params["final_norm"])
             logits = jnp.dot(
@@ -128,13 +173,15 @@ def pipeline_loss(params, batch, cfg: MoEConfig, mesh: Mesh, *,
             jax.lax.psum(cnt, "pp"), 1.0
         )
         aux = jax.lax.psum(aux_sum, "pp") / m
-        ce = jax.lax.pmean(ce, "dp")
-        aux = jax.lax.pmean(aux, "dp")
+        token_axes = ("dp", "ep") if use_ep else ("dp",)
+        ce = jax.lax.pmean(ce, token_axes)
+        aux = jax.lax.pmean(aux, token_axes)
         return ce + aux, ce, aux
 
+    tok_spec = P(("dp", "ep"), None) if use_ep else P("dp", None)
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P("pp"), P(), P("dp", None)),
+        in_specs=(stage_specs, P(), tok_spec),
         out_specs=(P(), P(), P()),
         check_vma=False,
     )

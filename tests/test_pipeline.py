@@ -45,6 +45,24 @@ def test_pipeline_grad(devices):
         assert np.isfinite(np.asarray(leaf)).all()
 
 
+def test_pipeline_with_ep_in_stage(devices):
+    """PP x EP composition: experts shard over ep INSIDE each stage (the
+    stage's MoE runs the in-shard_map all-to-all body), and the CE still
+    matches the plain forward."""
+    cfg = CFG.replace(pp=2, dp=2, ep=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh(cfg, devices=devices[:8], dp=2)
+    batch = _batch(b=8)  # dp*ep*mb = 2*2*2
+    total, m = pipeline_loss(params, batch, cfg, mesh, num_microbatches=2)
+    _, wm = loss_fn(params, batch, cfg, None)
+    np.testing.assert_allclose(float(m["ce"]), float(wm["ce"]), rtol=1e-5)
+    g = jax.grad(
+        lambda p: pipeline_loss(p, batch, cfg, mesh, num_microbatches=2)[0]
+    )(params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
 def test_stage_stacking_validation():
     cfg = CFG.replace(num_layers=3, pp=2)
     params = init_params(jax.random.PRNGKey(0), cfg)
