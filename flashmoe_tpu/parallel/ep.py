@@ -85,13 +85,19 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
                   reduce_axes: tuple[str, ...] = ("ep",),
                   tp_axis: str | None = None,
                   dcn_inner: int | None = None,
-                  interpret: bool = False):
+                  interpret: bool = False,
+                  skip_exchange: bool = False):
     """Per-rank body (runs inside shard_map over the ep axis).
 
     x: [S_loc, H] local tokens; params: expert weights sharded on axis 0
     (leading dim nLx), gate replicated.  With ``tp_axis``, each expert's
     intermediate dimension is additionally Megatron-split across tp ranks
     (column-parallel up/gate, row-parallel down, one psum per FFN).
+
+    ``skip_exchange`` elides both all-to-alls while keeping every other
+    stage and shape identical — the compute-only leg of the overlap-
+    efficiency measurement (:mod:`flashmoe_tpu.parallel.overlap`); the
+    result is numerically meaningless (tokens meet the wrong experts).
     """
     d = jax.lax.axis_size(axis)
     s_loc, h = x.shape
@@ -104,7 +110,9 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
     xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)  # [E, C, H]
 
     # exchange expert-major slabs: [E, C, H] -> [D, nLx, C, H] received
-    if dcn_inner is not None and 1 < dcn_inner < d:
+    if skip_exchange:
+        recv = xbuf.reshape(d, nlx, cap, h)
+    elif dcn_inner is not None and 1 < dcn_inner < d:
         recv = _hierarchical_a2a(
             xbuf.reshape(d, nlx, cap, h), axis, d, dcn_inner, reverse=False,
         )
@@ -131,7 +139,9 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
 
     # reverse: [nLx, D*C, H] -> [D, nLx, C, H] -> all_to_all -> [E, C, H]
     ysend = yloc.reshape(nlx, d, cap, h).transpose(1, 0, 2, 3)
-    if dcn_inner is not None and 1 < dcn_inner < d:
+    if skip_exchange:
+        yback = ysend
+    elif dcn_inner is not None and 1 < dcn_inner < d:
         yback = _hierarchical_a2a(ysend, axis, d, dcn_inner, reverse=True)
     else:
         yback = jax.lax.all_to_all(
@@ -156,7 +166,8 @@ def ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
                  token_axes: tuple[str, ...] = ("ep",),
                  tp: bool | None = None,
                  dcn_inner: int | None = None,
-                 interpret: bool = False) -> MoEOutput:
+                 interpret: bool = False,
+                 skip_exchange: bool = False) -> MoEOutput:
     """Expert-parallel MoE layer over a global token batch.
 
     x: [S, H] global tokens, sharded over ``token_axes`` (e.g.
@@ -199,6 +210,7 @@ def ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
         _ep_moe_shard, cfg=cfg, axis="ep", use_pallas=use_pallas,
         reduce_axes=token_axes, tp_axis="tp" if use_tp else None,
         dcn_inner=dcn_inner, interpret=interpret,
+        skip_exchange=skip_exchange,
     )
     fn = jax.shard_map(
         body, mesh=mesh,

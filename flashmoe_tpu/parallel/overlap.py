@@ -1,0 +1,135 @@
+"""Overlap-efficiency measurement.
+
+The reference's headline metric (``/root/reference/README.md:33-35``,
+``plots/overlap_efficiency_8.png``) quantifies how much of the dispatch/
+combine communication the fused kernel hides behind expert compute.  Here
+the metric is defined operationally, on any ``ep`` mesh:
+
+    overlap_efficiency = (t_compute_only + t_comm_only) / t_overlapped
+
+  * ``t_overlapped``   — the full MoE layer on the measured path (fused
+    Pallas RDMA kernel or the XLA-collective layer);
+  * ``t_compute_only`` — the same layer with both all-to-alls elided
+    (identical gate/dispatch/FFN/combine stages and shapes);
+  * ``t_comm_only``    — the two all-to-alls alone on identically shaped
+    slabs, with no FFN between them.
+
+A value of 1.0 means fully serialized (no overlap); the upper bound
+``(a+b)/max(a,b)`` (= 2.0 when legs are balanced) means one leg fully
+hidden behind the other.  The same procedure runs on a real v5e-8 and on
+the virtual 8-device CPU mesh (where it validates the harness, not the
+hardware — XLA's CPU collectives are memcpys).
+
+Timing uses chained in-jit iterations (two chain lengths, differenced)
+because the tunneled TPU backend's ``block_until_ready`` does not
+synchronize — see ``bench.py``.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.models.reference import init_moe_params
+from flashmoe_tpu.parallel.ep import ep_moe_layer, local_capacity
+from flashmoe_tpu.parallel.fused import fused_ep_moe_layer
+
+
+def _comm_only(x, cfg: MoEConfig, mesh: Mesh):
+    """Both all-to-alls on dispatch-shaped slabs, no compute between."""
+
+    def body(x):
+        d = jax.lax.axis_size("ep")
+        s_loc, h = x.shape
+        nlx = cfg.num_experts // d
+        cap = local_capacity(cfg, s_loc)
+        rows = d * nlx * cap
+        src = (jnp.arange(rows, dtype=jnp.int32) % s_loc)
+        slab = x[src].reshape(d, nlx, cap, h)
+        recv = jax.lax.all_to_all(
+            slab, "ep", split_axis=0, concat_axis=0, tiled=False
+        )
+        back = jax.lax.all_to_all(
+            recv, "ep", split_axis=0, concat_axis=0, tiled=False
+        )
+        # feed the payload back as the next chain input (data dependency —
+        # nothing for XLA to dead-code-eliminate)
+        return back.reshape(rows, h)[:s_loc]
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=P("ep", None), out_specs=P("ep", None),
+        check_vma=False,
+    )(x)
+
+
+def _time_chained(fn, x, *, trials: int, chain: int):
+    """Median seconds per application via two-chain-length differencing."""
+
+    def chained(n):
+        def run(x0):
+            def step(c, _):
+                return fn(c).astype(x0.dtype), None
+            c, _ = jax.lax.scan(step, x0, None, length=n)
+            return c.astype(jnp.float32).sum()
+        return jax.jit(run)
+
+    def median_time(f):
+        float(f(x))  # compile + warm
+        ts = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            float(f(x))
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return ts[len(ts) // 2]
+
+    t1 = median_time(chained(1))
+    tn = median_time(chained(chain))
+    return max(tn - t1, 1e-9) / (chain - 1)
+
+
+def measure_overlap(cfg: MoEConfig, mesh: Mesh, *, path: str = "fused",
+                    trials: int = 5, chain: int = 8,
+                    interpret: bool = False, seed: int = 0) -> dict:
+    """Measure the three legs and the efficiency ratio on ``mesh``.
+
+    ``path``: 'fused' (Pallas RDMA kernel) or 'collective' (XLA layer).
+    Returns {t_overlapped_ms, t_compute_ms, t_comm_ms, overlap_efficiency}.
+    """
+    ep = mesh.shape["ep"]
+    if cfg.num_experts % ep:
+        raise ValueError(f"E={cfg.num_experts} not divisible by ep={ep}")
+    pk, xk = jax.random.split(jax.random.PRNGKey(seed))
+    params = init_moe_params(pk, cfg)
+    params = jax.tree_util.tree_map(lambda p: p.astype(cfg.dtype), params)
+    x = jax.random.normal(xk, (cfg.tokens, cfg.hidden_size), cfg.dtype)
+
+    if path == "fused":
+        overlapped = lambda c: fused_ep_moe_layer(
+            params, c, cfg, mesh, interpret=interpret).out
+    elif path == "collective":
+        overlapped = lambda c: ep_moe_layer(
+            params, c, cfg, mesh, use_pallas=interpret,
+            interpret=interpret).out
+    else:
+        raise ValueError(f"unknown path {path!r}")
+    compute_only = lambda c: ep_moe_layer(
+        params, c, cfg, mesh, use_pallas=interpret, interpret=interpret,
+        skip_exchange=True).out
+    comm_only = lambda c: _comm_only(c, cfg, mesh)
+
+    t_over = _time_chained(overlapped, x, trials=trials, chain=chain)
+    t_comp = _time_chained(compute_only, x, trials=trials, chain=chain)
+    t_comm = _time_chained(comm_only, x, trials=trials, chain=chain)
+    return {
+        "t_overlapped_ms": t_over * 1e3,
+        "t_compute_ms": t_comp * 1e3,
+        "t_comm_ms": t_comm * 1e3,
+        "overlap_efficiency": (t_comp + t_comm) / t_over,
+        "path": path,
+        "ep": ep,
+    }
