@@ -1,0 +1,65 @@
+"""Elastic resume: continue training after the world size changes.
+
+The reference has no elasticity at all — a dead worker stalls its NVSHMEM
+collectives forever (SURVEY §5; the sequence-bit protocol only tolerates
+*skipped* iterations, ``subscriber.cuh:104-137``).  The TPU-native story is
+checkpoint resharding: every array in the TrainState is a logical global
+array whose sharding is a layout annotation, so resuming on a different
+device count is "rebuild the mesh, restore the checkpoint into the new
+shardings" — orbax reshards on read.  Combined with
+:mod:`flashmoe_tpu.runtime.resilient` (in-job detection + restore), this
+covers the scheduler-restarts-the-job-smaller/larger case.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.parallel.mesh import make_mesh
+from flashmoe_tpu.runtime import checkpoint as ckpt
+from flashmoe_tpu.runtime.trainer import (
+    TrainState, init_state, make_optimizer, state_shardings,
+)
+
+
+def fold_parallelism(cfg: MoEConfig, n_devices: int) -> MoEConfig:
+    """Fit the config's parallelism to the CURRENT device count: ep folds
+    down to the largest divisor of num_experts that fits, dp absorbs the
+    rest (same folding bootstrap.initialize applies at first start)."""
+    ep = min(cfg.ep if cfg.ep > 1 else n_devices, n_devices)
+    while ep > 1 and (cfg.num_experts % ep or n_devices % ep):
+        ep -= 1
+    return cfg.replace(ep=max(1, ep), dp=max(1, n_devices // max(1, ep)),
+                       pp=1, tp=1, sp=1)
+
+
+def elastic_resume(cfg: MoEConfig, checkpoint_dir: str, *,
+                   devices=None, optimizer=None, total_steps: int = 10000):
+    """Rebuild mesh + shardings for the current device set and restore the
+    latest checkpoint into them.
+
+    Returns (state, mesh, cfg', optimizer).  The restored arrays land
+    resharded over the NEW mesh regardless of the world size that wrote
+    the checkpoint.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    cfg = fold_parallelism(cfg, len(devices))
+    mesh = make_mesh(cfg, devices=devices)
+    optimizer = optimizer or make_optimizer(cfg, total_steps=total_steps)
+
+    step = ckpt.latest_step(checkpoint_dir)
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint in {checkpoint_dir}")
+    # abstract template only — never materialize a second copy of the model
+    template = jax.eval_shape(
+        lambda: init_state(jax.random.PRNGKey(0), cfg, optimizer)
+    )
+    shardings = state_shardings(template, cfg, mesh)
+    abstract = jax.tree_util.tree_map(
+        lambda x, sh: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+        if hasattr(x, "shape") else x,
+        template, shardings,
+    )
+    state = ckpt.restore(checkpoint_dir, abstract, step=step)
+    return state, mesh, cfg, optimizer

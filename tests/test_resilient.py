@@ -103,6 +103,33 @@ def test_retry_budget_exhausted(devices, tmp_path):
                         fail_injector=always_fail)
 
 
+def test_elastic_resume_smaller_world(devices, tmp_path):
+    """World-size change: train on an ep=4 mesh, then resume on HALF the
+    devices — the checkpoint reshards into the new mesh and training
+    continues (the elasticity the reference's stalled collectives can
+    never provide)."""
+    from flashmoe_tpu.runtime.elastic import elastic_resume
+    from flashmoe_tpu.runtime import checkpoint as ckpt
+
+    state, step, data = _fixture(devices)
+    rcfg = ResilienceConfig(checkpoint_dir=str(tmp_path / "ck_el"),
+                            checkpoint_every=2)
+    mid, _ = resilient_train(state, step, data, num_steps=2, rcfg=rcfg)
+    assert ckpt.latest_step(rcfg.checkpoint_dir) == 2
+
+    # "restart" on 4 devices: ep folds 4 -> 2 (divides E=4), dp absorbs
+    new_state, new_mesh, new_cfg, opt = elastic_resume(
+        CFG, rcfg.checkpoint_dir, devices=devices[:4])
+    assert int(new_state.step) == 2
+    assert dict(new_mesh.shape)["ep"] * dict(new_mesh.shape)["dp"] == 4
+    step2 = make_train_step(new_cfg, new_mesh, opt)
+    batch = {"tokens": jax.random.randint(
+        jax.random.PRNGKey(99), (2, 33), 0, 256)}
+    out_state, m = step2(new_state, batch)
+    assert int(out_state.step) == 3
+    assert np.isfinite(float(m["loss"]))
+
+
 def test_resumes_from_existing_checkpoint(devices, tmp_path):
     state, step, data = _fixture(devices)
     rcfg = ResilienceConfig(checkpoint_dir=str(tmp_path / "ck3"),
