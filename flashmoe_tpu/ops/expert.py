@@ -511,10 +511,15 @@ def _gffn_fwd(x, tile_gid, w_up, b_up, w_down, b_down, w_gate,
     return y, (x, tile_gid, w_up, b_up, w_down, b_down, w_gate, u, g)
 
 
-def _gffn_bwd(act_name, gated, block_m, block_i, interpret, res, dy):
-    import numpy as np
+def ffn_backward_core(x, tile_gid, w_up, w_down, w_gate, u, g, dy, *,
+                      act_name, gated, block_m, interpret):
+    """Shared backward math over pre-activation residuals (u, g).
 
-    x, tile_gid, w_up, b_up, w_down, b_down, w_gate, u, g = res
+    All four large GEMMs run on the Pallas kernels: dHidden and dX via
+    :func:`grouped_matmul` (transposed-weight contraction), dW via
+    :func:`tgmm`.  Returns float32 (dx, d_wu, d_bu, d_wd, d_bd, d_wg) —
+    d_wg is None when not gated.  Used by both the single-device grouped
+    FFN VJP and the fused EP layer's VJP."""
     act = activation_fn(act_name)
     e = w_up.shape[0]
     dyc = dy.astype(x.dtype)
@@ -540,7 +545,6 @@ def _gffn_bwd(act_name, gated, block_m, block_i, interpret, res, dy):
         )
         d_wg = tgmm(x, d_gate.astype(x.dtype), tile_gid, e,
                     block_m=block_m, interpret=interpret)
-        ct_wg = d_wg.astype(w_gate.dtype)
     else:
         act_u, act_vjp = jax.vjp(act, uf)
         d_up = act_vjp(d_hidden)[0]
@@ -549,14 +553,26 @@ def _gffn_bwd(act_name, gated, block_m, block_i, interpret, res, dy):
             d_up.astype(x.dtype), tile_gid, w_up, transpose_w=True,
             block_m=block_m, out_dtype=jnp.float32, interpret=interpret,
         )
-        ct_wg = None
+        d_wg = None
     d_wu = tgmm(x, d_up.astype(x.dtype), tile_gid, e,
                 block_m=block_m, interpret=interpret)
     d_wd = tgmm(hidden, dyc, tile_gid, e,
                 block_m=block_m, interpret=interpret)
     d_bu = _segment_bias_grad(d_up, tile_gid, e, block_m)
     d_bd = _segment_bias_grad(dy.astype(jnp.float32), tile_gid, e, block_m)
+    return dx, d_wu, d_bu, d_wd, d_bd, d_wg
 
+
+def _gffn_bwd(act_name, gated, block_m, block_i, interpret, res, dy):
+    import numpy as np
+
+    x, tile_gid, w_up, b_up, w_down, b_down, w_gate, u, g = res
+    dx, d_wu, d_bu, d_wd, d_bd, d_wg = ffn_backward_core(
+        x, tile_gid, w_up, w_down, w_gate, u, g, dy,
+        act_name=act_name, gated=gated, block_m=block_m,
+        interpret=interpret,
+    )
+    ct_wg = d_wg.astype(w_gate.dtype) if gated else None
     ct_gid = np.zeros(tile_gid.shape, jax.dtypes.float0)
     return (dx.astype(x.dtype), ct_gid, d_wu.astype(w_up.dtype),
             d_bu.astype(b_up.dtype), d_wd.astype(w_down.dtype),

@@ -419,6 +419,98 @@ def _fused_shard(send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down, *,
     return y_recv
 
 
+# ----------------------------------------------------------------------
+# Differentiable core: Pallas forward, Pallas-GEMM backward
+# ----------------------------------------------------------------------
+#
+# The kernel's dataflow is  x_send --a2a--> x_recv --FFN--> y_stage
+# --a2a--> y_recv.  ``all_to_all(split=concat=0)`` is its own transpose,
+# so the VJP re-exchanges the cotangents/primals with XLA collectives
+# (cheap next to the FFN FLOPs) and runs every large GEMM — the
+# pre-activation recompute, dHidden/dX, and both dW — through the Pallas
+# grouped kernels (:func:`flashmoe_tpu.ops.expert.ffn_backward_core`).
+# Expert shards are disjoint across ep ranks, so dW needs no psum.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11, 12))
+def _fused_core(send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down,
+                w_gate, cfg, axis, interpret, collective_id, detect_races):
+    return _fused_shard(
+        send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down,
+        cfg=cfg, axis=axis, interpret=interpret,
+        collective_id=collective_id, detect_races=detect_races,
+        w_gate=w_gate,
+    )
+
+
+def _fused_core_fwd(send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down,
+                    w_gate, cfg, axis, interpret, collective_id,
+                    detect_races):
+    y = _fused_core(send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down,
+                    w_gate, cfg, axis, interpret, collective_id,
+                    detect_races)
+    return y, (send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down,
+               w_gate)
+
+
+def _fused_core_bwd(cfg, axis, interpret, collective_id, detect_races,
+                    res, dy):
+    import numpy as np
+
+    from flashmoe_tpu.ops.expert import (
+        _auto_block, ffn_backward_core, grouped_matmul,
+    )
+
+    send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down, w_gate = res
+    d, nlx, cap, h = x_send.shape
+    gated = w_gate is not None
+
+    a2a = functools.partial(
+        jax.lax.all_to_all, axis_name=axis, split_axis=0, concat_axis=0,
+        tiled=False,
+    )
+    x_recv = a2a(x_send)       # recompute received slabs (fwd exchange)
+    dy_stage = a2a(dy)         # transpose of the return exchange
+
+    def to_rows(t):            # [D, nlx, cap, h] -> [nlx*D*cap, h]
+        return t.transpose(1, 0, 2, 3).reshape(nlx * d * cap, h)
+
+    def from_rows(r):
+        return r.reshape(nlx, d, cap, h).transpose(1, 0, 2, 3)
+
+    xr = to_rows(x_recv)
+    dyr = to_rows(dy_stage)
+    bm = _auto_block(cap, 256)
+    tiles_per_e = (d * cap) // bm
+    gid = jnp.arange(nlx * tiles_per_e, dtype=jnp.int32) // tiles_per_e
+
+    # recompute pre-activations through the Pallas grouped matmul
+    i_dim = w_up.shape[2]
+    u = grouped_matmul(xr, gid, w_up, block_m=bm, out_dtype=jnp.float32,
+                       interpret=interpret)
+    u = (u.reshape(nlx, d * cap, i_dim)
+         + b_up[:, None, :].astype(jnp.float32)).reshape(-1, i_dim)
+    g = None
+    if gated:
+        g = grouped_matmul(xr, gid, w_gate, block_m=bm,
+                           out_dtype=jnp.float32, interpret=interpret)
+
+    dxr, d_wu, d_bu, d_wd, d_bd, d_wg = ffn_backward_core(
+        xr, gid, w_up, w_down, w_gate, u, g, dyr,
+        act_name=cfg.hidden_act, gated=gated, block_m=bm,
+        interpret=interpret,
+    )
+    d_x_send = a2a(from_rows(dxr.astype(x_send.dtype)))
+
+    f0 = lambda a: np.zeros(a.shape, jax.dtypes.float0)
+    return (f0(send_cnt), f0(recv_cnt), d_x_send,
+            d_wu.astype(w_up.dtype), d_bu.astype(b_up.dtype),
+            d_wd.astype(w_down.dtype), d_bd.astype(b_down.dtype),
+            d_wg.astype(w_gate.dtype) if gated else None)
+
+
+_fused_core.defvjp(_fused_core_fwd, _fused_core_bwd)
+
+
 def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
                        interpret: bool = False,
                        use_pallas_gate: bool | None = None,
@@ -467,14 +559,13 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
             tiled=False,
         ).reshape(d, nlx)
 
-        y_recv = _fused_shard(
+        y_recv = _fused_core(
             send_cnt, recv_cnt, x_send,
             params["w_up"].astype(cfg.dtype), params["b_up"],
             params["w_down"].astype(cfg.dtype), params["b_down"],
-            cfg=cfg, axis="ep", interpret=interpret,
-            collective_id=collective_id, detect_races=detect_races,
-            w_gate=(params["w_gate"].astype(cfg.dtype)
-                    if cfg.gated_ffn else None),
+            (params["w_gate"].astype(cfg.dtype)
+             if cfg.gated_ffn else None),
+            cfg, "ep", interpret, collective_id, detect_races,
         )
         ybuf = y_recv.reshape(cfg.num_experts, cap_pad, h)
         out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap_pad)
