@@ -84,13 +84,24 @@ def _block_in_stage(layer, x, cfg: MoEConfig, li: int, use_ep: bool):
 
 
 def _stage_apply(stage_layers, x, cfg: MoEConfig, lps: int,
-                 use_ep: bool = False):
-    """Run this rank's ``lps`` layers on x: [B, T, H]."""
+                 use_ep: bool = False, remat: bool = True):
+    """Run this rank's ``lps`` layers on x: [B, T, H].
+
+    Per-layer rematerialization bounds the pipeline's activation memory to
+    one layer per in-flight microbatch — the memory profile 1F1B buys on
+    imperative runtimes, obtained here by letting XLA recompute inside the
+    GPipe schedule instead of hand-interleaving backward ticks."""
     aux = jnp.zeros((), cfg.accum_dtype)
     li0 = 0 if cfg.num_experts == 1 else cfg.moe_layer_indices[0]
+    apply = functools.partial(_block_in_stage, cfg=cfg, li=li0,
+                              use_ep=use_ep)
+    if remat:
+        apply = jax.checkpoint(
+            apply, policy=jax.checkpoint_policies.nothing_saveable,
+        )
     for li in range(lps):
         layer = jax.tree_util.tree_map(lambda a: a[li], stage_layers)
-        x, moe_loss = _block_in_stage(layer, x, cfg, li0, use_ep)
+        x, moe_loss = apply(layer, x)
         aux = aux + moe_loss
     return x, aux
 
