@@ -75,7 +75,36 @@ def bench_moe_layer(cfg: MoEConfig, trials: int, chain: int = 16):
     return out["fused"], out["xla"]
 
 
+_PEAK_TFLOPS = {
+    # bf16 peak matmul TFLOP/s per chip (public spec sheets)
+    "v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0,
+}
+
+
+def _layer_flops(cfg: MoEConfig) -> float:
+    """Model FLOPs of one MoE layer forward: gate GEMM + routed expert
+    FFN (2 or 3 GEMMs per token-slot)."""
+    gate = 2.0 * cfg.tokens * cfg.hidden_size * cfg.num_experts
+    rows = cfg.tokens * cfg.expert_top_k
+    gemms = 3 if cfg.gated_ffn else 2
+    ffn = gemms * 2.0 * rows * cfg.hidden_size * cfg.intermediate_size
+    return gate + ffn
+
+
+def _mxu_util(cfg: MoEConfig, seconds: float) -> float | None:
+    """Achieved fraction of peak MXU throughput — the TPU analogue of the
+    reference's headline SM-utilization metric (``README.md:43-44``,
+    ``plots/sm_util.png``), computed from model FLOPs over wall time."""
+    from flashmoe_tpu.parallel.topology import tpu_generation
+
+    peak = _PEAK_TFLOPS.get(tpu_generation(jax.devices()[0]))
+    if peak is None or seconds <= 0:
+        return None
+    return _layer_flops(cfg) / seconds / (peak * 1e12)
+
+
 def _emit(cfg, name, t_fused, t_xla):
+    util = _mxu_util(cfg, t_fused)
     print(json.dumps({
         "metric": f"moe_layer_fwd_ms[{name}:E={cfg.num_experts},"
                   f"k={cfg.expert_top_k},H={cfg.hidden_size},"
@@ -86,6 +115,7 @@ def _emit(cfg, name, t_fused, t_xla):
         "vs_baseline": round(t_xla / t_fused, 3),
         "tokens_per_sec_per_chip": round(cfg.tokens / t_fused),
         "xla_path_ms": round(t_xla * 1e3, 3),
+        "mxu_util": round(util, 4) if util is not None else None,
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -135,6 +165,55 @@ def _bench_overlap(ep: int, trials: int):
     }), flush=True)
 
 
+def _sweep_ep(trials: int):
+    """Weak-scaling sweep over the ep axis: per-rank tokens held constant
+    while the mesh grows (the reference's ``scaling_gpus_8`` axis).
+    Virtual CPU mesh when multi-chip hardware is absent; identical
+    procedure on real chips (FLASHMOE_OVERLAP_TPU=1)."""
+    import os
+
+    from flashmoe_tpu.parallel.mesh import make_mesh
+    from flashmoe_tpu.parallel.overlap import _time_chained
+    from flashmoe_tpu.parallel.ep import ep_moe_layer
+    from flashmoe_tpu.models.reference import init_moe_params
+
+    on_tpu = os.environ.get("FLASHMOE_OVERLAP_TPU") == "1"
+    if not on_tpu:
+        from __graft_entry__ import _force_cpu_devices
+        _force_cpu_devices(8)
+        devs = jax.devices("cpu")
+    else:
+        devs = jax.devices()
+    base_t = None
+    for ep in (2, 4, 8):
+        if len(devs) < ep:
+            break
+        cfg = MoEConfig(
+            num_experts=16, expert_top_k=2, hidden_size=256,
+            intermediate_size=512, sequence_len=256 * ep,
+            capacity_factor=1.0, drop_tokens=True, ep=ep,
+            dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        )
+        mesh = make_mesh(cfg, dp=1, devices=devs[:ep])
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(cfg.dtype), params)
+        x = jax.random.normal(
+            jax.random.PRNGKey(1), (cfg.tokens, cfg.hidden_size), cfg.dtype)
+        fn = lambda c: ep_moe_layer(params, c, cfg, mesh,
+                                    use_pallas=on_tpu).out
+        t = _time_chained(fn, x, trials=trials, chain=8)
+        base_t = base_t or t
+        print(json.dumps({
+            "metric": f"weak_scaling_ms[collective,ep={ep},"
+                      f"tokens_per_rank=256,"
+                      f"{'tpu' if on_tpu else 'virtual_cpu'}]",
+            "value": round(t * 1e3, 3),
+            "unit": "ms",
+            "vs_baseline": round(base_t / t, 3),  # weak-scaling efficiency
+        }), flush=True)
+
+
 def _probe_backend(timeout_s: int):
     """Run one trivial op on the default backend in a subprocess with a hard
     timeout.  The tunneled TPU backend can wedge so that even ``jax.devices()``
@@ -159,9 +238,11 @@ def main():
                     choices=sorted(BENCH_CONFIGS.keys()))
     ap.add_argument("--trials", type=int, default=7)
     ap.add_argument("--chain", type=int, default=16)
-    ap.add_argument("--sweep", choices=["tokens", "experts"], default=None,
+    ap.add_argument("--sweep", choices=["tokens", "experts", "ep"],
+                    default=None,
                     help="emit one JSON line per point instead of the "
-                         "single headline number")
+                         "single headline number (ep = weak scaling on "
+                         "an ep-way mesh)")
     ap.add_argument("--overlap", type=int, default=0, metavar="EP",
                     help="measure overlap efficiency on an EP-way mesh "
                          "instead of the latency bench")
@@ -185,6 +266,9 @@ def main():
 
     if args.overlap:
         _bench_overlap(args.overlap, args.trials)
+        return
+    if args.sweep == "ep":
+        _sweep_ep(args.trials)
         return
 
     ok, info = _probe_backend(timeout_s=min(120, args.deadline or 120))

@@ -116,6 +116,11 @@ class MoEConfig:
     sp: int = 1  # sequence/context parallel
     pp: int = 1  # pipeline parallel
 
+    # distributed MoE transport when ep > 1: "collective" (XLA all-to-all,
+    # the robust default), "fused" (in-kernel RDMA, the FlashDMoE path),
+    # "ragged" (dropless ragged all-to-all)
+    moe_backend: str = "collective"
+
     def __post_init__(self):
         if self.num_experts < 1:
             raise ValueError("num_experts must be >= 1")
@@ -130,6 +135,23 @@ class MoEConfig:
             raise ValueError("num_experts must divide evenly over ep")
         if self.capacity_factor <= 0:
             raise ValueError("capacity_factor must be > 0")
+        if self.moe_backend not in ("collective", "fused", "ragged"):
+            raise ValueError(
+                f"moe_backend {self.moe_backend!r} not in "
+                f"('collective', 'fused', 'ragged')"
+            )
+        # reject combinations the specialized transports cannot serve
+        # rather than silently falling back to the collective path
+        if self.moe_backend in ("fused", "ragged") and self.tp > 1:
+            raise ValueError(
+                f"moe_backend={self.moe_backend!r} does not compose with "
+                f"tp>1; use moe_backend='collective'"
+            )
+        if self.moe_backend == "ragged" and self.num_shared_experts:
+            raise ValueError(
+                "moe_backend='ragged' does not support shared experts; "
+                "use 'collective' or 'fused'"
+            )
 
     # ------------------------------------------------------------------
     # Derived quantities (ACC equivalents, types.cuh:441-512)

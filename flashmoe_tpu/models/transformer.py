@@ -157,9 +157,30 @@ def _ffn(layer, x, cfg: MoEConfig, li: int, mesh, use_pallas):
     )
     if mesh is not None and layer_cfg.num_experts > 1 and cfg.ep > 1:
         axes = ("dp", "ep") + (("sp",) if cfg.sp > 1 else ())
-        o = ep_moe_layer(layer["moe"], flat, layer_cfg, mesh,
-                         use_pallas=bool(use_pallas),
-                         token_axes=axes)
+        if cfg.moe_backend == "fused" and cfg.tp == 1:
+            from flashmoe_tpu.parallel.fused import fused_ep_moe_layer
+
+            # distinct collective_id per layer: each fused kernel in the
+            # step needs its own barrier-semaphore identity
+            # the fused layer IS a Pallas kernel — interpret it anywhere
+            # but on real TPU, independent of the use_pallas preference
+            o = fused_ep_moe_layer(layer["moe"], flat, layer_cfg, mesh,
+                                   token_axes=axes,
+                                   collective_id=7 + (li % 16),
+                                   interpret=jax.default_backend() != "tpu")
+        elif (cfg.moe_backend == "ragged" and cfg.tp == 1
+                and not layer_cfg.num_shared_experts):
+            from flashmoe_tpu.parallel.ragged_ep import ragged_ep_moe_layer
+
+            o = ragged_ep_moe_layer(layer["moe"], flat, layer_cfg, mesh,
+                                    use_pallas=bool(use_pallas),
+                                    interpret=bool(use_pallas)
+                                    and jax.default_backend() != "tpu",
+                                    token_axes=axes)
+        else:
+            o = ep_moe_layer(layer["moe"], flat, layer_cfg, mesh,
+                             use_pallas=bool(use_pallas),
+                             token_axes=axes)
     else:
         o = moe_layer(layer["moe"], flat, layer_cfg, use_pallas=use_pallas)
     return o.out.reshape(b, t, h).astype(x.dtype), o.aux_loss + o.z_loss
@@ -185,7 +206,15 @@ def forward(params, tokens, cfg: MoEConfig, mesh=None, use_pallas=None):
     x = params["embed"].astype(cfg.dtype)[tokens]
     total_aux = jnp.zeros((), cfg.accum_dtype)
     blk = block
-    if cfg.is_training:
+    # per-block remat keeps HBM bounded; excluded exactly when the fused
+    # RDMA backend actually runs (same condition as _ffn's fused branch —
+    # its kernel's side effects cannot be partially evaluated under
+    # checkpoint, and its custom VJP already avoids storing the exchange
+    # intermediates)
+    fused_active = (cfg.moe_backend == "fused" and cfg.ep > 1
+                    and cfg.tp == 1 and mesh is not None
+                    and cfg.num_experts > 1)
+    if cfg.is_training and not fused_active:
         blk = jax.checkpoint(
             block, static_argnums=(2, 3, 4, 5),
             policy=jax.checkpoint_policies.nothing_saveable,

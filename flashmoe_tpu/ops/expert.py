@@ -34,6 +34,10 @@ from jax.experimental.pallas import tpu as pltpu
 from flashmoe_tpu.config import BLOCK_M, MoEConfig
 from flashmoe_tpu.models.reference import activation_fn
 
+# default intermediate-dimension chunk for the grouped kernels (VMEM
+# working-set sizing); call sites share this instead of bare literals
+DEFAULT_BLOCK_I = 512
+
 
 # ----------------------------------------------------------------------
 # XLA path: batched over the capacity buffer
@@ -112,7 +116,7 @@ def _ffn_kernel(gid_ref, x_ref, wup_ref, bup_ref, wdn_ref, bdn_ref, out_ref,
 )
 def grouped_ffn(x, tile_gid, w_up, b_up, w_down, b_down, w_gate=None, *,
                 act_name: str, gated: bool = False, block_m: int = BLOCK_M,
-                block_i: int = 512, interpret: bool = False):
+                block_i: int = DEFAULT_BLOCK_I, interpret: bool = False):
     """Grouped FFN over row-sorted tokens.
 
     x:        [T, H] tokens, grouped so rows of one row-tile share an expert.

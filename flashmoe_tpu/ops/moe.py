@@ -72,7 +72,8 @@ def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
             params["w_up"].astype(cfg.dtype), params["b_up"],
             params["w_down"].astype(cfg.dtype), params["b_down"],
             params.get("w_gate", None) if cfg.gated_ffn else None,
-            cfg.hidden_act, cfg.gated_ffn, bm, 512, interpret,
+            cfg.hidden_act, cfg.gated_ffn, bm, exp.DEFAULT_BLOCK_I,
+            interpret,
         )
         out = rag.ragged_combine(ybuf, plan, r.combine_weights, cfg)
     else:

@@ -165,13 +165,16 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
 
     # ---- expert FFN on the local shard of weights ----
     if use_pallas:
-        y_grp = exp.grouped_ffn(
+        # _ad variant: Pallas forward AND Pallas backward (grouped_matmul/
+        # tgmm with saved residuals) — the dropless path trains through
+        # the kernels too
+        y_grp = exp.grouped_ffn_ad(
             x_grp, tile_gid,
             params["w_up"].astype(cfg.dtype), params["b_up"],
             params["w_down"].astype(cfg.dtype), params["b_down"],
             params.get("w_gate", None) if cfg.gated_ffn else None,
-            act_name=cfg.hidden_act, gated=cfg.gated_ffn,
-            block_m=block_m, interpret=interpret,
+            cfg.hidden_act, cfg.gated_ffn, block_m,
+            exp.DEFAULT_BLOCK_I, interpret,
         )
     else:
         # XLA fallback: per-row weight selection via one-hot (test path)

@@ -105,6 +105,28 @@ def test_token_count_not_multiple_of_block(devices):
     )
 
 
+def test_pallas_path_gradients_match_xla_path(devices):
+    """The dropless pallas path must differentiate (grouped_ffn_ad) and
+    agree with the XLA-fallback path's gradients."""
+    cfg = MoEConfig(num_experts=4, expert_top_k=2, hidden_size=64,
+                    intermediate_size=128, sequence_len=256, ep=2, **F32)
+    params, x = _setup(cfg)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:2])
+
+    def loss(p, use_pallas):
+        o = ragged_ep_moe_layer(p, x, cfg, mesh, use_pallas=use_pallas,
+                                interpret=use_pallas, exchange="dense")
+        return (o.out.astype(jnp.float32) ** 2).sum()
+
+    gp = jax.grad(lambda p: loss(p, True))(params)
+    gx = jax.grad(lambda p: loss(p, False))(params)
+    for k in gx:
+        np.testing.assert_allclose(
+            np.asarray(gp[k]), np.asarray(gx[k]),
+            rtol=5e-3, atol=5e-3, err_msg=k,
+        )
+
+
 def test_pallas_grouped_ffn_path(devices):
     """The grouped Pallas kernel runs on the regrouped ragged buffer."""
     cfg = MoEConfig(num_experts=4, expert_top_k=2, hidden_size=128,

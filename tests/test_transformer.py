@@ -76,6 +76,44 @@ def test_optax_trainer_with_shardings(devices):
     assert "ep" in str(moe_w.sharding.spec) or moe_w.sharding.is_fully_replicated is False
 
 
+@pytest.mark.parametrize("backend", ["fused", "ragged"])
+def test_moe_backend_selection(backend, devices):
+    """The flagship model can route its distributed MoE through the fused
+    RDMA kernel or the dropless ragged layer and still match the default
+    collective path (forward AND gradients)."""
+    cfg = CFG.replace(ep=2, moe_backend=backend, moe_frequency=1,
+                      num_layers=1)
+    mesh = make_mesh(cfg, devices=devices[:2], dp=1)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    batch = _batch(cfg)
+
+    def loss_with(backend_name):
+        c = cfg.replace(moe_backend=backend_name)
+        return float(jax.jit(
+            lambda p, b: loss_fn(p, b, c, mesh, False)[0]
+        )(params, batch))
+
+    lb = loss_with(backend)
+    lc = loss_with("collective")
+    np.testing.assert_allclose(lb, lc, rtol=2e-4)
+
+    def grads_with(backend_name):
+        c = cfg.replace(moe_backend=backend_name)
+        return jax.jit(jax.grad(
+            lambda p: loss_fn(p, batch, c, mesh, False)[0]
+        ))(params)
+
+    gb = grads_with(backend)
+    gc = grads_with("collective")
+    fb, _ = jax.tree_util.tree_flatten_with_path(gb)
+    fc, _ = jax.tree_util.tree_flatten_with_path(gc)
+    for (path, a), (_, b) in zip(fb, fc):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
 def test_sequence_parallel_forward(devices):
     """sp=2: ring attention + EP MoE with tokens sharded over (ep, sp)."""
     cfg = CFG.replace(ep=2, sp=2, sequence_len=128)
