@@ -205,21 +205,22 @@ def forward(params, tokens, cfg: MoEConfig, mesh=None, use_pallas=None):
     aux losses."""
     x = params["embed"].astype(cfg.dtype)[tokens]
     total_aux = jnp.zeros((), cfg.accum_dtype)
-    blk = block
-    # per-block remat keeps HBM bounded; excluded exactly when the fused
-    # RDMA backend actually runs (same condition as _ffn's fused branch —
-    # its kernel's side effects cannot be partially evaluated under
-    # checkpoint, and its custom VJP already avoids storing the exchange
-    # intermediates)
+    # per-block remat keeps HBM bounded; excluded exactly for the blocks
+    # where the fused RDMA backend actually runs (same condition as _ffn's
+    # fused branch — its kernel's side effects cannot be partially
+    # evaluated under checkpoint, and its custom VJP already avoids
+    # storing the exchange intermediates).  Non-MoE blocks keep remat.
     fused_active = (cfg.moe_backend == "fused" and cfg.ep > 1
                     and cfg.tp == 1 and mesh is not None
                     and cfg.num_experts > 1)
-    if cfg.is_training and not fused_active:
-        blk = jax.checkpoint(
-            block, static_argnums=(2, 3, 4, 5),
-            policy=jax.checkpoint_policies.nothing_saveable,
-        )
+    blk_remat = jax.checkpoint(
+        block, static_argnums=(2, 3, 4, 5),
+        policy=jax.checkpoint_policies.nothing_saveable,
+    )
+    moe_layers = set(cfg.moe_layer_indices)
     for li, layer in enumerate(params["layers"]):
+        fused_block = fused_active and li in moe_layers
+        blk = blk_remat if (cfg.is_training and not fused_block) else block
         x, moe_loss = blk(layer, x, cfg, li, mesh, use_pallas)
         total_aux = total_aux + moe_loss
     x = rms_norm(x, params["final_norm"])

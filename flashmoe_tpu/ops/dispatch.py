@@ -71,26 +71,22 @@ def make_plan(expert_idx, cfg: MoEConfig, capacity: int) -> DispatchPlan:
     return DispatchPlan(expert_idx, pos, valid, counts)
 
 
-def dispatch(x, plan: DispatchPlan, cfg: MoEConfig, capacity: int):
-    """Gather tokens into the per-expert capacity buffer.
+def dispatch_indices(plan: DispatchPlan, cfg: MoEConfig, capacity: int):
+    """Source-token index per expert-capacity slot.
 
-    x: [S, H] -> [E, C, H].  Dropped/empty slots are zero (so the expert
-    GEMM over them contributes nothing after combine masks them out).
-
-    Formulated as sort + row-GATHER rather than a row-scatter: an H-wide
-    scatter serializes on TPU, while a stable argsort over the [K*S] expert
-    ids (k-major, so priority order matches :func:`make_plan`) followed by
-    one [E*C]-row dynamic gather runs at HBM bandwidth — the slabs are
-    built from token rows directly, the way the reference's super-blocks
-    gather from ``tokenIds`` (``packet.cuh:99-206``).
+    Returns ``(src_tok, present)``, both ``[E, capacity]``: ``src_tok`` is
+    the token id feeding each slot (slots past an expert's count point at
+    token 0 and are never read back by :func:`combine`), ``present`` marks
+    populated slots.  Computed as a stable argsort over the [K*S] expert
+    ids (k-major, so priority order matches :func:`make_plan`): the c-th
+    entry of expert e's sorted run is exactly the selection with position
+    c.  This index plane is what the gather-fused FFN kernel consumes to
+    build expert slabs from token rows on the fly — the analogue of the
+    reference's super-blocks gathering from ``tokenIds``
+    (``packet.cuh:99-206``).
     """
-    s, h = x.shape
-    k = plan.expert_idx.shape[1]
-    e = cfg.num_experts
-    # k-major flattening: index = kk*S + ss; stable sort groups by expert
-    # while preserving (k, token) priority order within each expert, so the
-    # c-th entry of expert e's run is exactly the selection with position c.
-    ef = plan.expert_idx.T.reshape(-1)
+    s, k = plan.expert_idx.shape
+    ef = plan.expert_idx.T.reshape(-1)  # k-major flattening: kk*S + ss
     order = jnp.argsort(ef, stable=True)
     tok_sorted = (order % s).astype(jnp.int32)  # token id per sorted entry
     offsets = jnp.cumsum(plan.counts) - plan.counts  # [E] exclusive
@@ -98,6 +94,21 @@ def dispatch(x, plan: DispatchPlan, cfg: MoEConfig, capacity: int):
     present = jnp.arange(capacity, dtype=jnp.int32)[None, :] < \
         plan.counts[:, None]
     src_tok = tok_sorted[jnp.clip(slot, 0, s * k - 1)]  # [E, C]
+    src_tok = jnp.where(present, src_tok, 0)
+    return src_tok, present
+
+
+def dispatch(x, plan: DispatchPlan, cfg: MoEConfig, capacity: int):
+    """Gather tokens into the per-expert capacity buffer.
+
+    x: [S, H] -> [E, C, H].  Dropped/empty slots are zero (so the expert
+    GEMM over them contributes nothing after combine masks them out).
+
+    Formulated as sort + row-GATHER rather than a row-scatter: an H-wide
+    scatter serializes on TPU, while the :func:`dispatch_indices` argsort
+    followed by one [E*C]-row dynamic gather runs at HBM bandwidth.
+    """
+    src_tok, present = dispatch_indices(plan, cfg, capacity)
     buf = jnp.where(present[..., None], x[src_tok], 0)
     return buf.astype(x.dtype)
 

@@ -189,6 +189,271 @@ def grouped_ffn(x, tile_gid, w_up, b_up, w_down, b_down, w_gate=None, *,
 
 
 # ----------------------------------------------------------------------
+# Gather-fused grouped kernel: expert slabs built from token rows on the
+# fly, never materializing the [E, C, H] dispatch buffer in HBM
+# ----------------------------------------------------------------------
+
+def _ffn_gather_kernel(gid_ref, tok_ref, x_ref, wup_ref, bup_ref, wdn_ref,
+                       bdn_ref, out_ref, xtile, acc_ref, sems, *,
+                       act_name, gated, block_m):
+    """One (row-tile, I-chunk) grid step with in-kernel token gather.
+
+    At each tile's first I-chunk the kernel issues per-row DMAs that pull
+    the NEXT tile's token rows from ``x`` (HBM) into the alternate VMEM
+    slab, then waits for the current tile's rows — the gather streams
+    behind the previous tile's GEMMs exactly like the reference's packet
+    stage building heap cells from ``tokenIds`` while processors compute
+    (``packet.cuh:99-206``).
+    """
+    ti = pl.program_id(0)
+    j = pl.program_id(1)
+    nt = pl.num_programs(0)
+    nj = pl.num_programs(1)
+    act = activation_fn(act_name)
+
+    def start_gather(tile, slot):
+        def body(i, _):
+            tok = tok_ref[tile * block_m + i]
+            pltpu.make_async_copy(
+                x_ref.at[pl.ds(tok, 1), :],
+                xtile.at[slot, pl.ds(i, 1), :],
+                sems.at[slot],
+            ).start()
+            return 0
+        jax.lax.fori_loop(0, block_m, body, 0)
+
+    def wait_gather(slot):
+        # per-row waits mirror the per-row starts one-for-one, so the
+        # semaphore balance is exact under either byte- or completion-
+        # counting DMA semantics
+        def body(i, _):
+            pltpu.make_async_copy(
+                x_ref.at[pl.ds(0, 1), :],
+                xtile.at[slot, pl.ds(i, 1), :],
+                sems.at[slot],
+            ).wait()
+            return 0
+        jax.lax.fori_loop(0, block_m, body, 0)
+
+    slot = jax.lax.rem(ti, 2)
+
+    @pl.when(j == 0)
+    def _():
+        @pl.when(ti == 0)
+        def _():
+            start_gather(0, 0)
+
+        @pl.when(ti + 1 < nt)
+        def _():
+            start_gather(ti + 1, jax.lax.rem(ti + 1, 2))
+
+        wait_gather(slot)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = xtile[slot]
+    if gated:
+        half = wup_ref.shape[2] // 2
+        g = jnp.dot(x, wup_ref[0, :, :half], preferred_element_type=jnp.float32)
+        up = jnp.dot(x, wup_ref[0, :, half:], preferred_element_type=jnp.float32)
+        up = up + bup_ref[0, 0, :].astype(jnp.float32)
+        hidden = act(g) * up
+    else:
+        up = jnp.dot(x, wup_ref[0], preferred_element_type=jnp.float32)
+        hidden = act(up + bup_ref[0, 0, :].astype(jnp.float32))
+    acc_ref[:] += jnp.dot(
+        hidden.astype(x.dtype), wdn_ref[0], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(j == nj - 1)
+    def _():
+        out_ref[:] = (
+            acc_ref[:] + bdn_ref[0, 0, :].astype(jnp.float32)
+        ).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("act_name", "gated", "block_m", "block_i",
+                              "interpret"),
+)
+def grouped_ffn_tokens(x, src_tok, tile_gid, w_up, b_up, w_down, b_down,
+                       w_gate=None, *, act_name: str, gated: bool = False,
+                       block_m: int = BLOCK_M,
+                       block_i: int = DEFAULT_BLOCK_I,
+                       interpret: bool = False):
+    """Grouped FFN reading token rows directly: the dispatch gather fused
+    into the kernel (no [T, H] grouped buffer ever hits HBM).
+
+    x:        [S, H] tokens in natural order (stays in HBM).
+    src_tok:  [T] int32 source token per slab row (expert-grouped order).
+    tile_gid: [T // block_m] int32 expert id owning each row tile.
+
+    Returns [T, H] in slab order.  Rows whose slot is unpopulated compute
+    on token 0's data; combine never reads them.  Forward-only: the
+    training path keeps the explicit dispatch so the backward has its
+    residuals (see :func:`grouped_ffn_ad`).
+    """
+    s, h = x.shape
+    (t,) = src_tok.shape
+    e, _, i = w_up.shape
+    if t % block_m:
+        raise ValueError(f"slab rows {t} must be a multiple of {block_m}")
+    bi = _auto_block(i, block_i)
+    nt, nj = t // block_m, i // bi
+
+    if gated:
+        if w_gate is None:
+            raise ValueError("gated_ffn requires w_gate")
+        wg = w_gate.reshape(e, h, nj, bi)
+        wu = w_up.reshape(e, h, nj, bi)
+        w_up_eff = jnp.concatenate([wg, wu], axis=-1).reshape(e, h, nj * 2 * bi)
+        up_block = (1, h, 2 * bi)
+    else:
+        w_up_eff = w_up
+        up_block = (1, h, bi)
+    b_up3 = b_up.reshape(e, 1, i)
+    b_down3 = b_down.reshape(e, 1, h)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nt, nj),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # x: full [S, H] in HBM
+            pl.BlockSpec(up_block, lambda ti, j, gid, tok: (gid[ti], 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bi), lambda ti, j, gid, tok: (gid[ti], 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bi, h), lambda ti, j, gid, tok: (gid[ti], j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, h), lambda ti, j, gid, tok: (gid[ti], 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_m, h), lambda ti, j, gid, tok: (ti, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_m, h), x.dtype),
+            pltpu.VMEM((block_m, h), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    flops = 2 * t * h * i * (3 if gated else 2)
+    return pl.pallas_call(
+        functools.partial(_ffn_gather_kernel, act_name=act_name, gated=gated,
+                          block_m=block_m),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, h), x.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=flops,
+            bytes_accessed=t * h * x.dtype.itemsize * 2
+            + w_up_eff.size * w_up_eff.dtype.itemsize
+            + w_down.size * w_down.dtype.itemsize,
+            transcendentals=t * i,
+        ),
+        interpret=interpret,
+    )(tile_gid, src_tok, x, w_up_eff, b_up3, w_down, b_down3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11, 12))
+def _grouped_ffn_tokens_ad(x, src_tok, tile_gid, w_up, b_up, w_down, b_down,
+                           w_gate, act_name, gated, block_m, block_i,
+                           interpret):
+    """Differentiable wrapper over :func:`grouped_ffn_tokens`.
+
+    The forward is the cheap gather-fused kernel (no residuals written);
+    under differentiation the backward re-gathers the slab rows and
+    reuses the residual-saving grouped-FFN VJP, scattering dX back to
+    token order.  Costs one extra forward recompute — only paid when
+    someone actually differentiates through the inference path."""
+    return grouped_ffn_tokens(
+        x, src_tok, tile_gid, w_up, b_up, w_down, b_down, w_gate,
+        act_name=act_name, gated=gated, block_m=block_m, block_i=block_i,
+        interpret=interpret,
+    )
+
+
+def _gft_fwd(x, src_tok, tile_gid, w_up, b_up, w_down, b_down, w_gate,
+             act_name, gated, block_m, block_i, interpret):
+    y = grouped_ffn_tokens(
+        x, src_tok, tile_gid, w_up, b_up, w_down, b_down, w_gate,
+        act_name=act_name, gated=gated, block_m=block_m, block_i=block_i,
+        interpret=interpret,
+    )
+    return y, (x, src_tok, tile_gid, w_up, b_up, w_down, b_down, w_gate)
+
+
+def _gft_bwd(act_name, gated, block_m, block_i, interpret, res, dy):
+    import numpy as np
+
+    x, src_tok, tile_gid, w_up, b_up, w_down, b_down, w_gate = res
+    xb = x[src_tok]
+    if gated:
+        def f(xb_, wu, bu, wd, bd, wg):
+            return grouped_ffn_ad(xb_, tile_gid, wu, bu, wd, bd, wg,
+                                  act_name, gated, block_m, block_i,
+                                  interpret)
+        _, vjp = jax.vjp(f, xb, w_up, b_up, w_down, b_down, w_gate)
+        dxb, dwu, dbu, dwd, dbd, dwg = vjp(dy)
+    else:
+        def f(xb_, wu, bu, wd, bd):
+            return grouped_ffn_ad(xb_, tile_gid, wu, bu, wd, bd, None,
+                                  act_name, gated, block_m, block_i,
+                                  interpret)
+        _, vjp = jax.vjp(f, xb, w_up, b_up, w_down, b_down)
+        dxb, dwu, dbu, dwd, dbd = vjp(dy)
+        dwg = None
+    dx = jnp.zeros(x.shape, jnp.float32).at[
+        src_tok].add(dxb.astype(jnp.float32)).astype(x.dtype)
+    ct_int = lambda a: np.zeros(a.shape, jax.dtypes.float0)
+    return (dx, ct_int(src_tok), ct_int(tile_gid), dwu, dbu, dwd, dbd, dwg)
+
+
+_grouped_ffn_tokens_ad.defvjp(_gft_fwd, _gft_bwd)
+
+
+def _capacity_tiling(c: int) -> tuple[int, int, int]:
+    """Shared row-tile selection for the capacity-buffer kernels: returns
+    ``(block_m, padded_capacity, block_i)``.  Capacities <= 512 round up
+    to the sublane multiple (each expert's weights stream through VMEM
+    exactly once); larger ones tile at the largest dividing block."""
+    if c <= 512:
+        bm = ((c + 7) // 8) * 8
+    else:
+        bm = next(b for b in (512, 256, 128) if c % b == 0) if any(
+            c % b == 0 for b in (512, 256, 128)
+        ) else 512
+    cp = ((c + bm - 1) // bm) * bm
+    block_i = 512 if bm <= 256 else 256
+    return bm, cp, block_i
+
+
+def capacity_ffn_gather(x, plan, cfg: MoEConfig, capacity: int, params, *,
+                        interpret: bool = False):
+    """Capacity-path FFN with the dispatch gather fused into the kernel.
+
+    Pads capacity to the row-tile size, derives per-slot source tokens
+    from the plan, and runs the gather-fused kernel (differentiable via
+    re-gather, :func:`_grouped_ffn_tokens_ad`).  Returns ``([E, Cp, H],
+    Cp)`` — combine must use the padded capacity so flat slot indices
+    line up.
+    """
+    from flashmoe_tpu.ops import dispatch as dsp
+
+    _, h = x.shape
+    e = cfg.num_experts
+    bm, cp, block_i = _capacity_tiling(capacity)
+    src_tok, _ = dsp.dispatch_indices(plan, cfg, cp)
+    tiles_per_e = cp // bm
+    tile_gid = jnp.arange(e * tiles_per_e, dtype=jnp.int32) // tiles_per_e
+    y = _grouped_ffn_tokens_ad(
+        x, src_tok.reshape(-1), tile_gid,
+        params["w_up"].astype(x.dtype), params["b_up"],
+        params["w_down"].astype(x.dtype), params["b_down"],
+        params.get("w_gate", None) if cfg.gated_ffn else None,
+        cfg.hidden_act, cfg.gated_ffn, bm, block_i, interpret,
+    )
+    return y.reshape(e, cp, h), cp
+
+
+# ----------------------------------------------------------------------
 # Grouped matmul / transposed grouped matmul — the backward kernels
 # ----------------------------------------------------------------------
 
@@ -593,19 +858,12 @@ def capacity_buffer_ffn_ad(xs, params, cfg: MoEConfig,
     reshaping as :func:`capacity_buffer_ffn_pallas` — autodiff flows
     through the reshapes natively."""
     e, c, h = xs.shape
-    if c <= 512:
-        bm = ((c + 7) // 8) * 8
-    else:
-        bm = next(b for b in (512, 256, 128) if c % b == 0) if any(
-            c % b == 0 for b in (512, 256, 128)
-        ) else 512
-    cp = ((c + bm - 1) // bm) * bm
+    bm, cp, block_i = _capacity_tiling(c)
     if cp != c:
         xs = jnp.pad(xs, ((0, 0), (0, cp - c), (0, 0)))
     x = xs.reshape(e * cp, h)
     tiles_per_e = cp // bm
     tile_gid = jnp.arange(e * tiles_per_e, dtype=jnp.int32) // tiles_per_e
-    block_i = 512 if bm <= 256 else 256
     out = grouped_ffn_ad(
         x, tile_gid, params["w_up"].astype(x.dtype), params["b_up"],
         params["w_down"].astype(x.dtype), params["b_down"],
@@ -625,17 +883,7 @@ def capacity_buffer_ffn_pallas(xs, params, cfg: MoEConfig, *,
     never reads.
     """
     e, c, h = xs.shape
-    # Row tile sized to cover the whole per-expert capacity when it fits
-    # (<= 512 rows): each expert's weights then stream through VMEM exactly
-    # once.  Smaller capacities round up to the sublane multiple; larger
-    # ones tile at 512 (weights re-fetched once per 512 rows).
-    if c <= 512:
-        bm = ((c + 7) // 8) * 8
-    else:
-        bm = next(b for b in (512, 256, 128) if c % b == 0) if any(
-            c % b == 0 for b in (512, 256, 128)
-        ) else 512
-    cp = ((c + bm - 1) // bm) * bm
+    bm, cp, block_i = _capacity_tiling(c)
     if cp != c:
         xs = jnp.pad(xs, ((0, 0), (0, cp - c), (0, 0)))
     x = xs.reshape(e * cp, h)
@@ -643,8 +891,6 @@ def capacity_buffer_ffn_pallas(xs, params, cfg: MoEConfig, *,
     tile_gid = (
         jnp.arange(e * tiles_per_e, dtype=jnp.int32) // tiles_per_e
     )
-    # keep the chunked weight working set within VMEM alongside the row tile
-    block_i = 512 if bm <= 256 else 256
     out = grouped_ffn(
         x, tile_gid, params["w_up"].astype(x.dtype),
         params["b_up"], params["w_down"].astype(x.dtype), params["b_down"],

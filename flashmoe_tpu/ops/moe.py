@@ -81,13 +81,22 @@ def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
         # nominal sequence length (callers pass batched shards of any size)
         cap = capacity if capacity is not None else cfg.capacity_for(s)
         plan = dsp.make_plan(r.expert_idx, cfg, cap)
-        xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)  # [E, C, H]
-        if use_pallas:
-            ybuf = exp.capacity_buffer_ffn_ad(xbuf, params, cfg,
-                                              interpret=interpret)
+        if use_pallas and not cfg.is_training:
+            # inference: gather fused into the kernel — the [E, C, H]
+            # dispatch buffer never hits HBM (training keeps the explicit
+            # dispatch so the fused backward has its residuals)
+            ybuf, cap_p = exp.capacity_ffn_gather(
+                x.astype(cfg.dtype), plan, cfg, cap, params,
+                interpret=interpret)
+            out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap_p)
         else:
-            ybuf = exp.expert_ffn_dense(xbuf, params, cfg)
-        out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)  # [S,H] f32
+            xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)
+            if use_pallas:
+                ybuf = exp.capacity_buffer_ffn_ad(xbuf, params, cfg,
+                                                  interpret=interpret)
+            else:
+                ybuf = exp.expert_ffn_dense(xbuf, params, cfg)
+            out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)
     if cfg.num_shared_experts:
         out = out + shared_expert_ffn(x.astype(cfg.dtype), params, cfg).astype(
             out.dtype

@@ -1,0 +1,114 @@
+"""Per-stage latency breakdown of the fused MoE forward on live hardware.
+
+Times cumulative prefixes of the pipeline (router | +plan | +dispatch |
++ffn | +combine) with the chained-scan method from bench.py; successive
+differences isolate each stage.  Used to target the roofline gap
+(BASELINE.md: measured 2.75 ms vs ~1.8 ms roofline on the reference
+config).
+
+Usage: python scripts/stage_bench.py [--trials 5] [--chain 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from flashmoe_tpu.config import BENCH_CONFIGS
+from flashmoe_tpu.models.reference import init_moe_params
+from flashmoe_tpu.ops import dispatch as dsp
+from flashmoe_tpu.ops import expert as exp
+from flashmoe_tpu.ops.gate import router
+
+
+def make_prefix(params, cfg, depth: int, cap: int):
+    """Prefix through `depth` stages, ending in a scalar that feeds the
+    chain carry (dependency without materialization)."""
+
+    def fn(x):
+        r = router(x, params["gate_w"], cfg, use_pallas=True)
+        if depth == 0:
+            return r.combine_weights.astype(jnp.float32).sum()
+        plan = dsp.make_plan(r.expert_idx, cfg, cap)
+        if depth == 1:
+            return (plan.position.sum() + r.combine_weights.sum()).astype(
+                jnp.float32)
+        xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)
+        if depth == 2:
+            return xbuf.astype(jnp.float32).sum()
+        ybuf = exp.capacity_buffer_ffn_pallas(xbuf, params, cfg)
+        if depth == 3:
+            return ybuf.astype(jnp.float32).sum()
+        out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)
+        return out.sum()
+
+    return fn
+
+
+def chained(fn, x0, iters: int):
+    def run(x):
+        def body(c, _):
+            s = fn(c)
+            return c * (1.0 + 0.0 * s.astype(c.dtype)), None
+        c, _ = jax.lax.scan(body, x, None, length=iters)
+        return c.astype(jnp.float32).sum()
+    return jax.jit(run)
+
+
+def time_chain(fn, x, trials: int):
+    float(fn(x))
+    ts = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        float(fn(x))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=5)
+    ap.add_argument("--chain", type=int, default=8,
+                    help="longer chain length for the differencing pair "
+                         "(must be >= 2)")
+    ap.add_argument("--config", default="reference")
+    args = ap.parse_args()
+    if args.chain < 2:
+        ap.error("--chain must be >= 2 (per-iteration time comes from "
+                 "differencing two chain lengths)")
+
+    cfg = BENCH_CONFIGS[args.config].replace(ep=1)
+    cap = cfg.capacity_for(cfg.tokens)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    params = jax.tree_util.tree_map(lambda p: p.astype(cfg.dtype), params)
+    x = jax.random.normal(
+        jax.random.PRNGKey(1), (cfg.tokens, cfg.hidden_size), cfg.dtype)
+
+    # router alone is known-negligible (~0 ms: one [S,H]x[H,E] GEMM);
+    # three prefixes bound the interesting stages with 6 compiles instead
+    # of 10 (tunnel compiles are ~60-90 s each, RPC'd server-side)
+    names = {2: "router+plan+dispatch", 3: "+ffn", 4: "+combine"}
+    prev = 0.0
+    for depth, name in names.items():
+        fn = make_prefix(params, cfg, depth, cap)
+        t1 = time_chain(chained(fn, x, 1), x, args.trials)
+        tn = time_chain(chained(fn, x, args.chain), x, args.trials)
+        t = max(tn - t1, 0.0) / (args.chain - 1)
+        print(json.dumps({
+            "prefix": name, "cum_ms": round(t * 1e3, 3),
+            "stage_ms": round((t - prev) * 1e3, 3),
+        }), flush=True)
+        prev = t
+
+
+if __name__ == "__main__":
+    main()

@@ -92,6 +92,25 @@ def test_integrated_pallas_path_interpret():
     )
 
 
+@pytest.mark.parametrize("gated,cf", [(False, 1.0), (True, 1.25),
+                                      (False, 2.0)],
+                         ids=["cf1", "gated_cf1.25", "cf2"])
+def test_gather_fused_inference_matches_oracle(gated, cf):
+    """The gather-fused capacity path (dispatch built inside the kernel,
+    no [E, C, H] HBM buffer) matches the explicit-dispatch XLA oracle."""
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=256,
+                    intermediate_size=512, sequence_len=256,
+                    drop_tokens=True, capacity_factor=cf, gated_ffn=gated,
+                    dtype=jnp.float32, param_dtype=jnp.float32,
+                    is_training=False)
+    params, x = _setup(cfg)
+    got = moe_layer(params, x, cfg, use_pallas=True, interpret=True)
+    want = moe_layer(params, x, cfg, use_pallas=False)
+    np.testing.assert_allclose(
+        np.asarray(got.out), np.asarray(want.out), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_fused_path_grad_matches_xla_grad():
     """The fused path's custom VJP (pallas fwd, XLA-recompute bwd) must
     produce the same gradients as differentiating the XLA path."""
