@@ -42,33 +42,50 @@ class DispatchPlan(NamedTuple):
     position:   [S, K] slot within the expert's capacity buffer.
     valid:      [S, K] bool; False when dropped (over capacity).
     counts:     [E] number of selections per expert (pre-drop).
+    tok_sorted: [S*K] token id per expert-sorted assignment (k-major
+                priority order) — the sort is computed once here and
+                reused by :func:`dispatch_indices`.
     """
 
     expert_idx: jax.Array
     position: jax.Array
     valid: jax.Array
     counts: jax.Array
+    tok_sorted: jax.Array
 
 
 def make_plan(expert_idx, cfg: MoEConfig, capacity: int) -> DispatchPlan:
     """Compute per-(token, k) capacity positions.
 
-    expert_idx: [S, K] int32.  Pure integer work, fully parallel on the VPU.
+    expert_idx: [S, K] int32.  Sort-based ranking: ONE stable argsort over
+    the [K*S] expert ids (k-major flattening, so priority order matches
+    GShard: all k=0 assignments beat k=1, ties by token index) yields both
+    the per-assignment rank (via the inverse permutation) and the
+    expert-sorted token order that :func:`dispatch_indices` consumes.
+    This replaces a [K*S, E] one-hot cumsum — O(S*K*E) integer traffic
+    with a long-axis scan — with two O(S*K log S*K) sorts, the cheaper
+    form on the VPU at MoE scale.
     """
     s, k = expert_idx.shape
     e = cfg.num_experts
-    oh = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)  # [S, K, E]
-    counts = jnp.sum(oh, axis=(0, 1))
-    # k-major priority: flatten to [K*S, E] with k as the slow axis.
-    ohf = oh.transpose(1, 0, 2).reshape(k * s, e)
-    ranks = jnp.cumsum(ohf, axis=0) - ohf  # rank within expert
-    pos = jnp.sum(ranks * ohf, axis=-1).reshape(k, s).T  # [S, K]
+    ef = expert_idx.T.reshape(-1)  # k-major flattening: index = kk*S + ss
+    order = jnp.argsort(ef, stable=True)
+    inv = jnp.argsort(order)  # rank of each assignment in the sorted run
+    # counts from the sorted run boundaries — no [S*K, E] one-hot
+    starts = jnp.searchsorted(ef[order], jnp.arange(e, dtype=ef.dtype),
+                              side="left").astype(jnp.int32)
+    ends = jnp.concatenate(
+        [starts[1:], jnp.full((1,), s * k, jnp.int32)]
+    )
+    counts = ends - starts
+    pos = (inv.astype(jnp.int32) - starts[ef]).reshape(k, s).T  # [S, K]
+    tok_sorted = (order % s).astype(jnp.int32)
     # positions past capacity are ALWAYS invalid — with drop_tokens=False the
     # caller must size capacity >= max count (capacity_for does), so nothing
     # clamps; an undersized capacity then degrades to drops instead of
     # silently scattering into the next expert's buffer region.
     valid = pos < capacity
-    return DispatchPlan(expert_idx, pos, valid, counts)
+    return DispatchPlan(expert_idx, pos, valid, counts, tok_sorted)
 
 
 def dispatch_indices(plan: DispatchPlan, cfg: MoEConfig, capacity: int):
@@ -77,18 +94,15 @@ def dispatch_indices(plan: DispatchPlan, cfg: MoEConfig, capacity: int):
     Returns ``(src_tok, present)``, both ``[E, capacity]``: ``src_tok`` is
     the token id feeding each slot (slots past an expert's count point at
     token 0 and are never read back by :func:`combine`), ``present`` marks
-    populated slots.  Computed as a stable argsort over the [K*S] expert
-    ids (k-major, so priority order matches :func:`make_plan`): the c-th
-    entry of expert e's sorted run is exactly the selection with position
-    c.  This index plane is what the gather-fused FFN kernel consumes to
-    build expert slabs from token rows on the fly — the analogue of the
-    reference's super-blocks gathering from ``tokenIds``
-    (``packet.cuh:99-206``).
+    populated slots.  Reads the expert-sorted token order computed once by
+    :func:`make_plan`'s argsort: the c-th entry of expert e's sorted run
+    is exactly the selection with position c.  This index plane is what
+    the gather-fused FFN kernel consumes to build expert slabs from token
+    rows on the fly — the analogue of the reference's super-blocks
+    gathering from ``tokenIds`` (``packet.cuh:99-206``).
     """
     s, k = plan.expert_idx.shape
-    ef = plan.expert_idx.T.reshape(-1)  # k-major flattening: kk*S + ss
-    order = jnp.argsort(ef, stable=True)
-    tok_sorted = (order % s).astype(jnp.int32)  # token id per sorted entry
+    tok_sorted = plan.tok_sorted
     offsets = jnp.cumsum(plan.counts) - plan.counts  # [E] exclusive
     slot = offsets[:, None] + jnp.arange(capacity, dtype=jnp.int32)[None, :]
     present = jnp.arange(capacity, dtype=jnp.int32)[None, :] < \

@@ -29,9 +29,15 @@ from flashmoe_tpu.ops import expert as exp
 from flashmoe_tpu.ops.gate import router
 
 
-def make_prefix(params, cfg, depth: int, cap: int):
+def make_prefix(params, cfg, depth: int, cap: int, path: str):
     """Prefix through `depth` stages, ending in a scalar that feeds the
-    chain carry (dependency without materialization)."""
+    chain carry (dependency without materialization).
+
+    ``path='gather'`` times the default inference pipeline (dispatch
+    indices feed the gather-fused kernel, no [E, C, H] HBM buffer);
+    ``path='explicit'`` times the training-shape pipeline (explicit
+    dispatch buffer + grouped kernel).
+    """
 
     def fn(x):
         r = router(x, params["gate_w"], cfg, use_pallas=True)
@@ -41,6 +47,17 @@ def make_prefix(params, cfg, depth: int, cap: int):
         if depth == 1:
             return (plan.position.sum() + r.combine_weights.sum()).astype(
                 jnp.float32)
+        if path == "gather":
+            src_tok, _ = dsp.dispatch_indices(plan, cfg, cap)
+            if depth == 2:
+                return (src_tok.sum() + plan.position.sum()
+                        + r.combine_weights.sum()).astype(jnp.float32)
+            ybuf, cap_p = exp.capacity_ffn_gather(
+                x.astype(cfg.dtype), plan, cfg, cap, params)
+            if depth == 3:
+                return ybuf.astype(jnp.float32).sum()
+            out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap_p)
+            return out.sum()
         xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)
         if depth == 2:
             return xbuf.astype(jnp.float32).sum()
@@ -81,6 +98,8 @@ def main():
                     help="longer chain length for the differencing pair "
                          "(must be >= 2)")
     ap.add_argument("--config", default="reference")
+    ap.add_argument("--path", choices=["gather", "explicit"],
+                    default="gather")
     args = ap.parse_args()
     if args.chain < 2:
         ap.error("--chain must be >= 2 (per-iteration time comes from "
@@ -96,10 +115,12 @@ def main():
     # router alone is known-negligible (~0 ms: one [S,H]x[H,E] GEMM);
     # three prefixes bound the interesting stages with 6 compiles instead
     # of 10 (tunnel compiles are ~60-90 s each, RPC'd server-side)
-    names = {2: "router+plan+dispatch", 3: "+ffn", 4: "+combine"}
+    stage2 = ("router+plan+indices" if args.path == "gather"
+              else "router+plan+dispatch")
+    names = {2: stage2, 3: "+ffn", 4: "+combine"}
     prev = 0.0
     for depth, name in names.items():
-        fn = make_prefix(params, cfg, depth, cap)
+        fn = make_prefix(params, cfg, depth, cap, args.path)
         t1 = time_chain(chained(fn, x, 1), x, args.trials)
         tn = time_chain(chained(fn, x, args.chain), x, args.trials)
         t = max(tn - t1, 0.0) / (args.chain - 1)

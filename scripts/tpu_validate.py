@@ -90,8 +90,8 @@ def main() -> int:
 
     # 5. training grad through the fused path — now the PALLAS backward
     # (grouped_matmul/tgmm custom VJPs), checked against XLA-path grads
-    def loss(p, use_pallas):
-        o = fm.moe_layer(p, x, cfg2, use_pallas=use_pallas)
+    def loss(p, use_pallas, c=cfg2):
+        o = fm.moe_layer(p, x, c, use_pallas=use_pallas)
         return jnp.sum(o.out.astype(jnp.float32) ** 2) + o.aux_loss
     gp = jax.grad(lambda p: loss(p, True))(params)
     gx = jax.grad(lambda p: loss(p, False))(params)
@@ -106,6 +106,19 @@ def main() -> int:
                         jax.tree_util.tree_leaves(gx))
     )
     check("pallas_bwd_vs_xla_grads_rel", gerr, 0.02)
+
+    # 5b. grad through the gather-fused inference capacity path (the
+    # re-gather VJP) vs the XLA path
+    gcap = jax.grad(lambda p: loss(p, True, cfg))(params)
+    gcapx = jax.grad(lambda p: loss(p, False, cfg))(params)
+    cerr = max(
+        float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                              - b.astype(jnp.float32))))
+        / max(float(jnp.max(jnp.abs(b.astype(jnp.float32)))), 1e-9)
+        for a, b in zip(jax.tree_util.tree_leaves(gcap),
+                        jax.tree_util.tree_leaves(gcapx))
+    )
+    check("gather_fused_regather_vjp_rel", cerr, 0.02)
 
     # 6. backward kernels standalone (grouped_matmul / tgmm vs einsum)
     from flashmoe_tpu.ops.expert import grouped_matmul, tgmm

@@ -42,6 +42,48 @@ def test_k_major_priority():
     assert pos[1, 0] == 0 and pos[0, 1] == 1 and pos[2, 1] == 2
 
 
+def test_plan_matches_bruteforce_oracle():
+    """Sort-based plan == arrival-order counting (the cumsum semantics)."""
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        s = int(rng.integers(8, 200))
+        k = int(rng.integers(1, 4))
+        e = int(rng.integers(2, 17))
+        idx = rng.integers(0, e, size=(s, k)).astype(np.int32)
+        cfg = MoEConfig(num_experts=e, expert_top_k=k, hidden_size=64,
+                        intermediate_size=64, sequence_len=max(8, s))
+        cap = int(rng.integers(1, 2 * s))
+        plan = dsp.make_plan(jnp.asarray(idx), cfg, cap)
+        cnt = np.zeros(e, np.int64)
+        pos = np.zeros((s, k), np.int64)
+        for kk in range(k):          # k-major arrival order
+            for ss in range(s):
+                ex = idx[ss, kk]
+                pos[ss, kk] = cnt[ex]
+                cnt[ex] += 1
+        np.testing.assert_array_equal(np.asarray(plan.position), pos)
+        np.testing.assert_array_equal(np.asarray(plan.counts), cnt)
+        np.testing.assert_array_equal(np.asarray(plan.valid), pos < cap)
+
+
+def test_dispatch_indices_consistent_with_plan():
+    """src_tok slots agree with (expert, position) scatter of token ids."""
+    idx = _idx(CFG, seed=3)
+    cap = 80
+    plan = dsp.make_plan(idx, CFG, cap)
+    src_tok, present = dsp.dispatch_indices(plan, CFG, cap)
+    src_tok, present = np.asarray(src_tok), np.asarray(present)
+    pos = np.asarray(plan.position)
+    valid = np.asarray(plan.valid)
+    eidx = np.asarray(plan.expert_idx)
+    s, k = eidx.shape
+    for ss in range(s):
+        for kk in range(k):
+            if valid[ss, kk]:
+                assert present[eidx[ss, kk], pos[ss, kk]]
+                assert src_tok[eidx[ss, kk], pos[ss, kk]] == ss
+
+
 def test_dispatch_combine_roundtrip_identity():
     """With identity 'experts' and no drops, combine(dispatch(x)) == x."""
     cfg = MoEConfig(num_experts=4, expert_top_k=2, hidden_size=64,
