@@ -353,7 +353,7 @@ def grouped_ffn_tokens(x, src_tok, tile_gid, w_up, b_up, w_down, b_down,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11, 12))
-def _grouped_ffn_tokens_ad(x, src_tok, tile_gid, w_up, b_up, w_down, b_down,
+def grouped_ffn_tokens_ad(x, src_tok, tile_gid, w_up, b_up, w_down, b_down,
                            w_gate, act_name, gated, block_m, block_i,
                            interpret):
     """Differentiable wrapper over :func:`grouped_ffn_tokens`.
@@ -406,7 +406,7 @@ def _gft_bwd(act_name, gated, block_m, block_i, interpret, res, dy):
     return (dx, ct_int(src_tok), ct_int(tile_gid), dwu, dbu, dwd, dbd, dwg)
 
 
-_grouped_ffn_tokens_ad.defvjp(_gft_fwd, _gft_bwd)
+grouped_ffn_tokens_ad.defvjp(_gft_fwd, _gft_bwd)
 
 
 def _capacity_tiling(c: int) -> tuple[int, int, int]:
@@ -431,7 +431,7 @@ def capacity_ffn_gather(x, plan, cfg: MoEConfig, capacity: int, params, *,
 
     Pads capacity to the row-tile size, derives per-slot source tokens
     from the plan, and runs the gather-fused kernel (differentiable via
-    re-gather, :func:`_grouped_ffn_tokens_ad`).  Returns ``([E, Cp, H],
+    re-gather, :func:`grouped_ffn_tokens_ad`).  Returns ``([E, Cp, H],
     Cp)`` — combine must use the padded capacity so flat slot indices
     line up.
     """
@@ -443,7 +443,7 @@ def capacity_ffn_gather(x, plan, cfg: MoEConfig, capacity: int, params, *,
     src_tok, _ = dsp.dispatch_indices(plan, cfg, cp)
     tiles_per_e = cp // bm
     tile_gid = jnp.arange(e * tiles_per_e, dtype=jnp.int32) // tiles_per_e
-    y = _grouped_ffn_tokens_ad(
+    y = grouped_ffn_tokens_ad(
         x, src_tok.reshape(-1), tile_gid,
         params["w_up"].astype(x.dtype), params["b_up"],
         params["w_down"].astype(x.dtype), params["b_down"],

@@ -66,15 +66,23 @@ def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
         # (S*K + E*block rows instead of the capacity path's E*S)
         bm = BLOCK_M if s >= BLOCK_M else max(8, ((s + 7) // 8) * 8)
         plan = rag.make_ragged_plan(r.expert_idx, cfg, bm)
-        xbuf = rag.ragged_dispatch(x.astype(cfg.dtype), plan, cfg, bm)
-        ybuf = exp.grouped_ffn_ad(
-            xbuf, plan.tile_gid,
+        # identical weight/config tail for both kernel entries, so the
+        # training and inference arms cannot drift numerically
+        ffn_tail = (
             params["w_up"].astype(cfg.dtype), params["b_up"],
             params["w_down"].astype(cfg.dtype), params["b_down"],
             params.get("w_gate", None) if cfg.gated_ffn else None,
             cfg.hidden_act, cfg.gated_ffn, bm, exp.DEFAULT_BLOCK_I,
             interpret,
         )
+        if not cfg.is_training:
+            # inference: gather fused into the kernel via the plan's
+            # inverse map — no [T_pad, H] grouped buffer in HBM
+            ybuf = exp.grouped_ffn_tokens_ad(
+                x.astype(cfg.dtype), plan.src_tok, plan.tile_gid, *ffn_tail)
+        else:
+            xbuf = rag.ragged_dispatch(x.astype(cfg.dtype), plan, cfg, bm)
+            ybuf = exp.grouped_ffn_ad(xbuf, plan.tile_gid, *ffn_tail)
         out = rag.ragged_combine(ybuf, plan, r.combine_weights, cfg)
     else:
         # capacity from the ACTUAL token count of this call, not the config's

@@ -36,12 +36,17 @@ class RaggedPlan(NamedTuple):
                  static shape).
     counts:      [E] assignments per expert.
     num_rows:    [] total populated+padded rows (<= T_pad, dynamic).
+    src_tok:     [T_pad] source token id per buffer row (pad rows point at
+                 token 0; they are never read back by combine).
+    present:     [T_pad] bool, True for populated rows.
     """
 
     position: jax.Array
     tile_gid: jax.Array
     counts: jax.Array
     num_rows: jax.Array
+    src_tok: jax.Array
+    present: jax.Array
 
 
 def padded_total_rows(cfg: MoEConfig, s: int, block_m: int) -> int:
@@ -52,26 +57,32 @@ def padded_total_rows(cfg: MoEConfig, s: int, block_m: int) -> int:
 
 
 def make_ragged_plan(expert_idx, cfg: MoEConfig, block_m: int) -> RaggedPlan:
-    """Compute the expert-sorted, tile-padded layout. Pure integer work."""
+    """Compute the expert-sorted, tile-padded layout. Pure integer work.
+
+    One stable argsort powers everything: assignment positions (inverse
+    permutation minus segment starts), the per-row source-token index
+    plane (the inverse map, derived by locating each buffer row in its
+    expert's padded segment — all gathers, no H-wide scatter), and the
+    per-tile group ids."""
     s, k = expert_idx.shape
     e = cfg.num_experts
     flat_e = expert_idx.T.reshape(-1)  # k-major (matches capacity priority)
     n = flat_e.shape[0]
 
-    counts = jnp.zeros((e,), jnp.int32).at[flat_e].add(1, mode="drop")
+    order = jnp.argsort(flat_e, stable=True)
+    sorted_e = flat_e[order]
+    unpadded_starts = jnp.searchsorted(
+        sorted_e, jnp.arange(e, dtype=flat_e.dtype), side="left"
+    ).astype(jnp.int32)
+    counts = jnp.concatenate(
+        [unpadded_starts[1:], jnp.full((1,), n, jnp.int32)]
+    ) - unpadded_starts
     padded = ((counts + block_m - 1) // block_m) * block_m
     seg_starts = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(padded)[:-1]]
     )  # [E] padded segment starts
 
-    # stable sort by expert -> rank within expert
-    order = jnp.argsort(flat_e, stable=True)
-    sorted_pos = jnp.zeros((n,), jnp.int32).at[order].set(
-        jnp.arange(n, dtype=jnp.int32)
-    )
-    unpadded_starts = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]]
-    )
+    sorted_pos = jnp.argsort(order).astype(jnp.int32)  # inverse permutation
     rank = sorted_pos - unpadded_starts[flat_e]
     position = (seg_starts[flat_e] + rank).reshape(k, s).T  # [S, K]
 
@@ -84,17 +95,32 @@ def make_ragged_plan(expert_idx, cfg: MoEConfig, block_m: int) -> RaggedPlan:
     tile_gid = jnp.clip(
         jnp.searchsorted(seg_ends, tile_starts, side="right"), 0, e - 1
     ).astype(jnp.int32)
-    return RaggedPlan(position, tile_gid, counts, seg_ends[-1])
+
+    # inverse map: which (token, k) assignment feeds each buffer row
+    rows = jnp.arange(t_pad, dtype=jnp.int32)
+    e_row = jnp.clip(
+        jnp.searchsorted(seg_ends, rows, side="right"), 0, e - 1
+    ).astype(jnp.int32)
+    row_rank = rows - seg_starts[e_row]
+    present = row_rank < counts[e_row]
+    sorted_idx = unpadded_starts[e_row] + jnp.minimum(
+        row_rank, jnp.maximum(counts[e_row] - 1, 0)
+    )
+    src_tok = jnp.where(
+        present, (order[jnp.clip(sorted_idx, 0, n - 1)] % s).astype(
+            jnp.int32), 0
+    )
+    return RaggedPlan(position, tile_gid, counts, seg_ends[-1], src_tok,
+                      present)
 
 
 def ragged_dispatch(x, plan: RaggedPlan, cfg: MoEConfig, block_m: int):
-    """Scatter tokens into the expert-sorted padded buffer: [T_pad, H]."""
-    s, h = x.shape
-    k = plan.position.shape[1]
-    t_pad = padded_total_rows(cfg, s, block_m)
-    src = jnp.broadcast_to(x[:, None, :], (s, k, h)).reshape(-1, h)
-    buf = jnp.zeros((t_pad, h), x.dtype)
-    return buf.at[plan.position.reshape(-1)].set(src, mode="drop")
+    """Gather tokens into the expert-sorted padded buffer: [T_pad, H].
+
+    Row-gather via the plan's inverse map (``src_tok``) — an H-wide
+    row-scatter serializes on TPU, while this runs at HBM bandwidth."""
+    buf = jnp.where(plan.present[:, None], x[plan.src_tok], 0)
+    return buf.astype(x.dtype)
 
 
 def ragged_combine(y, plan: RaggedPlan, combine_weights, cfg: MoEConfig):

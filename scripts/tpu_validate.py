@@ -88,37 +88,37 @@ def main() -> int:
     wa = attention_xla(q, k, v, causal=True)
     check("flash_attention", float(jnp.max(jnp.abs(fa - wa))), 1e-4)
 
-    # 5. training grad through the fused path — now the PALLAS backward
-    # (grouped_matmul/tgmm custom VJPs), checked against XLA-path grads
-    def loss(p, use_pallas, c=cfg2):
+    # 5. TRAINING grad through the fused dropless path — the PALLAS
+    # backward (ragged_dispatch buffer -> grouped_ffn_ad with
+    # grouped_matmul/tgmm custom VJPs), checked against XLA-path grads.
+    # is_training=True matters: inference routes through the gather-fused
+    # kernel instead, which 5b covers separately.
+    def loss(p, use_pallas, c):
         o = fm.moe_layer(p, x, c, use_pallas=use_pallas)
         return jnp.sum(o.out.astype(jnp.float32) ** 2) + o.aux_loss
-    gp = jax.grad(lambda p: loss(p, True))(params)
-    gx = jax.grad(lambda p: loss(p, False))(params)
+
+    def relerr(ga, gb):
+        return max(
+            float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                  - b.astype(jnp.float32))))
+            / max(float(jnp.max(jnp.abs(b.astype(jnp.float32)))), 1e-9)
+            for a, b in zip(jax.tree_util.tree_leaves(ga),
+                            jax.tree_util.tree_leaves(gb))
+        )
+
+    cfg2t = cfg2.replace(is_training=True)
+    gp = jax.grad(lambda p: loss(p, True, cfg2t))(params)
+    gx = jax.grad(lambda p: loss(p, False, cfg2t))(params)
     finite = all(bool(jnp.isfinite(l).all())
                  for l in jax.tree_util.tree_leaves(gp))
     check("fused_grad_finite", 0.0 if finite else 1.0, 0.5)
-    gerr = max(
-        float(jnp.max(jnp.abs(a.astype(jnp.float32)
-                              - b.astype(jnp.float32))))
-        / max(float(jnp.max(jnp.abs(b.astype(jnp.float32)))), 1e-9)
-        for a, b in zip(jax.tree_util.tree_leaves(gp),
-                        jax.tree_util.tree_leaves(gx))
-    )
-    check("pallas_bwd_vs_xla_grads_rel", gerr, 0.02)
+    check("pallas_bwd_vs_xla_grads_rel", relerr(gp, gx), 0.02)
 
     # 5b. grad through the gather-fused inference capacity path (the
     # re-gather VJP) vs the XLA path
     gcap = jax.grad(lambda p: loss(p, True, cfg))(params)
     gcapx = jax.grad(lambda p: loss(p, False, cfg))(params)
-    cerr = max(
-        float(jnp.max(jnp.abs(a.astype(jnp.float32)
-                              - b.astype(jnp.float32))))
-        / max(float(jnp.max(jnp.abs(b.astype(jnp.float32)))), 1e-9)
-        for a, b in zip(jax.tree_util.tree_leaves(gcap),
-                        jax.tree_util.tree_leaves(gcapx))
-    )
-    check("gather_fused_regather_vjp_rel", cerr, 0.02)
+    check("gather_fused_regather_vjp_rel", relerr(gcap, gcapx), 0.02)
 
     # 6. backward kernels standalone (grouped_matmul / tgmm vs einsum)
     from flashmoe_tpu.ops.expert import grouped_matmul, tgmm
