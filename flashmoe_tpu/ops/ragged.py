@@ -117,8 +117,12 @@ def make_ragged_plan(expert_idx, cfg: MoEConfig, block_m: int) -> RaggedPlan:
 def ragged_dispatch(x, plan: RaggedPlan, cfg: MoEConfig, block_m: int):
     """Gather tokens into the expert-sorted padded buffer: [T_pad, H].
 
-    Row-gather via the plan's inverse map (``src_tok``) — an H-wide
-    row-scatter serializes on TPU, while this runs at HBM bandwidth."""
+    Row-gather via the plan's inverse map (``src_tok``).  Note: under
+    differentiation the gather's VJP is an H-wide scatter-add back to
+    token order, so the dropless TRAINING step still pays one scatter in
+    the backward (a wash vs the old scatter-forward formulation); the
+    real win is inference, which skips this buffer entirely via the
+    gather-fused kernel."""
     buf = jnp.where(plan.present[:, None], x[plan.src_tok], 0)
     return buf.astype(x.dtype)
 

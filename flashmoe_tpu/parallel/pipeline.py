@@ -36,16 +36,22 @@ from flashmoe_tpu.ops.moe import moe_layer
 from flashmoe_tpu.parallel.ep import _ep_moe_shard
 
 
-def stack_stage_params(params, cfg: MoEConfig, pp: int):
+def stack_stage_params(params, cfg: MoEConfig, pp: int, interleave: int = 1):
     """Re-shape init_params output into per-stage stacked pytrees.
 
     Returns (stage_layers, io_params): ``stage_layers`` has every leaf
-    stacked as [pp, layers_per_stage, ...]; ``io_params`` carries embed /
-    final_norm / lm_head (replicated; stage roles select what they use).
+    stacked as [pp, interleave, layers_per_chunk, ...] — global chunk
+    ``c = lap * pp + stage`` owns contiguous layers
+    ``[c * lpc, (c + 1) * lpc)`` (the Megatron interleaved assignment);
+    ``io_params`` carries embed / final_norm / lm_head (replicated; stage
+    roles select what they use).
     """
-    if cfg.num_layers % pp:
-        raise ValueError(f"num_layers {cfg.num_layers} not divisible by pp={pp}")
-    lps = cfg.num_layers // pp
+    v = interleave
+    if cfg.num_layers % (pp * v):
+        raise ValueError(
+            f"num_layers {cfg.num_layers} not divisible by "
+            f"pp*interleave={pp * v}")
+    lpc = cfg.num_layers // (pp * v)
     moe_set = set(cfg.moe_layer_indices)
     uniform = all(i in moe_set for i in range(cfg.num_layers)) or not moe_set
     if not uniform:
@@ -54,8 +60,13 @@ def stack_stage_params(params, cfg: MoEConfig, pp: int):
             "(moe_frequency=1 or num_experts=1)"
         )
     layers = params["layers"]
+    ordered = [
+        layers[(l * pp + s) * lpc + i]
+        for s in range(pp) for l in range(v) for i in range(lpc)
+    ]
     stage_layers = jax.tree_util.tree_map(
-        lambda *ls: jnp.stack(ls).reshape((pp, lps) + ls[0].shape), *layers
+        lambda *ls: jnp.stack(ls).reshape((pp, v, lpc) + ls[0].shape),
+        *ordered,
     )
     io_params = {k: params[k] for k in ("embed", "final_norm", "lm_head")}
     return stage_layers, io_params
@@ -107,28 +118,46 @@ def _stage_apply(stage_layers, x, cfg: MoEConfig, lps: int,
 
 
 def pipeline_loss(params, batch, cfg: MoEConfig, mesh: Mesh, *,
-                  num_microbatches: int = 2):
+                  num_microbatches: int = 2, interleave: int = 1):
     """Pipelined loss over the pp axis. batch["tokens"]: [B, T+1] with
-    B % (dp * num_microbatches) == 0."""
+    B % (dp * num_microbatches) == 0.
+
+    ``interleave`` > 1 runs the Megatron-style interleaved schedule: each
+    stage owns ``interleave`` layer chunks (global chunk ``l * pp + s``),
+    microbatches proceed in groups of ``pp``, and every activation
+    arriving on the ring is consumed the same tick — no holding buffer.
+    Bubble shrinks from ``(P-1)/(M+P-1)`` of a ``V``-deep stage to
+    ``(P-1)/(V*M+P-1)`` of a chunk (wall-clock ratio
+    ``(V*M+P-1) / (V*(M+P-1))``).  ``interleave=1`` is exactly GPipe.
+    Requires ``M % P == 0`` when interleaving (group structure).
+    """
     pp = mesh.shape["pp"]
     if pp <= 1:
         raise ValueError("pipeline_loss needs a pp>1 mesh")
+    v = interleave
+    if v < 1:
+        raise ValueError(f"interleave must be >= 1, got {v}")
+    if v > 1 and num_microbatches % pp:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches "
+            f"({num_microbatches}) divisible by pp ({pp})")
     ep = mesh.shape.get("ep", 1)
     use_ep = ep > 1 and cfg.num_experts > 1
     if use_ep and cfg.num_experts % ep:
         raise ValueError(f"E={cfg.num_experts} not divisible by ep={ep}")
-    lps = cfg.num_layers // pp
-    stage_layers, io_params = stack_stage_params(params, cfg, pp)
+    lpc = cfg.num_layers // (pp * v)
+    stage_layers, io_params = stack_stage_params(params, cfg, pp,
+                                                 interleave=v)
 
-    # expert-weight leaves additionally shard their expert dim (axis 2 of
-    # the [pp, lps, E, ...] stack) over ep; everything else replicates
+    # expert-weight leaves additionally shard their expert dim (axis 3 of
+    # the [pp, v, lpc, E, ...] stack) over ep; everything else replicates
     # across ep within the stage
     _EP_KEYS = {"w_up", "w_down", "w_gate", "b_up", "b_down"}
 
     def _stage_spec(path, leaf):
         keys = {getattr(k, "key", None) for k in path}
         if use_ep and keys & {"moe"} and keys & _EP_KEYS:
-            return P("pp", None, "ep")
+            return P("pp", None, None, "ep")
         return P("pp")
 
     stage_specs = jax.tree_util.tree_map_with_path(_stage_spec, stage_layers)
@@ -147,12 +176,24 @@ def pipeline_loss(params, batch, cfg: MoEConfig, mesh: Mesh, *,
 
         def tick(carry, t):
             act_in, loss_sum, aux_sum, cnt = carry
-            mb = jnp.clip(t - s, 0, m - 1)
-            active = (t - s >= 0) & (t - s < m)
+            # interleaved decomposition of this rank's local tick
+            # u = t - s:  group g of p microbatches, lap l, offset r
+            u = t - s
+            active = (u >= 0) & (u < v * m)
+            uc = jnp.clip(u, 0, v * m - 1)
+            g = uc // (v * p)
+            l = (uc % (v * p)) // p
+            r = uc % p
+            mb = jnp.clip(g * p + r, 0, m - 1)
+            chunk = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, l, 0,
+                                                       keepdims=False),
+                stage_layers,
+            )
             inject = io_params["embed"].astype(cfg.dtype)[inp[mb]]
-            x = jnp.where(s == 0, inject, act_in)
-            y, aux = _stage_apply(stage_layers, x, cfg, lps, use_ep=use_ep)
-            # last stage: loss on the completed microbatch
+            x = jnp.where((s == 0) & (l == 0), inject, act_in)
+            y, aux = _stage_apply(chunk, x, cfg, lpc, use_ep=use_ep)
+            # last stage, last lap: loss on the completed microbatch
             h = tfm.rms_norm(y, io_params["final_norm"])
             logits = jnp.dot(
                 h.astype(cfg.dtype), io_params["lm_head"].astype(cfg.dtype),
@@ -162,8 +203,7 @@ def pipeline_loss(params, batch, cfg: MoEConfig, mesh: Mesh, *,
             nll = -jnp.take_along_axis(
                 logp, tgt[mb][..., None], axis=-1
             )[..., 0]
-            is_last = s == p - 1
-            use = active & is_last
+            use = active & (s == p - 1) & (l == v - 1)
             loss_sum = loss_sum + jnp.where(use, jnp.mean(nll), 0.0)
             aux_sum = aux_sum + jnp.where(active, aux, 0.0)
             cnt = cnt + jnp.where(use, 1.0, 0.0)
@@ -177,7 +217,7 @@ def pipeline_loss(params, batch, cfg: MoEConfig, mesh: Mesh, *,
             tick, (zero_act, jnp.zeros((), jnp.float32),
                    jnp.zeros((), cfg.accum_dtype),
                    jnp.zeros((), jnp.float32)),
-            jnp.arange(m + p - 1),
+            jnp.arange(v * m + p - 1),
         )
         # only the last stage accumulated CE; broadcast it everywhere
         ce = jax.lax.psum(loss_sum, "pp") / jnp.maximum(
