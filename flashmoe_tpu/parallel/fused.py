@@ -32,6 +32,19 @@ Gate/plan/dispatch-layout and the final combine stay in XLA (they are
 bandwidth-trivial next to the FFN); the kernel owns exactly the
 communication-heavy middle.  Capacity-format slabs keep every shape static.
 
+Design decision — why the send slabs are built XLA-side rather than
+gathered in-kernel (the reference gathers from ``tokenIds`` inside the
+kernel, ``packet.cuh:99-206``): the reference hides per-row staging
+latency behind hundreds of concurrently-resident SM blocks; a TPU kernel
+is one sequential instruction stream, and this kernel's phase 1 issues
+every outbound RDMA up front so remote compute can start.  An in-kernel
+row gather there would pay per-row DMA-issue latency serially before any
+send departs (~50-100 ns x S*K rows, with no compute to hide behind),
+whereas the XLA dispatch builds the same slabs at full VPU/HBM bandwidth
+and the RDMAs then stream straight from HBM with no VMEM bounce.  The
+single-device path, whose gather IS overlappable with the grid's own
+GEMMs, does fuse it (``ops/expert.py:grouped_ffn_tokens``).
+
 Layouts (D = ep world, nLx = local experts, C = per-(rank, expert) capacity):
   x_send  [D, nLx, C, H]  on each source rank: slab d holds tokens routed
                           to rank d's local experts (dest-major).

@@ -26,7 +26,8 @@ sys.path.insert(0, __import__("os").path.dirname(
 from flashmoe_tpu.config import MoEConfig  # noqa: E402
 from flashmoe_tpu.models.reference import init_moe_params  # noqa: E402
 from flashmoe_tpu.ops.expert import (  # noqa: E402
-    capacity_buffer_ffn_pallas, expert_ffn_dense,
+    _capacity_tiling, capacity_buffer_ffn_pallas, expert_ffn_dense,
+    grouped_ffn_tokens,
 )
 
 RTOL, ATOL = 2e-2, 2e-3  # the reference's isclose tolerances
@@ -47,6 +48,28 @@ def _bench_point(e, c, h, i, dtype, correctness, trials=3, chain=8):
     mism = float(jnp.mean(
         (jnp.abs(g32 - w32) > ATOL + RTOL * jnp.abs(w32)).astype(jnp.float32)
     )) * 100.0
+
+    # gather-fused variant: same slabs, rows pulled in-kernel from a flat
+    # token array through an identity-ish index plane
+    bm, cp, block_i = _capacity_tiling(c)
+    x_flat = xs.reshape(e * c, h)
+    src_tok = jnp.arange(e * c, dtype=jnp.int32).reshape(e, c)
+    src_tok = jnp.pad(src_tok, ((0, 0), (0, cp - c))).reshape(-1)
+    tile_gid = jnp.arange(e * (cp // bm), dtype=jnp.int32) // (cp // bm)
+
+    def gather_ffn(xf, p, c_):
+        y = grouped_ffn_tokens(
+            xf, src_tok, tile_gid, p["w_up"].astype(xf.dtype), p["b_up"],
+            p["w_down"].astype(xf.dtype), p["b_down"], None,
+            act_name=c_.hidden_act, gated=False, block_m=bm,
+            block_i=block_i, interpret=interpret)
+        return y.reshape(e, cp, h)[:, :c, :]
+
+    gog = gather_ffn(x_flat, params, cfg).astype(jnp.float32)
+    mism_g = float(jnp.mean(
+        (jnp.abs(gog - w32) > ATOL + RTOL * jnp.abs(w32)).astype(jnp.float32)
+    )) * 100.0
+    mism = max(mism, mism_g)
     rec = {
         "E": e, "rows": c, "H": h, "I": i,
         "dtype": jnp.dtype(dtype).name,
@@ -71,10 +94,30 @@ def _bench_point(e, c, h, i, dtype, correctness, trials=3, chain=8):
 
         tp = timed(lambda xs, p, c_: capacity_buffer_ffn_pallas(xs, p, c_))
         tx = timed(expert_ffn_dense)
+
+        def timed_flat(fn):
+            def run(p, xf):
+                def body(xf, _):
+                    return fn(xf, p, cfg).reshape(e * c, h).astype(
+                        xf.dtype), None
+                xf, _ = jax.lax.scan(body, xf, None, length=chain)
+                return xf.astype(jnp.float32).sum()
+            f = jax.jit(run)
+            float(f(params, x_flat))
+            ts = []
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                float(f(params, x_flat))
+                ts.append(time.perf_counter() - t0)
+            return sorted(ts)[len(ts) // 2] / chain
+
+        tg = timed_flat(gather_ffn)
         flops = 2 * e * c * 2 * h * i
         rec.update(
             pallas_ms=round(tp * 1e3, 3), xla_ms=round(tx * 1e3, 3),
+            gather_fused_ms=round(tg * 1e3, 3),
             pallas_tflops=round(flops / tp / 1e12, 1),
+            gather_tflops=round(flops / tg / 1e12, 1),
         )
     print(json.dumps(rec), flush=True)
     return mism
