@@ -18,6 +18,7 @@ all-to-all.
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple
 
 import jax
@@ -29,6 +30,17 @@ from flashmoe_tpu.ops import dispatch as dsp
 from flashmoe_tpu.ops import expert as exp
 from flashmoe_tpu.ops import ragged as rag
 from flashmoe_tpu.ops.gate import router
+
+
+def _gather_fused(cfg: MoEConfig) -> bool:
+    """Whether inference routes through the gather-fused FFN kernel.
+
+    Opt-in (config field, or FLASHMOE_GATHER_FUSED=1) until the kernel has a
+    winning stage_bench row on real TPU; the explicit-dispatch path is the
+    hardware-validated default (round-2 advisor finding)."""
+    if cfg.gather_fused is not None:
+        return cfg.gather_fused
+    return os.environ.get("FLASHMOE_GATHER_FUSED") == "1"
 
 
 class MoEOutput(NamedTuple):
@@ -75,7 +87,7 @@ def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
             cfg.hidden_act, cfg.gated_ffn, bm, exp.DEFAULT_BLOCK_I,
             interpret,
         )
-        if not cfg.is_training:
+        if not cfg.is_training and _gather_fused(cfg):
             # inference: gather fused into the kernel via the plan's
             # inverse map — no [T_pad, H] grouped buffer in HBM
             ybuf = exp.grouped_ffn_tokens_ad(
@@ -89,7 +101,7 @@ def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
         # nominal sequence length (callers pass batched shards of any size)
         cap = capacity if capacity is not None else cfg.capacity_for(s)
         plan = dsp.make_plan(r.expert_idx, cfg, cap)
-        if use_pallas and not cfg.is_training:
+        if use_pallas and not cfg.is_training and _gather_fused(cfg):
             # inference: gather fused into the kernel — the [E, C, H]
             # dispatch buffer never hits HBM (training keeps the explicit
             # dispatch so the fused backward has its residuals)

@@ -95,6 +95,12 @@ def make_train_step(cfg: MoEConfig, mesh: Mesh, optimizer,
     over dp; XLA inserts the dp gradient allreduce from the sharding
     layout.
     """
+    # Training entry point implies is_training: without this, a hand-built
+    # config silently differentiates through the inference-selected FFN path
+    # (extra forward recompute in the VJP) instead of the residual-saving
+    # training kernels (round-2 advisor finding).
+    if not cfg.is_training:
+        cfg = cfg.replace(is_training=True)
 
     def step_fn(state: TrainState, batch):
         (loss, metrics), grads = jax.value_and_grad(

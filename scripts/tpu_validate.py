@@ -56,11 +56,25 @@ def main() -> int:
     check("capacity_f32", float(jnp.max(jnp.abs(got.out - want))), 1e-4)
     print(f"  (compile+run {time.time()-t0:.1f}s)")
 
+    # 1b. gather-fused capacity path (opt-in kernel: dispatch built inside
+    # the kernel via per-row DMA; must pass here before it can be default)
+    cfg_g = cfg.replace(gather_fused=True)
+    got_g = fm.moe_layer(params, x, cfg_g, use_pallas=True)
+    check("capacity_gather_f32", float(jnp.max(jnp.abs(got_g.out - want))),
+          1e-4)
+
     # 2. dropless ragged path
     cfg2 = cfg.replace(drop_tokens=False)
     got2 = fm.moe_layer(params, x, cfg2, use_pallas=True)
     want2, _ = reference_moe(params, x, cfg2)
     check("dropless_ragged_f32", float(jnp.max(jnp.abs(got2.out - want2))),
+          1e-4)
+
+    # 2b. dropless gather-fused kernel (grouped_ffn_tokens via the ragged
+    # plan's inverse map) — same promotion gate as 1b
+    got2g = fm.moe_layer(params, x, cfg2.replace(gather_fused=True),
+                         use_pallas=True)
+    check("dropless_gather_f32", float(jnp.max(jnp.abs(got2g.out - want2))),
           1e-4)
 
     # 3. gated bf16 (Mixtral-style)
@@ -91,8 +105,8 @@ def main() -> int:
     # 5. TRAINING grad through the fused dropless path — the PALLAS
     # backward (ragged_dispatch buffer -> grouped_ffn_ad with
     # grouped_matmul/tgmm custom VJPs), checked against XLA-path grads.
-    # is_training=True matters: inference routes through the gather-fused
-    # kernel instead, which 5b covers separately.
+    # is_training=True keeps the explicit dispatch buffer + residual-saving
+    # backward; the (opt-in) gather-fused inference VJP is covered in 5b.
     def loss(p, use_pallas, c):
         o = fm.moe_layer(p, x, c, use_pallas=use_pallas)
         return jnp.sum(o.out.astype(jnp.float32) ** 2) + o.aux_loss
@@ -116,8 +130,8 @@ def main() -> int:
 
     # 5b. grad through the gather-fused inference capacity path (the
     # re-gather VJP) vs the XLA path
-    gcap = jax.grad(lambda p: loss(p, True, cfg))(params)
-    gcapx = jax.grad(lambda p: loss(p, False, cfg))(params)
+    gcap = jax.grad(lambda p: loss(p, True, cfg_g))(params)
+    gcapx = jax.grad(lambda p: loss(p, False, cfg_g))(params)
     check("gather_fused_regather_vjp_rel", relerr(gcap, gcapx), 0.02)
 
     # 6. backward kernels standalone (grouped_matmul / tgmm vs einsum)

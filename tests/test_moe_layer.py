@@ -102,7 +102,7 @@ def test_gather_fused_inference_matches_oracle(gated, cf):
                     intermediate_size=512, sequence_len=256,
                     drop_tokens=True, capacity_factor=cf, gated_ffn=gated,
                     dtype=jnp.float32, param_dtype=jnp.float32,
-                    is_training=False)
+                    is_training=False, gather_fused=True)
     params, x = _setup(cfg)
     got = moe_layer(params, x, cfg, use_pallas=True, interpret=True)
     want = moe_layer(params, x, cfg, use_pallas=False)
@@ -117,7 +117,7 @@ def test_dropless_gather_fused_inference(gated):
     map from the ragged plan); output and re-gather-VJP grads match XLA."""
     cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=256,
                     intermediate_size=512, sequence_len=256,
-                    gated_ffn=gated, **NODROP)
+                    gated_ffn=gated, gather_fused=True, **NODROP)
     params, x = _setup(cfg)
     got = moe_layer(params, x, cfg, use_pallas=True, interpret=True)
     want, _ = reference_moe(params, x, cfg)
