@@ -15,7 +15,9 @@
 #include <algorithm>
 #include <cmath>
 #include <cstdint>
+#include <limits>
 #include <numeric>
+#include <queue>
 #include <vector>
 
 namespace {
@@ -50,37 +52,31 @@ struct Ctx {
   double transfer_ms(int i, int j, double mb) const {
     return alpha[i * n + j] + beta[i * n + j] * mb;
   }
-  double worst_beta() const {
-    double w = 0;
-    for (int i = 0; i < n * n; ++i) w = std::max(w, beta[i]);
-    return w;
-  }
   bool can_hold_all(const std::vector<int>& mem) const {
     double cap = 0;
     for (int d : mem) cap += mem_gb[d] * 1024.0;
     return cap >= num_experts * expert_mb;
   }
+  // worst pairwise transfer, payload split across the group (the
+  // reference's evalP2PTime with p2pBuffer/numNodes)
   double intra_comm_ms(const std::vector<int>& mem) const {
     double worst = 0;
+    double mb = act_mb / std::max<size_t>(mem.size(), 1);
     for (int i : mem)
       for (int j : mem)
-        if (i != j) worst = std::max(worst, transfer_ms(i, j, act_mb));
+        if (i != j) worst = std::max(worst, transfer_ms(i, j, mb));
     return worst;
   }
-  double ring_allreduce_ms(int groups) const {
-    if (groups <= 1) return 0.0;
-    return 2.0 * (groups - 1) * ((grad_mb / groups) * worst_beta());
-  }
-  double objective(const std::vector<int>& mem, int cur_groups) const {
+  // memory-infeasible groups price at infinity (the reference's
+  // must-merge encoding, functions.cuh obj())
+  double objective(const std::vector<int>& mem, double ar_ms) const {
+    if (!can_hold_all(mem)) return std::numeric_limits<double>::infinity();
     double r = 0;
     for (int d : mem) r += rate[d];
-    // total cost of all experts at the slowest device's unit rate, split
-    // across the group's aggregate rate (matches the Python objective)
     double total_cost =
         num_experts / std::max(*std::min_element(rate, rate + n), 1e-9);
     double compute = total_cost / std::max(r, 1e-9);
-    double ar = training && grad_mb > 0 ? ring_allreduce_ms(cur_groups) : 0.0;
-    return gamma * (compute + 1.0 * intra_comm_ms(mem)) + ar;
+    return gamma * (compute + 1.0 * intra_comm_ms(mem)) + ar_ms;
   }
 };
 
@@ -111,7 +107,10 @@ int flashmoe_decide(int n, const double* alpha, const double* beta,
     return g;
   };
 
-  struct Edge { double w; int a, b; };
+  struct Edge {
+    double w; int a, b;
+    bool operator<(const Edge& o) const { return w < o.w; }  // PQ: max by w
+  };
   std::vector<Edge> edges;
   edges.reserve(n * (n - 1) / 2);
   for (int i = 0; i < n; ++i)
@@ -120,6 +119,18 @@ int flashmoe_decide(int n, const double* alpha, const double* beta,
   std::stable_sort(edges.begin(), edges.end(),
                    [](const Edge& x, const Edge& y) { return x.w < y.w; });
 
+  // inter-group allreduce bottleneck: max-heap of external edges keyed by
+  // per-chunk gradient transfer time, maintained across merges exactly as
+  // the reference's externalEdges priority queue (decider.cuh:60,86-158).
+  // Inference jobs (training == false) skip the term entirely — the
+  // reference's Decider<JobType::inference> specialization.
+  const bool use_ar = ctx.training && ctx.grad_mb > 0;
+  std::priority_queue<Edge> ext;  // Edge::operator< orders by w: max-heap
+  if (use_ar)
+    for (int i = 0; i < n; ++i)
+      for (int j = 0; j < n; ++j)
+        if (i != j) ext.push({ctx.transfer_ms(i, j, ctx.grad_mb / n), i, j});
+
   for (const Edge& e : edges) {
     int ra = dsu.find(e.a), rb = dsu.find(e.b);
     if (ra == rb) continue;
@@ -127,14 +138,40 @@ int flashmoe_decide(int n, const double* alpha, const double* beta,
     auto& gb = members[rb];
     std::vector<int> merged = ga;
     merged.insert(merged.end(), gb.begin(), gb.end());
-    int cur = num_groups();
-    bool must = !ctx.can_hold_all(ga) || !ctx.can_hold_all(gb);
-    if (must || ctx.objective(merged, cur) <=
-                    std::max(ctx.objective(ga, cur), ctx.objective(gb, cur))) {
+    double ar_parts = 0.0, ar_merged = 0.0;
+    std::vector<Edge> limbo;  // edges the merge would internalize
+    if (use_ar) {
+      while (!ext.empty()) {
+        Edge t = ext.top();
+        int fa = dsu.find(t.a), fb = dsu.find(t.b);
+        if (fa == fb) { ext.pop(); continue; }      // intra forever
+        if ((fa == ra && fb == rb) || (fa == rb && fb == ra)) {
+          limbo.push_back(t);                        // internal iff merged
+          ext.pop();
+          continue;
+        }
+        break;
+      }
+      int g = num_groups();
+      double cur_bot = ext.empty() ? 0.0 : ext.top().w;
+      for (const Edge& l : limbo) cur_bot = std::max(cur_bot, l.w);
+      ar_parts = g > 1 ? 2.0 * (g - 1) * cur_bot : 0.0;
+      ar_merged = (g - 1 > 1 && !ext.empty())
+                      ? 2.0 * (g - 2) * ext.top().w
+                      : 0.0;
+    }
+    double o1 = ctx.objective(ga, ar_parts);
+    double o2 = ctx.objective(gb, ar_parts);
+    double om = ctx.objective(merged, ar_merged);
+    bool both_inf = std::isinf(o1) && std::isinf(o2);
+    if (both_inf || om <= std::max(o1, o2)) {
       int root = dsu.unite(ra, rb);
       int other = (root == ra) ? rb : ra;
       members[root] = merged;
       members[other].clear();
+      // limbo edges became intra-group: stay out of the pool
+    } else {
+      for (const Edge& l : limbo) ext.push(l);
     }
   }
 

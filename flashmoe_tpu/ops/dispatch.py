@@ -127,6 +127,41 @@ def dispatch(x, plan: DispatchPlan, cfg: MoEConfig, capacity: int):
     return buf.astype(x.dtype)
 
 
+def combine_slot_maps(plan: DispatchPlan, combine_weights, cfg: MoEConfig,
+                      capacity: int):
+    """Slot-indexed combine maps for the in-kernel (fused) combine.
+
+    Returns ``(comb_idx, comb_w)``, both ``[E, capacity]``: the local token
+    row fed by each expert-capacity slot and that slot's renormalized
+    combine weight (0.0 for empty/dropped slots, so the kernel's
+    scatter-accumulate of ``w * y[slot]`` into token order reproduces
+    :func:`combine` exactly).  ``comb_w`` is differentiable with respect to
+    ``combine_weights`` (the scatter transposes to a gather), which is how
+    router gradients flow when the combine runs inside the RDMA kernel.
+    """
+    s, k = plan.expert_idx.shape
+    e = cfg.num_experts
+    w = jnp.where(plan.valid, combine_weights, 0.0).astype(jnp.float32)
+    denom = jnp.sum(w, axis=-1, keepdims=True)
+    w = w / jnp.maximum(denom, 1e-20)
+    # invalid slots scatter into a trash slot one past the end
+    flat = jnp.where(
+        plan.valid,
+        plan.expert_idx * capacity + plan.position,
+        e * capacity,
+    ).reshape(-1)
+    toks = jnp.broadcast_to(
+        jnp.arange(s, dtype=jnp.int32)[:, None], (s, k)
+    ).reshape(-1)
+    comb_idx = (
+        jnp.zeros(e * capacity + 1, jnp.int32).at[flat].set(toks)
+    )[: e * capacity].reshape(e, capacity)
+    comb_w = (
+        jnp.zeros(e * capacity + 1, jnp.float32).at[flat].set(w.reshape(-1))
+    )[: e * capacity].reshape(e, capacity)
+    return comb_idx, comb_w
+
+
 def combine(expert_out, plan: DispatchPlan, combine_weights, cfg: MoEConfig,
             capacity: int):
     """Weighted un-permute: [E, C, H] -> [S, H].

@@ -214,11 +214,34 @@ def _router_bwd(cfg, interpret, res, ct):
 _router_pallas_ad.defvjp(_router_fwd, _router_bwd)
 
 
+def gate_vmem_bytes(s: int, h: int, e: int, dtype) -> int:
+    """Static VMEM estimate of the fused gate's working set: the weight
+    tile [H, PX], the token tile [BM, H], and ~4 [BM, PX]-sized f32
+    intermediates (logits, exp, probs, selection mask)."""
+    px = max(LANE, ((e + LANE - 1) // LANE) * LANE)
+    bm = next(b for b in (128, 64, 32, 16, 8) if s % b == 0) if s % 8 == 0 \
+        else 128
+    item = jnp.dtype(dtype).itemsize
+    return h * px * item + bm * h * item + 4 * bm * px * 4 + 8 * px * 4
+
+
+# Single-tile gate ceiling: the kernel holds the full padded-E logits tile
+# in VMEM, so it serves E up to a few thousand (h=2048 bf16: E <= ~4k).
+# Past the budget the router falls back to router_xla — semantically
+# identical, and XLA's own tiling IS the two-pass softmax/top-k the
+# reference's multi-block ring implements by hand (gate.cuh:93-467).
+_GATE_VMEM_BUDGET = 12 * 2**20
+
+
 def router(x, gate_w, cfg: MoEConfig, use_pallas: bool = True,
            interpret: bool = False) -> RouterOutput:
     """Dispatch to the fused kernel on TPU, XLA fallback elsewhere.
-    Differentiable on both paths."""
+    Differentiable on both paths.  Large-E configs beyond the single-tile
+    kernel's VMEM budget (:func:`gate_vmem_bytes`) route to XLA."""
     on_tpu = interpret or jax.default_backend() == "tpu"
-    if use_pallas and x.shape[0] % 8 == 0 and on_tpu:
+    s, h = x.shape
+    fits = gate_vmem_bytes(s, h, cfg.num_experts, x.dtype) \
+        <= _GATE_VMEM_BUDGET
+    if use_pallas and s % 8 == 0 and on_tpu and fits:
         return _router_pallas_ad(x, gate_w, cfg, interpret)
     return router_xla(x, gate_w, cfg)

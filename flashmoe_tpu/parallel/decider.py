@@ -12,8 +12,10 @@ capability envelope:
     its parts' (``os/decider/functions.cuh:34-45``).
   * **objective** — gamma * (compute/rate + eta * intra-group comm) + the
     inter-group gradient-allreduce time in training mode
-    (``functions.cuh:20-26``), with the ring-allreduce model
-    ``2 * (G-1)/G * buffer / bottleneck-bandwidth`` (``functions.cuh:28-32``).
+    (``functions.cuh:20-26``), with the ring model ``2 (G-1) * bottleneck``
+    priced from the ACTUAL worst external edge, maintained across merges
+    in a priority queue (``decider.cuh:60, 86-158``); inference jobs use
+    the no-allreduce specialization (``decider.cuh:177-268``).
   * **memory feasibility** — groups that cannot hold the full expert set
     must keep merging (``decider.cuh:50-55, 120-155``).
   * **expert assignment** — within a group, experts are partitioned across
@@ -117,13 +119,17 @@ class Placement:
 
 
 def _intra_comm_ms(members, adj: Adjacency, mbytes: float) -> float:
-    """Worst pairwise one-shot transfer inside the group — the dispatch/
-    combine bottleneck edge."""
+    """Worst pairwise transfer inside the group — the dispatch/combine
+    bottleneck edge.  The payload each peer exchanges shrinks as the group
+    grows (the all-to-all slab is 1/|G| of the activations), mirroring the
+    reference's ``evalP2PTime`` with ``p2pBuffer / numNodes``
+    (``os/decider/comps/group.cuh``)."""
+    n = max(len(members), 1)
     worst = 0.0
     for i in members:
         for j in members:
             if i != j:
-                worst = max(worst, adj.transfer_ms(i, j, mbytes))
+                worst = max(worst, adj.transfer_ms(i, j, mbytes / n))
     return worst
 
 
@@ -152,14 +158,30 @@ def _placement_from_native(group_ids, counts, n: int, e: int) -> Placement:
 
 def decide(adj: Adjacency, workers: list[WorkerAttr], cfg: MoEConfig,
            expert_mb: float | None = None,
-           native: str | bool = "auto") -> Placement:
+           native: str | bool = "auto",
+           price_mode: str = "bottleneck") -> Placement:
     """Form DP x EP groups and assign experts (the reference's
     ``Decider<JobType>::operator()`` + ``assign``).
+
+    Training mode prices the inter-group gradient allreduce with the
+    ACTUAL bottleneck external edge, maintained in a max-heap across
+    merges exactly as the reference's ``externalEdges`` priority queue
+    (``decider.cuh:60, 86-130``): edges that become intra-group leave the
+    pool, so the priced bottleneck improves as slow links are absorbed
+    into groups — and, crucially, the allreduce term DIFFERS between the
+    merged and unmerged sides of each comparison (fewer groups and a
+    possibly different bottleneck edge), so it can decide merges.
+    ``price_mode="max_beta"`` keeps the round-2 global-max-β model for
+    comparison (tests show it groups worse).  Inference jobs
+    (``cfg.is_training=False``) use the reference's specialization with
+    no allreduce term at all (``decider.cuh:177-268``).
 
     ``native``: "auto" prefers the C++ implementation
     (:mod:`flashmoe_tpu.parallel._native`) when it builds/loads, True
     requires it, False forces pure Python.
     """
+    import heapq
+
     n = adj.n
     e = cfg.num_experts
     import jax.numpy as jnp
@@ -181,7 +203,7 @@ def decide(adj: Adjacency, workers: list[WorkerAttr], cfg: MoEConfig,
         gamma=gamma,
     )
 
-    if native != False:  # noqa: E712  ("auto" and True both try native)
+    if native != False and price_mode == "bottleneck":  # noqa: E712
         from flashmoe_tpu.parallel import _native
 
         res = _native.native_decide(
@@ -201,16 +223,65 @@ def decide(adj: Adjacency, workers: list[WorkerAttr], cfg: MoEConfig,
 
     dsu = _DSU(n)
     members = {d: [d] for d in range(n)}
+    training = cfg.is_training and grad_mb > 0
 
-    def obj(mem) -> float:
+    def obj(mem, ar_ms) -> float:
+        # memory-infeasible groups price at infinity, which is exactly the
+        # reference's must-merge encoding (functions.cuh obj(): inf when
+        # groupMemCapacity < totalExpertMemoryDemand; optimizingPolicy
+        # accepts any merge between two infinite sides)
+        if not can_hold_all(mem):
+            return float("inf")
         intra = _intra_comm_ms(mem, adj, act_mb)
-        ar = 0.0
-        if cfg.is_training and grad_mb > 0:
-            # surviving-group count shrinks as merges happen; use current
-            num_groups = len({dsu.find(x) for x in range(n)})
-            worst_beta = float(np.max(adj.beta)) if n > 1 else 0.0
-            ar = ring_allreduce_ms(grad_mb, num_groups, worst_beta)
-        return group_objective(mem, rates, intra, args, ar)
+        return group_objective(mem, rates, intra, args, ar_ms)
+
+    # --- inter-group allreduce bottleneck: max-heap of external edges ---
+    # keyed by the edge's per-chunk gradient transfer time (the reference's
+    # ARArgs::bottleneck); heapq is a min-heap, so negate.
+    def bot_time(i, j):
+        return adj.transfer_ms(i, j, grad_mb / max(n, 1))
+
+    ext: list = []
+    if training and price_mode == "bottleneck":
+        ext = [(-bot_time(i, j), i, j)
+               for i in range(n) for j in range(n) if i != j]
+        heapq.heapify(ext)
+    max_beta = float(np.max(adj.beta)) if n > 1 else 0.0
+
+    def groups_now():
+        return len({dsu.find(x) for x in range(n)})
+
+    def ar_terms(ra, rb):
+        """(ar_parts, ar_merged): the allreduce price before/after the
+        hypothetical merge of roots ra+rb.  Pops permanently-intra edges;
+        edges that the merge would internalize go to ``limbo`` and are
+        re-pushed only if the merge is rejected (decider.cuh:96-158)."""
+        g = groups_now()
+        if not training:
+            return 0.0, 0.0, []
+        if price_mode == "max_beta":
+            # legacy: same bottleneck both sides, only G differs
+            return (ring_allreduce_ms(grad_mb, g, max_beta),
+                    ring_allreduce_ms(grad_mb, max(g - 1, 1), max_beta),
+                    [])
+        limbo = []
+        while ext:
+            key, i, j = ext[0]
+            fi, fj = dsu.find(i), dsu.find(j)
+            if fi == fj:
+                heapq.heappop(ext)          # intra forever: discard
+                continue
+            if {fi, fj} == {ra, rb}:
+                limbo.append(heapq.heappop(ext))  # internal iff merged
+                continue
+            break
+        # bottleneck for the CURRENT partition includes limbo edges
+        cand = ext[:1] + limbo
+        cur_bot = max((-k for k, _, _ in cand), default=0.0)
+        ar_parts = 2.0 * (g - 1) * cur_bot if g > 1 else 0.0
+        post_bot = -ext[0][0] if ext and g - 1 > 1 else 0.0
+        ar_merged = 2.0 * (g - 2) * post_bot if g - 1 > 1 else 0.0
+        return ar_parts, ar_merged, limbo
 
     # candidate edges sorted by p2p transfer time of one activation buffer
     edges = sorted(
@@ -225,14 +296,19 @@ def decide(adj: Adjacency, workers: list[WorkerAttr], cfg: MoEConfig,
             continue
         ga, gb = members[ra], members[rb]
         merged = ga + gb
-        # infeasible groups MUST merge; feasible ones merge only if the
-        # objective does not regress (functions.cuh:34-45)
-        must = not can_hold_all(ga) or not can_hold_all(gb)
-        if must or obj(merged) <= max(obj(ga), obj(gb)):
+        ar_parts, ar_merged, limbo = ar_terms(ra, rb)
+        o1, o2 = obj(ga, ar_parts), obj(gb, ar_parts)
+        om = obj(merged, ar_merged)
+        both_inf = o1 == float("inf") and o2 == float("inf")
+        if both_inf or om <= max(o1, o2):
             root = dsu.union(ra, rb)
             other = rb if root == ra else ra
             members[root] = merged
             del members[other]
+            # limbo edges became intra-group: stay out of the pool
+        else:
+            for item in limbo:
+                heapq.heappush(ext, item)
 
     # any still-infeasible group merges into its cheapest feasible neighbor
     changed = True

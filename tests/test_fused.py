@@ -142,6 +142,26 @@ def test_fused_non_tile_multiple_capacity(devices):
     )
 
 
+@pytest.mark.parametrize("mode", ["1", "0"], ids=["in_kernel", "xla"])
+def test_fused_combine_modes_match_oracle(mode, monkeypatch, devices):
+    """FLASHMOE_FUSED_COMBINE forces each combine implementation; both
+    must match the dense oracle (and hence each other) — incl. drops,
+    where empty slots hold unwritten slab memory the in-kernel combine
+    must never read."""
+    monkeypatch.setenv("FLASHMOE_FUSED_COMBINE", mode)
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                    intermediate_size=256, sequence_len=1024,
+                    capacity_factor=1.0, drop_tokens=True, ep=4, **F32)
+    params, x = _setup(cfg)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:4])
+    got = fused_ep_moe_layer(params, x, cfg, mesh, interpret=True,
+                             detect_races=(mode == "1"))
+    want = ep_moe_layer(params, x, cfg, mesh, use_pallas=False)
+    np.testing.assert_allclose(
+        np.asarray(got.out), np.asarray(want.out), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_fused_gated_with_shared_experts(devices):
     """SwiGLU experts stream through the kernel; shared experts add in."""
     cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,

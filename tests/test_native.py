@@ -57,6 +57,35 @@ def test_native_matches_python(native_lib, scenario):
     assert py.local_experts == cc.local_experts
 
 
+@pytest.mark.parametrize("training", [True, False], ids=["train", "infer"])
+def test_native_matches_python_gateway(native_lib, training):
+    """The bottleneck-edge PQ pricing and the inference specialization
+    (round-3 additions) must agree between C++ and Python on the
+    DCN-gateway topology where they change the grouping decision."""
+    n = 4
+    alpha = np.zeros((n, n))
+    beta = np.zeros((n, n))
+    for i in range(n):
+        for j in range(n):
+            if i == j:
+                continue
+            if (i < 2) == (j < 2):
+                beta[i, j] = 0.05 if i < 2 else 0.001
+            else:
+                alpha[i, j] = 10.0
+                beta[i, j] = 0.002
+    adj = Adjacency(alpha, beta)
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=256,
+                    intermediate_size=512, sequence_len=128,
+                    vocab_size=8192, num_layers=1, is_training=training)
+    workers = [WorkerAttr(throughput=1.0, memory_gb=16.0)
+               for _ in range(n)]
+    py = decide(adj, workers, cfg, native=False)
+    cc = decide(adj, workers, cfg, native=True)
+    assert py.groups == cc.groups, (py.groups, cc.groups)
+    assert len(py.groups) == (1 if training else 2)
+
+
 def test_native_memory_forcing(native_lib):
     cfg = MoEConfig(num_experts=64, expert_top_k=2, hidden_size=4096,
                     intermediate_size=4096)

@@ -79,22 +79,69 @@ def test_pipeline_grad(devices):
         assert np.isfinite(np.asarray(leaf)).all()
 
 
-def test_pipeline_with_ep_in_stage(devices):
+@pytest.mark.parametrize("use_pallas", [False, True], ids=["xla", "pallas"])
+def test_pipeline_with_ep_in_stage(use_pallas, devices):
     """PP x EP composition: experts shard over ep INSIDE each stage (the
     stage's MoE runs the in-shard_map all-to-all body), and the CE still
-    matches the plain forward."""
+    matches the plain forward — including with the Pallas kernel body
+    (interpret mode here; the production path on real TPU)."""
     cfg = CFG.replace(pp=2, dp=2, ep=2)
     params = init_params(jax.random.PRNGKey(0), cfg)
     mesh = make_mesh(cfg, devices=devices[:8], dp=2)
     batch = _batch(b=8)  # dp*ep*mb = 2*2*2
-    total, m = pipeline_loss(params, batch, cfg, mesh, num_microbatches=2)
+    total, m = pipeline_loss(params, batch, cfg, mesh, num_microbatches=2,
+                             use_pallas=use_pallas)
     _, wm = loss_fn(params, batch, cfg, None)
-    np.testing.assert_allclose(float(m["ce"]), float(wm["ce"]), rtol=1e-5)
+    np.testing.assert_allclose(float(m["ce"]), float(wm["ce"]),
+                               rtol=2e-5 if use_pallas else 1e-5)
     g = jax.grad(
-        lambda p: pipeline_loss(p, batch, cfg, mesh, num_microbatches=2)[0]
+        lambda p: pipeline_loss(p, batch, cfg, mesh, num_microbatches=2,
+                                use_pallas=use_pallas)[0]
     )(params)
     for leaf in jax.tree_util.tree_leaves(g):
         assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_pipeline_vocab_gemm_is_conditional(devices):
+    """Non-final ticks must skip the LM head: every vocab-sized GEMM in
+    the lowered HLO must live in a computation reachable only from a
+    ``conditional`` branch, never directly in the scan/while tick body
+    (round-2 verdict weak #3)."""
+    import re
+
+    cfg = CFG.replace(pp=4, dp=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh(cfg, devices=devices[:8])
+    batch = _batch(b=4)
+    txt = jax.jit(
+        lambda p, b: pipeline_loss(p, b, cfg, mesh, num_microbatches=2)[0]
+    ).lower(params, batch).as_text()  # StableHLO MLIR
+    lines = txt.splitlines()
+
+    # spans of stablehlo.if/case ops: all their regions, by brace balance
+    spans = []
+    for i, ln in enumerate(lines):
+        if "stablehlo.if" in ln or "stablehlo.case" in ln:
+            bal = 0
+            for j in range(i, len(lines)):
+                bal += lines[j].count("{") - lines[j].count("}")
+                if j > i and bal <= 0:
+                    spans.append((i, j))
+                    break
+    assert spans, "lax.cond was lowered away (no stablehlo.if/case)"
+
+    v = cfg.vocab_size
+    dot_lines = [
+        i for i, ln in enumerate(lines)
+        if "dot_general" in ln
+        and re.search(rf"tensor<[\dx]*x{v}xf32>", ln)
+    ]
+    assert dot_lines, "vocab GEMM vanished from the HLO (test is stale)"
+    for i in dot_lines:
+        assert any(a < i < b for a, b in spans), (
+            f"vocab GEMM at line {i} is outside every conditional region"
+        )
+
 
 
 def test_stage_stacking_validation():
