@@ -3,6 +3,10 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
+``vs_baseline`` is ``null`` (and the record carries a ``partial`` field,
+with exit code 3) when the xla comparison leg never completed — partial
+records are machine-distinguishable from genuine no-speedup results.
+
 The headline config mirrors the reference's benchmark setting
 (``csrc/flashmoe_config.json``: E=64, top-k=2, H=2048, I=2048, S=8192) run
 through the fused Pallas path.  ``vs_baseline`` is the speedup of the fused
@@ -119,9 +123,9 @@ def _mxu_util(cfg: MoEConfig, seconds: float) -> float | None:
 
 def _emit(cfg, name, t_fused, t_xla, note: str | None = None):
     """One JSON record.  ``t_xla=None`` marks a partial measurement (the
-    xla leg never completed): vs_baseline falls back to 1.0 and the record
-    carries an explicit ``partial`` field so it cannot be mistaken for a
-    genuine no-speedup result."""
+    xla leg never completed): vs_baseline is ``null`` — not a number a
+    driver could mistake for a genuine no-speedup result — and the record
+    carries an explicit ``partial`` field (advisor round-3 #4)."""
     try:
         util = _mxu_util(cfg, t_fused)
     except Exception:  # noqa: BLE001 — never lose the record over the label
@@ -133,7 +137,7 @@ def _emit(cfg, name, t_fused, t_xla, note: str | None = None):
                   f"{jnp.dtype(cfg.dtype).name}]",
         "value": round(t_fused * 1e3, 3),
         "unit": "ms",
-        "vs_baseline": round(t_xla / t_fused, 3) if t_xla else 1.0,
+        "vs_baseline": round(t_xla / t_fused, 3) if t_xla else None,
         "tokens_per_sec_per_chip": round(cfg.tokens / t_fused),
         "xla_path_ms": round(t_xla * 1e3, 3) if t_xla else None,
         "mxu_util": round(util, 4) if util is not None else None,
@@ -320,16 +324,17 @@ def main():
     def emit_best_partial(reason):
         """Emit whatever full measurement exists for the in-flight config
         (sweeps included: _PARTIAL carries that point's own cfg/name).
-        Exit 0 only for the single headline number; an interrupted sweep
-        exits 1 so a driver keying off the code sees the run as
-        incomplete even though the emitted rows are real."""
+        Exit codes are machine-distinguishable: 0 = headline fully
+        measured, 1 = interrupted sweep (emitted rows are real), 3 =
+        headline partial (xla leg missing; the record also carries
+        vs_baseline null), 2 = nothing measured."""
         tf, tx = _PARTIAL.get("fused"), _PARTIAL.get("xla")
         pcfg, pname = _PARTIAL.get("cfg"), _PARTIAL.get("name")
         if tf is not None and pcfg is not None:
             _emit(pcfg, pname, tf, tx,
                   note=f"{reason}; xla path "
                        f"{'measured' if tx else 'missing'}")
-            sys.exit(1 if args.sweep else 0)
+            sys.exit(1 if args.sweep else (0 if tx is not None else 3))
         emit_error(reason)
 
     def on_deadline(signum, frame):

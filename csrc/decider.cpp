@@ -152,12 +152,22 @@ int flashmoe_decide(int n, const double* alpha, const double* beta,
         }
         break;
       }
+      // Heap ORDER stays keyed at the initial chunk grad_mb/n; the VALUE
+      // is repriced with the chunk of the live partition (grad_mb/g now,
+      // grad_mb/(g-1) post-merge) — the reference's ARArgs::refresh
+      // (args.cuh:37, decider.cuh:96-158).
       int g = num_groups();
-      double cur_bot = ext.empty() ? 0.0 : ext.top().w;
-      for (const Edge& l : limbo) cur_bot = std::max(cur_bot, l.w);
+      double cur_bot = 0.0;
+      if (!ext.empty())
+        cur_bot = ctx.transfer_ms(ext.top().a, ext.top().b, ctx.grad_mb / g);
+      for (const Edge& l : limbo)
+        cur_bot = std::max(cur_bot,
+                           ctx.transfer_ms(l.a, l.b, ctx.grad_mb / g));
       ar_parts = g > 1 ? 2.0 * (g - 1) * cur_bot : 0.0;
       ar_merged = (g - 1 > 1 && !ext.empty())
-                      ? 2.0 * (g - 2) * ext.top().w
+                      ? 2.0 * (g - 2) *
+                            ctx.transfer_ms(ext.top().a, ext.top().b,
+                                            ctx.grad_mb / (g - 1))
                       : 0.0;
     }
     double o1 = ctx.objective(ga, ar_parts);

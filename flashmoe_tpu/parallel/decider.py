@@ -275,11 +275,24 @@ def decide(adj: Adjacency, workers: list[WorkerAttr], cfg: MoEConfig,
                 limbo.append(heapq.heappop(ext))  # internal iff merged
                 continue
             break
-        # bottleneck for the CURRENT partition includes limbo edges
+        # bottleneck for the CURRENT partition includes limbo edges.
+        # Heap ORDER is fixed at the initial chunk grad_mb/n, but the
+        # VALUE is repriced with the chunk of the current partition —
+        # grad_mb/g now, grad_mb/(g-1) post-merge — mirroring the
+        # reference's ARArgs::refresh, which re-derives bottleneckTime
+        # from the live group count before every objective evaluation
+        # (args.cuh:37, decider.cuh:96-158).  Without the refresh the
+        # term is underpriced as merges shrink the partition (advisor
+        # round-3 finding).
         cand = ext[:1] + limbo
-        cur_bot = max((-k for k, _, _ in cand), default=0.0)
+        cur_bot = max(
+            (adj.transfer_ms(i, j, grad_mb / g) for _, i, j in cand),
+            default=0.0,
+        )
         ar_parts = 2.0 * (g - 1) * cur_bot if g > 1 else 0.0
-        post_bot = -ext[0][0] if ext and g - 1 > 1 else 0.0
+        post_bot = (adj.transfer_ms(ext[0][1], ext[0][2],
+                                    grad_mb / (g - 1))
+                    if ext and g - 1 > 1 else 0.0)
         ar_merged = 2.0 * (g - 2) * post_bot if g - 1 > 1 else 0.0
         return ar_parts, ar_merged, limbo
 

@@ -175,3 +175,29 @@ def test_fused_gated_with_shared_experts(devices):
     np.testing.assert_allclose(
         np.asarray(out.out), np.asarray(want), rtol=2e-4, atol=2e-4
     )
+
+
+def test_fuse_combine_gate_is_opt_in(monkeypatch):
+    """The in-kernel combine is opt-in until a hardware stage_bench row
+    justifies a default (advisor r3 #1/#2): env unset -> XLA combine;
+    env=1 -> enabled only within the SMEM/VMEM budget, with a warning
+    (not a Mosaic compile failure) when the combine maps are too large."""
+    from flashmoe_tpu.parallel.fused import _fuse_combine_enabled
+
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                    intermediate_size=256, sequence_len=256,
+                    drop_tokens=False, **F32)
+    monkeypatch.delenv("FLASHMOE_FUSED_COMBINE", raising=False)
+    assert not _fuse_combine_enabled(cfg, 256, 128, 256, 64)
+
+    monkeypatch.setenv("FLASHMOE_FUSED_COMBINE", "1")
+    assert _fuse_combine_enabled(cfg, 256, 128, 256, 64)
+
+    # 4096 experts x 4096-slot capacity: comb maps alone are 128 MiB of
+    # SMEM — must fall back (with a warning), never Mosaic-fail
+    big = cfg.replace(num_experts=4096)
+    with pytest.warns(UserWarning, match="SMEM/VMEM budget"):
+        assert not _fuse_combine_enabled(big, 256, 128, 256, 4096)
+
+    monkeypatch.setenv("FLASHMOE_FUSED_COMBINE", "0")
+    assert not _fuse_combine_enabled(cfg, 256, 128, 256, 64)

