@@ -409,19 +409,40 @@ def _gft_bwd(act_name, gated, block_m, block_i, interpret, res, dy):
 grouped_ffn_tokens_ad.defvjp(_gft_fwd, _gft_bwd)
 
 
-def _capacity_tiling(c: int) -> tuple[int, int, int]:
+def _capacity_tiling(c: int, cfg: MoEConfig | None = None
+                     ) -> tuple[int, int, int]:
     """Shared row-tile selection for the capacity-buffer kernels: returns
     ``(block_m, padded_capacity, block_i)``.  Capacities <= 512 round up
     to the sublane multiple (each expert's weights stream through VMEM
-    exactly once); larger ones tile at the largest dividing block."""
+    exactly once); larger ones tile at the largest dividing block.
+
+    When a measured tuning entry matches (``flashmoe_tpu.tuning`` — the
+    TPU analogue of the reference's per-arch trait table,
+    ``arch.cuh:95-222``), its block sizes override the heuristic."""
     if c <= 512:
         bm = ((c + 7) // 8) * 8
     else:
         bm = next(b for b in (512, 256, 128) if c % b == 0) if any(
             c % b == 0 for b in (512, 256, 128)
         ) else 512
-    cp = ((c + bm - 1) // bm) * bm
     block_i = 512 if bm <= 256 else 256
+    if cfg is not None:
+        from flashmoe_tpu import tuning
+
+        t = tuning.lookup(
+            "capacity_ffn", h=cfg.hidden_size, i=cfg.intermediate_size,
+            dtype=jnp.dtype(cfg.dtype).name,
+        )
+        bm_t = t.get("block_m")
+        # same ignore-if-not-dividing contract as the fused kernel's cm
+        # override: a block measured at a large capacity must not inflate
+        # a small runtime capacity's padding (tuning entries match on
+        # (h, i, dtype) only)
+        if bm_t and bm_t % 8 == 0 and c % bm_t == 0:
+            bm = bm_t
+        if t.get("block_i"):
+            block_i = t["block_i"]  # _auto_block re-fits it to I below
+    cp = ((c + bm - 1) // bm) * bm
     return bm, cp, block_i
 
 
@@ -439,7 +460,7 @@ def capacity_ffn_gather(x, plan, cfg: MoEConfig, capacity: int, params, *,
 
     _, h = x.shape
     e = cfg.num_experts
-    bm, cp, block_i = _capacity_tiling(capacity)
+    bm, cp, block_i = _capacity_tiling(capacity, cfg)
     src_tok, _ = dsp.dispatch_indices(plan, cfg, cp)
     tiles_per_e = cp // bm
     tile_gid = jnp.arange(e * tiles_per_e, dtype=jnp.int32) // tiles_per_e
@@ -858,7 +879,7 @@ def capacity_buffer_ffn_ad(xs, params, cfg: MoEConfig,
     reshaping as :func:`capacity_buffer_ffn_pallas` — autodiff flows
     through the reshapes natively."""
     e, c, h = xs.shape
-    bm, cp, block_i = _capacity_tiling(c)
+    bm, cp, block_i = _capacity_tiling(c, cfg)
     if cp != c:
         xs = jnp.pad(xs, ((0, 0), (0, cp - c), (0, 0)))
     x = xs.reshape(e * cp, h)
@@ -883,7 +904,7 @@ def capacity_buffer_ffn_pallas(xs, params, cfg: MoEConfig, *,
     never reads.
     """
     e, c, h = xs.shape
-    bm, cp, block_i = _capacity_tiling(c)
+    bm, cp, block_i = _capacity_tiling(c, cfg)
     if cp != c:
         xs = jnp.pad(xs, ((0, 0), (0, cp - c), (0, 0)))
     x = xs.reshape(e * cp, h)

@@ -194,6 +194,220 @@ def router_pallas(x, gate_w, cfg: MoEConfig, interpret: bool = False
     return _finish(cfg, top_p, top_i, probs_sum, counts, zsum, s)
 
 
+# ----------------------------------------------------------------------
+# Two-pass expert-tiled gate: E beyond one VMEM tile
+# ----------------------------------------------------------------------
+#
+# The reference handles E > one CUDA tile with a block-ring: phase 1
+# passes an (max, sum) baton around SMs to form the global softmax
+# normalizer, phase 2 rings the top-k (``gate.cuh:93-467``).  The TPU
+# equivalent tiles the EXPERT axis across grid steps of one core:
+#
+#   pass 1 (grid nt x nj, experts inner): logits tile GEMM -> online
+#     softmax running (m, se) in VMEM scratch + running top-k merged
+#     tile-by-tile (the baton is just kernel-resident state); logits are
+#     spilled to HBM so pass 2 need not redo the GEMM.
+#   pass 2 (grid nj x nt, tokens inner): re-reads each logits tile with
+#     the final (m, se) to accumulate the exact per-expert probability
+#     sums / selection counts / z-loss the aux losses need (these are
+#     sums over tokens of globally-normalized probs, so they cannot be
+#     finalized inside pass 1's running rescale).
+
+_ET = 512  # expert-tile width (lanes) of the two-pass gate
+
+
+def _gate_pass1_kernel(x_ref, w_ref, logits_ref, m_ref, se_ref, tv_ref,
+                       ti_ref, mrun, serun, topv, topi, *, k, e, et):
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    bm = x_ref.shape[0]
+    neg = jnp.float32(-1e30)
+
+    @pl.when(j == 0)
+    def _():
+        mrun[:] = jnp.full_like(mrun, neg)
+        serun[:] = jnp.zeros_like(serun)
+        topv[:] = jnp.full_like(topv, neg)
+        topi[:] = jnp.full_like(topi, -1)
+
+    logits = jnp.dot(
+        x_ref[:].astype(jnp.float32), w_ref[:].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )  # [bm, et]
+    col = jax.lax.broadcasted_iota(jnp.int32, (bm, et), 1)
+    gcol = col + j * et
+    logits = jnp.where(gcol < e, logits, neg)
+    logits_ref[:] = logits
+
+    # online (max, sum) update with rescale — the softmax baton
+    m_old = mrun[:, 0:1]
+    mt = jnp.max(logits, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_old, mt)
+    ex = jnp.where(gcol < e, jnp.exp(logits - m_new), 0.0)
+    se_new = (serun[:, 0:1] * jnp.exp(m_old - m_new)
+              + jnp.sum(ex, axis=-1, keepdims=True))
+    mrun[:] = jnp.broadcast_to(m_new, mrun.shape)
+    serun[:] = jnp.broadcast_to(se_new, serun.shape)
+
+    # tile top-k by logit (same order as by prob), then merge with the
+    # carried top-k.  Expert ranges of carried vs tile candidates are
+    # disjoint, so indices never collide.
+    p = logits
+    cand_v, cand_i = [], []
+    for _ in range(k):
+        mx = jnp.max(p, axis=-1, keepdims=True)
+        is_mx = (p == mx) & (gcol < e)
+        idx = jnp.min(jnp.where(is_mx, gcol, jnp.int32(2**30)),
+                      axis=-1, keepdims=True)
+        ok = idx < jnp.int32(2**30)
+        cand_v.append(jnp.where(ok, mx, neg))
+        cand_i.append(jnp.where(ok, idx, -1))
+        p = jnp.where(gcol == idx, neg, p)
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, topv.shape, 1)
+    cv, ci = topv[:], topi[:]
+    for t in range(k):
+        cv = jnp.where(lane == k + t, cand_v[t], cv)
+        ci = jnp.where(lane == k + t, cand_i[t], ci)
+    nv = jnp.full_like(cv, neg)
+    ni = jnp.full_like(ci, -1)
+    for t in range(k):
+        mx = jnp.max(cv, axis=-1, keepdims=True)
+        lsel = jnp.min(jnp.where(cv == mx, lane, jnp.int32(2**30)),
+                       axis=-1, keepdims=True)
+        hit = lane == lsel
+        isel = jnp.max(jnp.where(hit, ci, -1), axis=-1, keepdims=True)
+        nv = jnp.where(lane == t, mx, nv)
+        ni = jnp.where(lane == t, isel, ni)
+        cv = jnp.where(hit, neg, cv)
+    topv[:] = nv
+    topi[:] = ni
+
+    @pl.when(j == nj - 1)
+    def _():
+        m_ref[:] = mrun[:]
+        se_ref[:] = serun[:]
+        tv_ref[:] = topv[:]
+        ti_ref[:] = topi[:]
+
+
+def _gate_pass2_kernel(logits_ref, m_ref, se_ref, ti_ref, stats_ref, *,
+                       k, e, et):
+    j = pl.program_id(0)
+    ii = pl.program_id(1)
+    bm = logits_ref.shape[0]
+
+    @pl.when(ii == 0)
+    def _():
+        stats_ref[:] = jnp.zeros_like(stats_ref)
+
+    m = m_ref[:, 0:1]
+    se = se_ref[:, 0:1]
+    col = jax.lax.broadcasted_iota(jnp.int32, (bm, et), 1)
+    gcol = col + j * et
+    probs = jnp.where(gcol < e,
+                      jnp.exp(logits_ref[:] - m) / jnp.maximum(se, 1e-30),
+                      0.0)
+    sel = jnp.zeros((bm, et), jnp.float32)
+    for t in range(k):
+        sel = sel + (gcol == ti_ref[:, t:t + 1]).astype(jnp.float32)
+    # z-loss partial once per token tile (tile j==0 carries it)
+    lse = m + jnp.log(jnp.maximum(se, 1e-30))
+    zpart = jnp.sum(jnp.square(lse)) * jnp.where(j == 0, 1.0, 0.0)
+    row = jax.lax.broadcasted_iota(jnp.int32, (8, et), 0)
+    lane0 = jax.lax.broadcasted_iota(jnp.int32, (8, et), 1) == 0
+    stats_ref[:] = stats_ref[:] + (
+        jnp.where(row == 0, jnp.sum(probs, axis=0)[None, :], 0.0)
+        + jnp.where(row == 1, jnp.sum(sel, axis=0)[None, :], 0.0)
+        + jnp.where((row == 2) & lane0, zpart, 0.0)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "interpret"))
+def router_pallas_tiled(x, gate_w, cfg: MoEConfig, interpret: bool = False
+                        ) -> RouterOutput:
+    """Two-pass fused gate for E beyond the single-tile VMEM budget.
+    x: [S, H], gate_w: [H, E];  S % 8 == 0, E > _ET recommended."""
+    s, h = x.shape
+    e, k = cfg.num_experts, cfg.expert_top_k
+    if s % 8:
+        raise ValueError(f"token count {s} must be a multiple of 8")
+    if 2 * k > LANE:
+        # the carried+candidate top-k merge lives in lanes [0, 2k) of a
+        # LANE-wide scratch; beyond that candidates would silently drop
+        raise ValueError(f"top_k {k} exceeds the merge buffer ({LANE // 2})")
+    et = _ET
+    nj = (e + et - 1) // et
+    px = nj * et
+    bm = next(b for b in (128, 64, 32, 16, 8) if s % b == 0)
+    nt = s // bm
+    w_pad = jnp.zeros((h, px), gate_w.dtype).at[:, :e].set(gate_w)
+
+    logits, m, se, tv, ti = pl.pallas_call(
+        functools.partial(_gate_pass1_kernel, k=k, e=e, et=et),
+        grid=(nt, nj),
+        in_specs=[
+            pl.BlockSpec((bm, h), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((h, et), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, et), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, LANE), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, LANE), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, LANE), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, LANE), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s, px), jnp.float32),
+            jax.ShapeDtypeStruct((s, LANE), jnp.float32),
+            jax.ShapeDtypeStruct((s, LANE), jnp.float32),
+            jax.ShapeDtypeStruct((s, LANE), jnp.float32),
+            jax.ShapeDtypeStruct((s, LANE), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bm, LANE), jnp.float32),
+            pltpu.VMEM((bm, LANE), jnp.float32),
+            pltpu.VMEM((bm, LANE), jnp.float32),
+            pltpu.VMEM((bm, LANE), jnp.int32),
+        ],
+        interpret=interpret,
+    )(x, w_pad)
+
+    stats = pl.pallas_call(
+        functools.partial(_gate_pass2_kernel, k=k, e=e, et=et),
+        grid=(nj, nt),
+        in_specs=[
+            pl.BlockSpec((bm, et), lambda j, i: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, LANE), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, LANE), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, LANE), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, et), lambda j, i: (0, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, px), jnp.float32),
+        interpret=interpret,
+    )(logits, m, se, ti)
+
+    top_l = tv[:, :k]
+    top_i = ti[:, :k].astype(jnp.int32)
+    top_p = jnp.exp(top_l - m[:, 0:1]) / jnp.maximum(se[:, 0:1], 1e-30)
+    probs_sum = stats[0, :e]
+    counts = stats[1, :e].astype(jnp.int32)
+    zsum = stats[2, 0]
+    return _finish(cfg, top_p, top_i, probs_sum, counts, zsum, s)
+
+
 # The kernel has no autodiff rule; under AD the fused router runs its
 # forward and recomputes the backward through router_xla (identical math).
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
@@ -214,6 +428,19 @@ def _router_bwd(cfg, interpret, res, ct):
 _router_pallas_ad.defvjp(_router_fwd, _router_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _router_tiled_ad(x, gate_w, cfg: MoEConfig, interpret: bool):
+    return router_pallas_tiled(x, gate_w, cfg, interpret=interpret)
+
+
+def _router_tiled_fwd(x, gate_w, cfg, interpret):
+    return (router_pallas_tiled(x, gate_w, cfg, interpret=interpret),
+            (x, gate_w))
+
+
+_router_tiled_ad.defvjp(_router_tiled_fwd, _router_bwd)
+
+
 def gate_vmem_bytes(s: int, h: int, e: int, dtype) -> int:
     """Static VMEM estimate of the fused gate's working set: the weight
     tile [H, PX], the token tile [BM, H], and ~4 [BM, PX]-sized f32
@@ -227,21 +454,24 @@ def gate_vmem_bytes(s: int, h: int, e: int, dtype) -> int:
 
 # Single-tile gate ceiling: the kernel holds the full padded-E logits tile
 # in VMEM, so it serves E up to a few thousand (h=2048 bf16: E <= ~4k).
-# Past the budget the router falls back to router_xla — semantically
-# identical, and XLA's own tiling IS the two-pass softmax/top-k the
-# reference's multi-block ring implements by hand (gate.cuh:93-467).
+# Past the budget the router switches to the two-pass expert-tiled kernel
+# (:func:`router_pallas_tiled`) — the TPU form of the reference's
+# multi-block ring (gate.cuh:93-467).
 _GATE_VMEM_BUDGET = 12 * 2**20
 
 
 def router(x, gate_w, cfg: MoEConfig, use_pallas: bool = True,
            interpret: bool = False) -> RouterOutput:
-    """Dispatch to the fused kernel on TPU, XLA fallback elsewhere.
-    Differentiable on both paths.  Large-E configs beyond the single-tile
-    kernel's VMEM budget (:func:`gate_vmem_bytes`) route to XLA."""
+    """Dispatch to a fused kernel on TPU, XLA fallback elsewhere.
+    Differentiable on all paths.  Large-E configs beyond the single-tile
+    kernel's VMEM budget (:func:`gate_vmem_bytes`) use the two-pass
+    expert-tiled kernel."""
     on_tpu = interpret or jax.default_backend() == "tpu"
     s, h = x.shape
+    if not (use_pallas and s % 8 == 0 and on_tpu):
+        return router_xla(x, gate_w, cfg)
     fits = gate_vmem_bytes(s, h, cfg.num_experts, x.dtype) \
         <= _GATE_VMEM_BUDGET
-    if use_pallas and s % 8 == 0 and on_tpu and fits:
+    if fits:
         return _router_pallas_ad(x, gate_w, cfg, interpret)
-    return router_xla(x, gate_w, cfg)
+    return _router_tiled_ad(x, gate_w, cfg, interpret)

@@ -84,6 +84,7 @@ from flashmoe_tpu.parallel.ep import local_capacity
 
 def _fused_kernel(
     send_cnt, recv_cnt,                   # SMEM int32 [D, nLx] tile counts
+    src_order,                            # SMEM int32 [D, D] processing order
     comb_idx, comb_w,                     # SMEM [D*nLx, cap] (None = XLA combine)
     x_send, w_up, b_up, w_down, b_down,   # inputs (ANY/VMEM)
     x_recv, y_recv, y_stage, out,         # outputs (out: VMEM f32 accumulator,
@@ -196,8 +197,23 @@ def _fused_kernel(
         own.start()
         own.wait()
 
-    # ---- phase 2: process source slab in ring-arrival order ----
-    src = jax.lax.rem(my + s, d_world)
+    # ---- phase 2: process source slabs in expected-arrival order ----
+    # ``src_order[my]`` is a permutation of sources starting with ``my``
+    # (the own slab is local and ready immediately).  The default is ring
+    # order (src_order[r, s] = (r+s) mod D), which IS arrival order on a
+    # homogeneous ICI torus because phase 1 staggers sends by ring
+    # distance.  On heterogeneous fabrics (multi-slice: some sources
+    # behind a DCN hop) the caller passes
+    # :func:`flashmoe_tpu.parallel.topology.arrival_order`, which sorts
+    # sources by predicted alpha-beta arrival time — the static
+    # equivalent of the reference subscriber consuming packets in
+    # whatever order they land (``os/subscriber.cuh:333-451``); Mosaic
+    # semaphores have no try-wait, so the order is bound at trace time
+    # from the measured topology instead of polled at run time.
+    # Correctness never depends on the order: every slab's recv
+    # semaphore is awaited before use (see scripts/skew_sim.py for the
+    # quantified cost of a mispredicted order).
+    src = src_order[my, s]
 
     @pl.when(s != 0)
     def _():
@@ -457,7 +473,8 @@ def _fused_kernel(
         jax.lax.fori_loop(0, d_world, drain, 0)
 
 
-def _fused_shard(send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down, *,
+def _fused_shard(send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
+                 b_down, *,
                  cfg: MoEConfig, axis: str, interpret, collective_id: int,
                  detect_races: bool = False, w_gate=None,
                  comb_idx=None, comb_w=None, s_out: int | None = None):
@@ -477,6 +494,17 @@ def _fused_shard(send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down, *,
     # the combine accumulator claims VMEM, so cap the streamed weight
     # chunk lower when it is resident (see _fuse_combine_enabled)
     bi_cap = 256 if fuse_combine else (512 if cm <= 128 else 256)
+    # measured per-generation overrides (flashmoe_tpu.tuning; the
+    # reference's arch trait table, arch.cuh:95-222) — applied only when
+    # they still divide the shapes they claim to match
+    from flashmoe_tpu import tuning
+
+    tuned = tuning.lookup("fused_ep", h=h, i=i_dim,
+                          dtype=jnp.dtype(x_send.dtype).name)
+    if tuned.get("cm") and cap % tuned["cm"] == 0:
+        cm = tuned["cm"]
+    if tuned.get("bi_cap") and not fuse_combine:
+        bi_cap = tuned["bi_cap"]
     bi = min(bi_cap, i_dim)
     if i_dim % bi:
         raise ValueError(f"intermediate {i_dim} not divisible by {bi}")
@@ -500,8 +528,8 @@ def _fused_shard(send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down, *,
     ]
     any_spec = pl.BlockSpec(memory_space=pl.ANY)
     smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
-    in_specs = [smem_spec, smem_spec]
-    inputs = [send_cnt, recv_cnt]
+    in_specs = [smem_spec, smem_spec, smem_spec]
+    inputs = [send_cnt, recv_cnt, src_order]
     out_specs = [any_spec, any_spec, any_spec]
     if fuse_combine:
         s_pad = -(-s_out // 8) * 8
@@ -515,20 +543,20 @@ def _fused_shard(send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down, *,
     inputs += [x_send, w_up, b_up, w_down, b_down]
 
     if fuse_combine:
-        def kernel(send_cnt, recv_cnt, comb_idx, comb_w,
+        def kernel(send_cnt, recv_cnt, src_order, comb_idx, comb_w,
                    x_send, w_up, b_up, w_down, b_down,
                    x_recv, y_recv, y_stage, out,
                    xs, wup, wdn, acc, yv, bup, bdn, yc, *sems):
-            unified(send_cnt, recv_cnt, comb_idx, comb_w,
+            unified(send_cnt, recv_cnt, src_order, comb_idx, comb_w,
                     x_send, w_up, b_up, w_down, b_down,
                     x_recv, y_recv, y_stage, out,
                     xs, wup, wdn, acc, yv, bup, bdn, yc, *sems)
     else:
-        def kernel(send_cnt, recv_cnt,
+        def kernel(send_cnt, recv_cnt, src_order,
                    x_send, w_up, b_up, w_down, b_down,
                    x_recv, y_recv, y_stage,
                    xs, wup, wdn, acc, yv, bup, bdn, *sems):
-            unified(send_cnt, recv_cnt, None, None,
+            unified(send_cnt, recv_cnt, src_order, None, None,
                     x_send, w_up, b_up, w_down, b_down,
                     x_recv, y_recv, y_stage, None,
                     xs, wup, wdn, acc, yv, bup, bdn, None, *sems)
@@ -591,25 +619,26 @@ def _fused_shard(send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down, *,
 # grouped kernels (:func:`flashmoe_tpu.ops.expert.ffn_backward_core`).
 # Expert shards are disjoint across ep ranks, so dW needs no psum.
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11, 12))
-def _fused_core(send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down,
-                w_gate, cfg, axis, interpret, collective_id, detect_races):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12, 13))
+def _fused_core(send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
+                b_down, w_gate, cfg, axis, interpret, collective_id,
+                detect_races):
     return _fused_shard(
-        send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down,
+        send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down, b_down,
         cfg=cfg, axis=axis, interpret=interpret,
         collective_id=collective_id, detect_races=detect_races,
         w_gate=w_gate,
     )
 
 
-def _fused_core_fwd(send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down,
-                    w_gate, cfg, axis, interpret, collective_id,
-                    detect_races):
-    y = _fused_core(send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down,
-                    w_gate, cfg, axis, interpret, collective_id,
-                    detect_races)
-    return y, (send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down,
-               w_gate)
+def _fused_core_fwd(send_cnt, recv_cnt, src_order, x_send, w_up, b_up,
+                    w_down, b_down, w_gate, cfg, axis, interpret,
+                    collective_id, detect_races):
+    y = _fused_core(send_cnt, recv_cnt, src_order, x_send, w_up, b_up,
+                    w_down, b_down, w_gate, cfg, axis, interpret,
+                    collective_id, detect_races)
+    return y, (send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
+               b_down, w_gate)
 
 
 def _ffn_bwd_from_dy(cfg, axis, interpret, res, dy):
@@ -670,13 +699,14 @@ def _fused_core_bwd(cfg, axis, interpret, collective_id, detect_races,
                     res, dy):
     import numpy as np
 
-    send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down, w_gate = res
+    (send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down, b_down,
+     w_gate) = res
     grads = _ffn_bwd_from_dy(
         cfg, axis, interpret,
         (x_send, w_up, b_up, w_down, b_down, w_gate), dy,
     )
     f0 = lambda a: np.zeros(a.shape, jax.dtypes.float0)
-    return (f0(send_cnt), f0(recv_cnt)) + grads
+    return (f0(send_cnt), f0(recv_cnt), f0(src_order)) + grads
 
 
 _fused_core.defvjp(_fused_core_fwd, _fused_core_bwd)
@@ -694,13 +724,13 @@ _fused_core.defvjp(_fused_core_fwd, _fused_core_bwd)
 # gradients flow through dsp.combine_slot_maps' scatter transpose.
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(10, 11, 12, 13, 14, 15))
-def _fused_combine_core(send_cnt, recv_cnt, comb_idx, comb_w, x_send,
-                        w_up, b_up, w_down, b_down, w_gate,
+                   nondiff_argnums=(11, 12, 13, 14, 15, 16))
+def _fused_combine_core(send_cnt, recv_cnt, src_order, comb_idx, comb_w,
+                        x_send, w_up, b_up, w_down, b_down, w_gate,
                         cfg, axis, interpret, collective_id,
                         detect_races, s_out):
     out, _ = _fused_shard(
-        send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down,
+        send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down, b_down,
         cfg=cfg, axis=axis, interpret=interpret,
         collective_id=collective_id, detect_races=detect_races,
         w_gate=w_gate, comb_idx=comb_idx, comb_w=comb_w, s_out=s_out,
@@ -708,17 +738,17 @@ def _fused_combine_core(send_cnt, recv_cnt, comb_idx, comb_w, x_send,
     return out
 
 
-def _fused_combine_core_fwd(send_cnt, recv_cnt, comb_idx, comb_w, x_send,
-                            w_up, b_up, w_down, b_down, w_gate,
-                            cfg, axis, interpret, collective_id,
+def _fused_combine_core_fwd(send_cnt, recv_cnt, src_order, comb_idx,
+                            comb_w, x_send, w_up, b_up, w_down, b_down,
+                            w_gate, cfg, axis, interpret, collective_id,
                             detect_races, s_out):
     out, y_recv = _fused_shard(
-        send_cnt, recv_cnt, x_send, w_up, b_up, w_down, b_down,
+        send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down, b_down,
         cfg=cfg, axis=axis, interpret=interpret,
         collective_id=collective_id, detect_races=detect_races,
         w_gate=w_gate, comb_idx=comb_idx, comb_w=comb_w, s_out=s_out,
     )
-    return out, (send_cnt, recv_cnt, comb_idx, comb_w, x_send,
+    return out, (send_cnt, recv_cnt, src_order, comb_idx, comb_w, x_send,
                  w_up, b_up, w_down, b_down, w_gate, y_recv)
 
 
@@ -726,7 +756,7 @@ def _fused_combine_core_bwd(cfg, axis, interpret, collective_id,
                             detect_races, s_out, res, dout):
     import numpy as np
 
-    (send_cnt, recv_cnt, comb_idx, comb_w, x_send,
+    (send_cnt, recv_cnt, src_order, comb_idx, comb_w, x_send,
      w_up, b_up, w_down, b_down, w_gate, y_recv) = res
     d, nlx, cap, h = x_send.shape
 
@@ -755,7 +785,8 @@ def _fused_combine_core_bwd(cfg, axis, interpret, collective_id,
     ).reshape(comb_w.shape)
 
     f0 = lambda a: np.zeros(a.shape, jax.dtypes.float0)
-    return (f0(send_cnt), f0(recv_cnt), f0(comb_idx), d_w) + grads
+    return (f0(send_cnt), f0(recv_cnt), f0(src_order), f0(comb_idx),
+            d_w) + grads
 
 
 _fused_combine_core.defvjp(_fused_combine_core_fwd, _fused_combine_core_bwd)
@@ -814,16 +845,53 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
                        use_pallas_gate: bool | None = None,
                        token_axes: tuple[str, ...] = ("ep",),
                        collective_id: int = 7,
-                       detect_races: bool = False) -> MoEOutput:
+                       detect_races: bool = False,
+                       src_order=None) -> MoEOutput:
     """Expert-parallel MoE with the fused in-kernel all-to-all.
 
     Same contract as :func:`flashmoe_tpu.parallel.ep.ep_moe_layer`.  Gated
     (SwiGLU) experts stream through the kernel with chunk-interleaved
     gate|up weights; shared experts run XLA-side on the local token shard
     (they are replicated dense compute, not communication).
+
+    ``src_order`` ([D, D] int32; row r = the order in which rank r
+    processes source slabs, starting with r itself) overrides the default
+    ring schedule — pass :func:`flashmoe_tpu.parallel.topology.
+    arrival_order` on heterogeneous fabrics so slow-linked sources are
+    processed last instead of stalling earlier slabs (the reference's
+    arrival-order subscriber, ``os/subscriber.cuh:333-451``, bound
+    statically from the measured topology).
     """
 
-    def body(params, x):
+    d_world = mesh.shape["ep"]
+    if src_order is None:
+        ring = (jnp.arange(d_world, dtype=jnp.int32)[:, None]
+                + jnp.arange(d_world, dtype=jnp.int32)[None, :]) % d_world
+        src_order = ring
+    else:
+        if src_order.shape != (d_world, d_world):
+            raise ValueError(
+                f"src_order must be [{d_world}, {d_world}] (one "
+                f"processing order per ep rank), got {src_order.shape}")
+        # a row that is not an own-first permutation would make the kernel
+        # process a slab whose recv semaphore was never awaited (step 0)
+        # or wait on the never-signaled own slab — a silent race or a
+        # hang; src_order normally comes concrete from arrival_order, so
+        # check it at trace time when possible
+        try:
+            so = __import__("numpy").asarray(src_order)
+        except Exception:  # traced value: caller owns the invariant
+            so = None
+        if so is not None:
+            for r in range(d_world):
+                if so[r, 0] != r or sorted(so[r]) != list(range(d_world)):
+                    raise ValueError(
+                        f"src_order row {r} must be a permutation of "
+                        f"0..{d_world - 1} starting with {r}, got "
+                        f"{so[r].tolist()}")
+        src_order = jnp.asarray(src_order, jnp.int32)
+
+    def body(params, x, src_order):
         d = jax.lax.axis_size("ep")
         s_loc, h = x.shape
         nlx = cfg.num_experts // d
@@ -872,12 +940,13 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
                 comb_idx = jnp.pad(comb_idx, ((0, 0), (0, cap_pad - cap)))
                 comb_w = jnp.pad(comb_w, ((0, 0), (0, cap_pad - cap)))
             out = _fused_combine_core(
-                send_cnt, recv_cnt, comb_idx, comb_w, x_send, *w_args,
+                send_cnt, recv_cnt, src_order, comb_idx, comb_w, x_send,
+                *w_args,
                 cfg, "ep", interpret, collective_id, detect_races, s_loc,
             )[:s_loc]
         else:
             y_recv = _fused_core(
-                send_cnt, recv_cnt, x_send, *w_args,
+                send_cnt, recv_cnt, src_order, x_send, *w_args,
                 cfg, "ep", interpret, collective_id, detect_races,
             )
             ybuf = y_recv.reshape(cfg.num_experts, cap_pad, h)
@@ -896,8 +965,8 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
               else P() for k in params}
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(pspecs, P(token_axes, None)),
+        in_specs=(pspecs, P(token_axes, None), P()),
         out_specs=MoEOutput(P(token_axes, None), P(), P(), P()),
         check_vma=False,
     )
-    return fn(params, x)
+    return fn(params, x, src_order)

@@ -101,6 +101,46 @@ class Adjacency:
                 f.write(" ".join(f"{v:.6f}" for v in row) + "\n")
 
 
+def arrival_order(adj: Adjacency, payload_mb: float,
+                  stagger_ms: float = 0.0) -> np.ndarray:
+    """Per-rank source-processing order for the fused RDMA kernel, sorted
+    by predicted slab arrival time.
+
+    Row r is a permutation of ranks starting with r (the own slab is
+    local); the remaining sources are ordered by the alpha-beta transfer
+    estimate of their slab to r (+ ``stagger_ms`` x ring distance for the
+    send-issue stagger of the kernel's phase 1).  On a homogeneous ICI
+    torus this reduces to ring order; with heterogeneous links (e.g. a
+    DCN hop between slices) slow sources sink to the end so fast slabs
+    are never stalled behind them.
+
+    This is the static counterpart of the reference's subscriber, which
+    consumes packets in physical arrival order
+    (``csrc/include/flashmoe/os/subscriber.cuh:333-451``): a Pallas
+    kernel cannot poll semaphores without blocking, so the expected order
+    is bound at trace time from the same measured topology the Decider
+    uses.  Mispredictions cost stall time but never correctness (every
+    slab's recv semaphore is awaited; bound quantified in
+    ``scripts/skew_sim.py``).
+    """
+    n = adj.n
+    order = np.empty((n, n), dtype=np.int32)
+    for r in range(n):
+        others = [s for s in range(n) if s != r]
+        # sender s issues its copy toward r at phase-1 step
+        # (r - s - 1) mod n (the kernel sends dst = my+1, my+2, ...), so
+        # that is the issue-stagger penalty direction; ties keep the
+        # kernel's default ring order so stagger_ms=0 is zero-diff
+        issue_step = lambda s: (r - s - 1) % n
+        ring_dist = lambda s: (s - r) % n
+        others.sort(key=lambda s: (adj.transfer_ms(s, r, payload_mb)
+                                   + stagger_ms * issue_step(s),
+                                   ring_dist(s)))
+        order[r, 0] = r
+        order[r, 1:] = others
+    return order
+
+
 def _torus_hops(a, b, dims):
     """Minimal hop count between coords on a (possibly wrap-around) torus."""
     hops = 0

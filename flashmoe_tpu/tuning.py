@@ -1,0 +1,97 @@
+"""Per-generation kernel tuning table.
+
+The reference encodes per-architecture execution geometry in a constexpr
+trait table — blocks/SM, pipeline stages, tile sizes per (arch, register
+budget) (``csrc/include/flashmoe/arch.cuh:95-222``).  The TPU analogue is
+this table: measured winners for the Pallas kernels' block sizes keyed by
+(generation, kernel, shape), consulted at trace time, with the existing
+size-derived heuristics as the fallback when no measurement matches.
+
+The table is populated by ``scripts/tune_sweep.py`` running on real
+hardware (winners are committed to ``flashmoe_tpu/tuning_data/<gen>.json``
+so they ship with the package); entries are ignored with a warning if
+they stopped dividing the shapes they claim to match.
+
+Knobs per kernel family:
+
+  capacity_ffn   block_m (row tile), block_i (intermediate chunk) of the
+                 grouped capacity-buffer / gather-fused FFN kernels
+                 (``ops/expert.py:_capacity_tiling``).
+  fused_ep       cm (slab row tile), bi_cap (streamed-weight chunk cap)
+                 of the fused RDMA kernel (``parallel/fused.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import warnings
+
+_DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tuning_data")
+
+
+def generation() -> str:
+    """Current TPU generation, resolved without touching the backend (a
+    wedged remote tunnel must not hang trace-time tuning lookups):
+    FLASHMOE_TPU_GEN overrides, then the axon plugin's generation pin,
+    else v5e."""
+    return (os.environ.get("FLASHMOE_TPU_GEN")
+            or os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"))
+
+
+@functools.lru_cache(maxsize=8)
+def _load(gen: str) -> list:
+    """Measured entries for a generation: a list of
+    ``{"kernel": ..., "match": {...}, "set": {...}, "measured_ms": ...}``
+    dicts, most-specific first.  FLASHMOE_TUNING_FILE overrides the
+    committed per-generation file."""
+    path = os.environ.get("FLASHMOE_TUNING_FILE") or os.path.join(
+        _DATA_DIR, f"{gen}.json")
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return list(doc.get("entries", []))
+    except (OSError, ValueError) as e:  # unreadable table = no tuning
+        warnings.warn(f"ignoring unreadable tuning table {path}: {e}")
+        return []
+
+
+def lookup(kernel: str, gen: str | None = None, **shape) -> dict:
+    """Measured knob overrides for ``kernel`` at ``shape`` (h=, i=, cap=,
+    dtype=...), or {} when nothing matches.  An entry matches when every
+    key in its ``match`` dict equals the corresponding shape value."""
+    gen = gen or generation()
+    for ent in _load(gen):
+        if ent.get("kernel") != kernel:
+            continue
+        m = ent.get("match", {})
+        if all(shape.get(k) == v for k, v in m.items()):
+            return dict(ent.get("set", {}))
+    return {}
+
+
+def save_entries(gen: str, entries: list, path: str | None = None) -> str:
+    """Write a measured table (used by scripts/tune_sweep.py).  Replaces
+    existing entries for the same (kernel, match) keys, keeps others."""
+    path = path or os.path.join(_DATA_DIR, f"{gen}.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    old = []
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                old = json.load(f).get("entries", [])
+        except (OSError, ValueError):
+            old = []
+    keyof = lambda e: (e.get("kernel"),
+                       tuple(sorted(e.get("match", {}).items())))
+    new_keys = {keyof(e) for e in entries}
+    merged = entries + [e for e in old if keyof(e) not in new_keys]
+    with open(path, "w") as f:
+        json.dump({"generation": gen, "entries": merged}, f, indent=1,
+                  sort_keys=True)
+    _load.cache_clear()
+    return path

@@ -17,18 +17,15 @@ LOG=PROBE_r04.log
 OUTDIR=HWLOG_r04
 mkdir -p "$OUTDIR"
 
-probe() {
-  timeout "$PTIMEOUT" python -c \
-    "import jax, jax.numpy as jnp; print(jax.default_backend(), float(jnp.ones(8).sum()))" \
-    2>&1 | tail -1
-}
-
 attempt=0
 while true; do
   attempt=$((attempt + 1))
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-  out=$(probe)
-  rc=$?
+  out=$(timeout "$PTIMEOUT" python -c \
+    "import jax, jax.numpy as jnp; print(jax.default_backend(), float(jnp.ones(8).sum()))" \
+    2>&1)
+  rc=$?   # 124 = hung past the timeout; anything else is python's own exit
+  out=$(printf '%s\n' "$out" | grep -v -E "WARNING|INFO|WARN" | tail -1)
   if [ $rc -eq 0 ] && echo "$out" | grep -q "8.0"; then
     echo "$ts attempt=$attempt OK: $out" >> "$LOG"
     echo "$ts backend is UP — running hardware pipeline" >> "$LOG"
@@ -42,6 +39,11 @@ while true; do
     echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) bench rc=$?" >> "$LOG"
     timeout 1800 python scripts/stage_bench.py > "$OUTDIR/stage_bench.log" 2>&1
     echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) stage_bench rc=$?" >> "$LOG"
+    timeout 1200 python scripts/stage_bench.py --path combine \
+      > "$OUTDIR/combine_modes.log" 2>&1
+    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) combine_modes rc=$?" >> "$LOG"
+    timeout 2400 python scripts/tune_sweep.py > "$OUTDIR/tune_sweep.log" 2>&1
+    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tune_sweep rc=$?" >> "$LOG"
     exit 0
   fi
   echo "$ts attempt=$attempt DOWN rc=$rc: ${out:-<no output>}" >> "$LOG"

@@ -1,0 +1,149 @@
+"""Skew-cost experiment: ring-order vs arrival-order slab processing.
+
+The reference's subscriber consumes expert packets in whatever order they
+physically arrive (``csrc/include/flashmoe/os/subscriber.cuh:333-451``);
+the fused TPU kernel processes source slabs in a STATIC order (default
+ring) because Mosaic semaphores cannot be polled without blocking.  This
+script quantifies what that costs when links are skewed, using a
+discrete-event model of the kernel's phase-1/phase-2 protocol:
+
+  * every source's slab RDMA is issued asynchronously at t=0; slab s -> d
+    arrives at alpha[s,d] + beta[s,d] * slab_mb;
+  * each rank then processes sources sequentially (one grid step per
+    source, compute t_c per slab); source q starts at
+    max(prev_step_done, arrival_q); the own slab is local (arrival 0);
+  * makespan of rank r = when its last slab finishes.
+
+Orders compared:
+  ring    — src_order[r, s] = (r+s) mod D (the kernel's default);
+  pred    — :func:`flashmoe_tpu.parallel.topology.arrival_order` (sorted
+            by the alpha-beta estimate — what a heterogeneous deployment
+            should pass to ``fused_ep_moe_layer``);
+  oracle  — sorted by true arrival times (the reference's dynamic
+            subscriber, unattainable statically).
+
+Empirical bound (asserted across every swept case, see
+``tests/test_fused.py::test_arrival_order_and_skew_bounds``): for any
+processing order
+
+    makespan(order) - makespan(oracle) <= max_arrival - min_arrival
+
+i.e. a mispredicted order can stall at most one full arrival spread —
+one slow link cannot cascade beyond the slabs actually behind it.  On a
+homogeneous torus ring == oracle (zero cost); under a skewed link the
+predicted order recovers the oracle makespan whenever the alpha-beta
+estimate ranks sources like the true arrivals do.
+
+Usage: python scripts/skew_sim.py [--d 8] [--tc-ms 0.3] [--slab-mb 4]
+Prints one JSON line per (case, skew-factor) point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flashmoe_tpu.parallel.topology import Adjacency, arrival_order
+
+
+def makespan(arrivals: np.ndarray, order: np.ndarray, t_c: float) -> float:
+    """Sequential processing of sources in ``order`` with release times
+    ``arrivals``: step j starts at max(prev done, arrival[order[j]])."""
+    t = 0.0
+    for q in order:
+        t = max(t, float(arrivals[q])) + t_c
+    return t
+
+
+def rank_arrivals(adj: Adjacency, r: int, slab_mb: float) -> np.ndarray:
+    a = np.array([adj.transfer_ms(s, r, slab_mb) for s in range(adj.n)])
+    a[r] = 0.0  # own slab: local copy, effectively immediate
+    return a
+
+
+def simulate(adj_true: Adjacency, adj_est: Adjacency, slab_mb: float,
+             t_c: float) -> dict:
+    """Worst-rank makespan for ring / predicted / oracle orders, plus the
+    empirical stall bound (max arrival spread)."""
+    n = adj_true.n
+    ring = np.array([[(r + s) % n for s in range(n)] for r in range(n)],
+                    dtype=np.int32)
+    pred = arrival_order(adj_est, slab_mb)
+    out = {"ring": 0.0, "pred": 0.0, "oracle": 0.0, "spread": 0.0}
+    for r in range(n):
+        arr = rank_arrivals(adj_true, r, slab_mb)
+        others = np.delete(arr, r)
+        out["spread"] = max(out["spread"],
+                            float(others.max() - others.min()) if n > 1
+                            else 0.0)
+        oracle = np.argsort(arr, kind="stable")
+        out["ring"] = max(out["ring"], makespan(arr, ring[r], t_c))
+        out["pred"] = max(out["pred"], makespan(arr, pred[r], t_c))
+        out["oracle"] = max(out["oracle"], makespan(arr, oracle, t_c))
+    return out
+
+
+def torus_adj(n: int, alpha_ms: float = 0.001,
+              beta_ms_mb: float = 0.0222) -> Adjacency:
+    """Uniform single-hop ring costs (v5e-like: 45 GB/s/link)."""
+    alpha = np.full((n, n), alpha_ms)
+    beta = np.full((n, n), beta_ms_mb)
+    np.fill_diagonal(alpha, 0.0)
+    np.fill_diagonal(beta, 0.0)
+    return Adjacency(alpha, beta)
+
+
+def cases(n: int):
+    """(name, mutate(alpha, beta, factor)) skew scenarios."""
+    def one_link(al, be, f):
+        be[0, 1] *= f          # a single contended link into rank 1
+        al[0, 1] *= f
+
+    def slow_source(al, be, f):
+        be[0, :] *= f          # rank 0 behind a DCN hop: all its sends slow
+        al[0, :] *= f
+        be[0, 0] = al[0, 0] = 0.0
+
+    return [("one_link", one_link), ("slow_source", slow_source)]
+
+
+def run(n: int, slab_mb: float, t_c: float, factors=(1, 2, 4, 8, 16, 32)):
+    rows = []
+    for name, mutate in cases(n):
+        for f in factors:
+            adj = torus_adj(n)
+            mutate(adj.alpha, adj.beta, float(f))
+            r = simulate(adj, adj, slab_mb, t_c)
+            rows.append({
+                "case": name, "skew": f, "d": n,
+                "t_ring_ms": round(r["ring"], 4),
+                "t_pred_ms": round(r["pred"], 4),
+                "t_oracle_ms": round(r["oracle"], 4),
+                "arrival_spread_ms": round(r["spread"], 4),
+                "ring_stall_ms": round(r["ring"] - r["oracle"], 4),
+                "pred_stall_ms": round(r["pred"] - r["oracle"], 4),
+            })
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--d", type=int, default=8)
+    ap.add_argument("--slab-mb", type=float, default=4.0,
+                    help="per-source slab size (reference config, ep=8: "
+                         "nLx*C*H*2B ~ 4 MB)")
+    ap.add_argument("--tc-ms", type=float, default=0.3,
+                    help="per-slab expert-FFN compute time")
+    args = ap.parse_args()
+    for row in run(args.d, args.slab_mb, args.tc_ms):
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

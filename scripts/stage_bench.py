@@ -98,9 +98,14 @@ def main():
                     help="longer chain length for the differencing pair "
                          "(must be >= 2)")
     ap.add_argument("--config", default="reference")
-    ap.add_argument("--path", choices=["gather", "explicit"],
-                    default="gather")
+    ap.add_argument("--path", choices=["gather", "explicit", "combine"],
+                    default="gather",
+                    help="'combine' times the fused layer with in-kernel "
+                         "vs XLA combine instead of stage prefixes")
     args = ap.parse_args()
+    if args.path == "combine":
+        combine_modes(args)
+        return
     if args.chain < 2:
         ap.error("--chain must be >= 2 (per-iteration time comes from "
                  "differencing two chain lengths)")
@@ -129,6 +134,42 @@ def main():
             "stage_ms": round((t - prev) * 1e3, 3),
         }), flush=True)
         prev = t
+
+
+def combine_modes(args):
+    """The VERDICT r3 #2 decision row: the fused RDMA layer with the
+    in-kernel combine (FLASHMOE_FUSED_COMBINE=1) vs the XLA combine, on
+    a 1-rank mesh on the real chip.  The in-kernel combine's per-row VPU
+    scatter is the suspected serializer; whichever mode wins here sets
+    the default."""
+    from flashmoe_tpu.parallel.fused import fused_ep_moe_layer
+    from flashmoe_tpu.parallel.mesh import make_mesh
+
+    cfg = BENCH_CONFIGS[args.config].replace(ep=1)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    params = jax.tree_util.tree_map(lambda p: p.astype(cfg.dtype), params)
+    x = jax.random.normal(
+        jax.random.PRNGKey(1), (cfg.tokens, cfg.hidden_size), cfg.dtype)
+    mesh = make_mesh(cfg, dp=1, devices=jax.devices()[:1])
+    out = {}
+    for mode in ("0", "1"):
+        os.environ["FLASHMOE_FUSED_COMBINE"] = mode
+        try:
+            def fn(c):
+                o = fused_ep_moe_layer(params, c, cfg, mesh)
+                return o.out.astype(jnp.float32).sum()
+
+            t1 = time_chain(chained(fn, x, 1), x, args.trials)
+            tn = time_chain(chained(fn, x, args.chain), x, args.trials)
+            out[mode] = max(tn - t1, 0.0) / (args.chain - 1)
+        finally:
+            os.environ.pop("FLASHMOE_FUSED_COMBINE", None)
+    print(json.dumps({
+        "bench": "fused_combine_modes", "config": args.config,
+        "xla_combine_ms": round(out["0"] * 1e3, 3),
+        "in_kernel_combine_ms": round(out["1"] * 1e3, 3),
+        "winner": "in_kernel" if out["1"] < out["0"] else "xla",
+    }), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,167 @@
+"""Measure per-generation kernel block sizes and commit them to the
+tuning table (``flashmoe_tpu/tuning.py`` — the TPU analogue of the
+reference's per-arch trait table, ``csrc/include/flashmoe/arch.cuh:
+95-222``, whose geometry was likewise chosen offline per architecture).
+
+Sweeps, on the real chip:
+  * capacity_ffn — (block_m, block_i) of the grouped capacity-buffer FFN
+    kernel at each bench shape;
+  * fused_ep     — (cm, bi_cap) of the fused RDMA kernel's compute loop
+    (swept on a 1-rank mesh: transfer legs vanish, the streamed-weight /
+    row-tile geometry being tuned is identical).
+
+Winners are written to ``flashmoe_tpu/tuning_data/<gen>.json`` (one
+``{"kernel", "match", "set", "measured_ms"}`` entry per shape), which
+ships with the package and is consulted at trace time.
+
+Usage: python scripts/tune_sweep.py [--trials 3] [--chain 8] [--dry]
+Prints one JSON line per (kernel, shape, candidate) measurement.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from flashmoe_tpu import tuning
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.models.reference import init_moe_params
+
+# shapes worth a table row: the reference bench config and the Mixtral
+# FFN dims (BASELINE.json configs 2 and 3)
+SHAPES = [
+    dict(h=2048, i=2048, e=64, cap=256),
+    dict(h=4096, i=14336, e=8, cap=2048),
+]
+
+
+def _chain_time(fn, args, trials, chain):
+    def run(*a):
+        def body(c, _):
+            return c * (1.0 + 0.0 * fn(*a).astype(c.dtype)), None
+        c, _ = jax.lax.scan(body, jnp.float32(1.0), None, length=chain)
+        return c
+
+    j = jax.jit(run)
+    float(j(*args))
+    ts = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        float(j(*args))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2] / chain
+
+
+def sweep_capacity(shape, dtype, trials, chain):
+    from flashmoe_tpu.ops.expert import grouped_ffn
+
+    h, i, e, cap = shape["h"], shape["i"], shape["e"], shape["cap"]
+    cfg = MoEConfig(num_experts=e, expert_top_k=1, hidden_size=h,
+                    intermediate_size=i, dtype=dtype,
+                    param_dtype=jnp.float32)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    params = jax.tree_util.tree_map(lambda p: p.astype(dtype), params)
+    best = None
+    for bm, bi in itertools.product((128, 256, 512), (256, 512)):
+        if cap % bm and bm % cap:
+            continue
+        cp = ((cap + bm - 1) // bm) * bm
+        x = jax.random.normal(jax.random.PRNGKey(1), (e * cp, h), dtype)
+        gid = jnp.arange(e * (cp // bm), dtype=jnp.int32) // (cp // bm)
+
+        def fn(xx):
+            return grouped_ffn(
+                xx, gid, params["w_up"], params["b_up"], params["w_down"],
+                params["b_down"], None, act_name=cfg.hidden_act,
+                gated=False, block_m=bm, block_i=bi,
+            ).astype(jnp.float32).sum()
+
+        t = _chain_time(fn, (x,), trials, chain)
+        row = {"kernel": "capacity_ffn", "h": h, "i": i, "block_m": bm,
+               "block_i": bi, "ms": round(t * 1e3, 4)}
+        print(json.dumps(row), flush=True)
+        if best is None or t < best[0]:
+            best = (t, {"block_m": bm, "block_i": bi})
+    return {"kernel": "capacity_ffn",
+            "match": {"h": h, "i": i, "dtype": jnp.dtype(dtype).name},
+            "set": best[1], "measured_ms": round(best[0] * 1e3, 4)}
+
+
+def sweep_fused(shape, dtype, trials, chain):
+    from flashmoe_tpu.parallel.fused import fused_ep_moe_layer
+    from flashmoe_tpu.parallel.mesh import make_mesh
+
+    h, i, e = shape["h"], shape["i"], shape["e"]
+    cfg = MoEConfig(num_experts=e, expert_top_k=2, hidden_size=h,
+                    intermediate_size=i, sequence_len=2048,
+                    capacity_factor=1.0, drop_tokens=True, ep=1,
+                    dtype=dtype, param_dtype=jnp.float32)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    params = jax.tree_util.tree_map(lambda p: p.astype(dtype), params)
+    x = jax.random.normal(jax.random.PRNGKey(1), (cfg.tokens, h), dtype)
+    mesh = make_mesh(cfg, dp=1, devices=jax.devices()[:1])
+    tmp = "/tmp/flashmoe_tune_candidate.json"
+    best = None
+    try:
+        for cm, bic in itertools.product((128, 256), (256, 512)):
+            with open(tmp, "w") as f:
+                json.dump({"entries": [{
+                    "kernel": "fused_ep",
+                    "match": {"h": h, "i": i,
+                              "dtype": jnp.dtype(dtype).name},
+                    "set": {"cm": cm, "bi_cap": bic},
+                }]}, f)
+            os.environ["FLASHMOE_TUNING_FILE"] = tmp
+            tuning._load.cache_clear()
+
+            def fn(xx):
+                return fused_ep_moe_layer(
+                    params, xx, cfg, mesh).out.astype(jnp.float32).sum()
+
+            t = _chain_time(fn, (x,), trials, chain)
+            row = {"kernel": "fused_ep", "h": h, "i": i, "cm": cm,
+                   "bi_cap": bic, "ms": round(t * 1e3, 4)}
+            print(json.dumps(row), flush=True)
+            if best is None or t < best[0]:
+                best = (t, {"cm": cm, "bi_cap": bic})
+    finally:
+        os.environ.pop("FLASHMOE_TUNING_FILE", None)
+        tuning._load.cache_clear()
+    return {"kernel": "fused_ep",
+            "match": {"h": h, "i": i, "dtype": jnp.dtype(dtype).name},
+            "set": best[1], "measured_ms": round(best[0] * 1e3, 4)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--chain", type=int, default=8)
+    ap.add_argument("--dry", action="store_true",
+                    help="sweep without writing the table")
+    args = ap.parse_args()
+    dtype = jnp.bfloat16
+    entries = []
+    for shape in SHAPES:
+        entries.append(sweep_capacity(shape, dtype, args.trials,
+                                      args.chain))
+        entries.append(sweep_fused(shape, dtype, args.trials, args.chain))
+    gen = tuning.generation()
+    if args.dry:
+        print(json.dumps({"generation": gen, "entries": entries}))
+    else:
+        path = tuning.save_entries(gen, entries)
+        print(json.dumps({"written": path, "n": len(entries)}))
+
+
+if __name__ == "__main__":
+    main()

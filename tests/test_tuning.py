@@ -1,0 +1,70 @@
+"""Per-generation tuning table (the reference's arch trait table,
+``csrc/include/flashmoe/arch.cuh:95-222``, as measured data instead of
+hardcoded constexprs)."""
+
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from flashmoe_tpu import tuning
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.ops.expert import _capacity_tiling
+
+
+@pytest.fixture(autouse=True)
+def _clean_cache(monkeypatch, tmp_path):
+    monkeypatch.setenv("FLASHMOE_TUNING_FILE",
+                       str(tmp_path / "missing.json"))
+    tuning._load.cache_clear()
+    yield
+    tuning._load.cache_clear()
+
+
+def test_lookup_empty_without_table():
+    assert tuning.lookup("capacity_ffn", h=2048, i=2048,
+                         dtype="bfloat16") == {}
+
+
+def test_save_load_roundtrip_and_match(tmp_path, monkeypatch):
+    path = str(tmp_path / "v5e.json")
+    entries = [{"kernel": "capacity_ffn",
+                "match": {"h": 2048, "i": 2048, "dtype": "bfloat16"},
+                "set": {"block_m": 256, "block_i": 512},
+                "measured_ms": 1.0}]
+    tuning.save_entries("v5e", entries, path=path)
+    monkeypatch.setenv("FLASHMOE_TUNING_FILE", path)
+    tuning._load.cache_clear()
+    got = tuning.lookup("capacity_ffn", h=2048, i=2048, dtype="bfloat16")
+    assert got == {"block_m": 256, "block_i": 512}
+    # a different shape falls through to {}
+    assert tuning.lookup("capacity_ffn", h=1024, i=2048,
+                         dtype="bfloat16") == {}
+    # re-saving the same key replaces, not duplicates
+    entries[0]["set"] = {"block_m": 128, "block_i": 256}
+    tuning.save_entries("v5e", entries, path=path)
+    with open(path) as f:
+        assert len(json.load(f)["entries"]) == 1
+    tuning._load.cache_clear()
+    assert tuning.lookup("capacity_ffn", h=2048, i=2048,
+                         dtype="bfloat16")["block_m"] == 128
+
+
+def test_capacity_tiling_consults_table(tmp_path, monkeypatch):
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=2048,
+                    intermediate_size=2048, dtype=jnp.bfloat16,
+                    param_dtype=jnp.float32)
+    bm_h, cp_h, bi_h = _capacity_tiling(1024, cfg)  # heuristic (no table)
+    path = str(tmp_path / "v5e.json")
+    tuning.save_entries("v5e", [{
+        "kernel": "capacity_ffn",
+        "match": {"h": 2048, "i": 2048, "dtype": "bfloat16"},
+        "set": {"block_m": 128, "block_i": 256},
+    }], path=path)
+    monkeypatch.setenv("FLASHMOE_TUNING_FILE", path)
+    tuning._load.cache_clear()
+    bm, cp, bi = _capacity_tiling(1024, cfg)
+    assert (bm, bi) == (128, 256)
+    assert cp % bm == 0 and cp >= 1024
+    # no cfg -> pure heuristic, table untouched
+    assert _capacity_tiling(1024)[0] == bm_h
