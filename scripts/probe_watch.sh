@@ -39,6 +39,9 @@ while true; do
     echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) bench rc=$?" >> "$LOG"
     timeout 1800 python scripts/stage_bench.py > "$OUTDIR/stage_bench.log" 2>&1
     echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) stage_bench rc=$?" >> "$LOG"
+    timeout 1800 python scripts/stage_bench.py --path explicit \
+      > "$OUTDIR/stage_bench_explicit.log" 2>&1
+    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) stage_bench_explicit rc=$?" >> "$LOG"
     timeout 1200 python scripts/stage_bench.py --path combine \
       > "$OUTDIR/combine_modes.log" 2>&1
     echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) combine_modes rc=$?" >> "$LOG"

@@ -91,6 +91,7 @@ def test_ep_with_tensor_parallel_experts(devices):
 
 
 @pytest.mark.parametrize("inner", [2, 4])
+@pytest.mark.slow
 def test_hierarchical_dcn_a2a_matches_flat(inner, devices):
     """Two-stage (intra-slice, inter-slice) all-to-all must be
     bit-identical to the flat exchange."""
@@ -106,6 +107,7 @@ def test_hierarchical_dcn_a2a_matches_flat(inner, devices):
     )
 
 
+@pytest.mark.slow
 def test_ep_pallas_path_and_grad(devices):
     """EP with pallas experts (interpreter): forward matches oracle and
     the custom-VJP backward produces finite grads."""
@@ -129,6 +131,7 @@ def test_ep_pallas_path_and_grad(devices):
         assert np.isfinite(np.asarray(leaf)).all()
 
 
+@pytest.mark.slow
 def test_ep_grad(devices):
     """EP layer must be differentiable end-to-end (training path)."""
     cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=64,

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from flashmoe_tpu.config import MoEConfig
 from flashmoe_tpu.models.generate import generate
@@ -15,6 +16,7 @@ CFG = MoEConfig(num_experts=4, expert_top_k=2, hidden_size=64,
                 param_dtype=jnp.float32)
 
 
+@pytest.mark.slow
 def test_greedy_matches_full_forward():
     """Greedy decode must reproduce argmax of the full (non-cached)
     forward at every step."""

@@ -24,6 +24,7 @@ def _batch(b=4, seed=1):
 
 
 @pytest.mark.parametrize("pp,dp,mb", [(4, 2, 2), (2, 4, 4), (2, 2, 1)])
+@pytest.mark.slow
 def test_pipeline_ce_matches_plain_forward(pp, dp, mb, devices):
     cfg = CFG.replace(pp=pp, dp=dp)
     params = init_params(jax.random.PRNGKey(0), cfg)
@@ -35,6 +36,7 @@ def test_pipeline_ce_matches_plain_forward(pp, dp, mb, devices):
 
 
 @pytest.mark.parametrize("mb", [2, 4])
+@pytest.mark.slow
 def test_interleaved_schedule_matches_gpipe(mb, devices):
     """interleave=2 (Megatron-style two chunks per stage) computes the
     same loss as GPipe — identical math, fewer bubble ticks — and matches
@@ -68,6 +70,7 @@ def test_interleave_validation(devices):
                       num_microbatches=3, interleave=2)
 
 
+@pytest.mark.slow
 def test_pipeline_grad(devices):
     params = init_params(jax.random.PRNGKey(0), CFG)
     mesh = make_mesh(CFG)
@@ -80,6 +83,7 @@ def test_pipeline_grad(devices):
 
 
 @pytest.mark.parametrize("use_pallas", [False, True], ids=["xla", "pallas"])
+@pytest.mark.slow
 def test_pipeline_with_ep_in_stage(use_pallas, devices):
     """PP x EP composition: experts shard over ep INSIDE each stage (the
     stage's MoE runs the in-shard_map all-to-all body), and the CE still

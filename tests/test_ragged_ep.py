@@ -26,6 +26,7 @@ def _setup(cfg, seed=0):
 
 
 @pytest.mark.parametrize("ep", [2, 4, 8])
+@pytest.mark.slow
 def test_matches_oracle(ep, devices):
     cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=64,
                     intermediate_size=128, sequence_len=256, ep=ep, **F32)
@@ -39,6 +40,7 @@ def test_matches_oracle(ep, devices):
     assert int(jnp.sum(out.expert_counts)) == cfg.tokens * cfg.expert_top_k
 
 
+@pytest.mark.slow
 def test_skewed_all_to_one_expert(devices):
     """Extreme imbalance: all tokens to one expert on one rank — the exact
     case capacity-based EP drops and dropless must not."""
@@ -56,6 +58,7 @@ def test_skewed_all_to_one_expert(devices):
     assert int(out.expert_counts[5]) == cfg.tokens
 
 
+@pytest.mark.slow
 def test_gated_ffn(devices):
     cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=64,
                     intermediate_size=128, sequence_len=128, ep=4,
@@ -69,6 +72,7 @@ def test_gated_ffn(devices):
     )
 
 
+@pytest.mark.slow
 def test_sentinel_no_collision_with_padded_targets(devices):
     """Regression: tile padding can push a real row's target to exactly
     recv_bound; the dropped-row sentinel must be out of range, not
@@ -91,6 +95,7 @@ def test_sentinel_no_collision_with_padded_targets(devices):
         )
 
 
+@pytest.mark.slow
 def test_token_count_not_multiple_of_block(devices):
     """Regression: recv_bound not divisible by block_m must not crash."""
     cfg = MoEConfig(num_experts=4, expert_top_k=1, hidden_size=64,
@@ -105,6 +110,7 @@ def test_token_count_not_multiple_of_block(devices):
     )
 
 
+@pytest.mark.slow
 def test_pallas_path_gradients_match_xla_path(devices):
     """The dropless pallas path must differentiate (grouped_ffn_ad) and
     agree with the XLA-fallback path's gradients."""

@@ -48,6 +48,7 @@ def test_dense_layers_interleave():
     assert params["layers"][1]["moe"]["w_up"].shape[0] == cfg.num_experts
 
 
+@pytest.mark.slow
 def test_train_step_decreases_loss(devices):
     mesh = make_mesh(CFG)
     params = init_params(jax.random.PRNGKey(0), CFG)
@@ -77,6 +78,7 @@ def test_optax_trainer_with_shardings(devices):
 
 
 @pytest.mark.parametrize("backend", ["fused", "ragged"])
+@pytest.mark.slow
 def test_moe_backend_selection(backend, devices):
     """The flagship model can route its distributed MoE through the fused
     RDMA kernel or the dropless ragged layer and still match the default
@@ -114,6 +116,7 @@ def test_moe_backend_selection(backend, devices):
         )
 
 
+@pytest.mark.slow
 def test_sequence_parallel_forward(devices):
     """sp=2: ring attention + EP MoE with tokens sharded over (ep, sp)."""
     cfg = CFG.replace(ep=2, sp=2, sequence_len=128)
