@@ -886,9 +886,17 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
 
     d_world = mesh.shape["ep"]
     if src_order is None:
-        ring = (jnp.arange(d_world, dtype=jnp.int32)[:, None]
-                + jnp.arange(d_world, dtype=jnp.int32)[None, :]) % d_world
-        src_order = ring
+        # a bootstrapped runtime on a heterogeneous fabric publishes its
+        # arrival-order schedule (gated on this mesh's device ordering
+        # actually matching the table's rank indexing); everywhere else
+        # the ring default stands
+        from flashmoe_tpu.runtime.bootstrap import current_src_order
+
+        src_order = current_src_order(mesh, d_world)
+    if src_order is None:
+        from flashmoe_tpu.parallel.topology import default_ring
+
+        src_order = jnp.asarray(default_ring(d_world))
     else:
         if src_order.shape != (d_world, d_world):
             raise ValueError(

@@ -101,6 +101,15 @@ class Adjacency:
                 f.write(" ".join(f"{v:.6f}" for v in row) + "\n")
 
 
+def default_ring(n: int) -> np.ndarray:
+    """The fused kernel's default source schedule: row r processes
+    sources (r, r+1, ..., r-1) — ``order[r, s] = (r + s) mod n``.  The
+    single definition both :mod:`flashmoe_tpu.runtime.bootstrap` (to
+    suppress redundant tables) and the kernel launcher compare against."""
+    r = np.arange(n, dtype=np.int32)
+    return (r[:, None] + r[None, :]) % n
+
+
 def arrival_order(adj: Adjacency, payload_mb: float,
                   stagger_ms: float = 0.0) -> np.ndarray:
     """Per-rank source-processing order for the fused RDMA kernel, sorted

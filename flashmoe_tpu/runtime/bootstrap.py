@@ -38,6 +38,10 @@ class Runtime:
     placement: Placement
     num_processes: int
     process_id: int
+    # non-None only on heterogeneous fabrics: the per-rank source
+    # processing order for the fused RDMA kernel, from
+    # topology.arrival_order (ring order needs no table)
+    src_order: object = None
 
     @property
     def num_local_experts(self) -> int:
@@ -118,20 +122,68 @@ def initialize(cfg: MoEConfig | dict | str | None = None, *,
 
     if measure is None:
         measure = jax.process_count() > 1 or devices[0].platform != "cpu"
+    src_order = None
     if use_decider and n > 1:
         adj = ici_adjacency(devices)
         if measure and jax.process_count() > 1:
             adj = merge_dcn_costs(adj, probe_dcn_costs(), devices)
         attrs = measured_worker_attrs(devices, cfg, probe=measure)
         placement = decide(adj, attrs, cfg)
+        src_order = _heterogeneous_src_order(adj, cfg, n)
     else:
         placement = uniform_placement(n, cfg)
 
     _runtime = Runtime(
         cfg=cfg, mesh=mesh, placement=placement,
         num_processes=jax.process_count(), process_id=jax.process_index(),
+        src_order=src_order,
     )
     return _runtime
+
+
+def current_src_order(mesh, d_world: int):
+    """The bootstrapped arrival-order table, iff it applies to ``mesh``:
+    a live runtime must hold a table of matching ep width AND the mesh's
+    devices must be ``jax.devices()`` in order (the table's rank indices
+    are positions in that order; a permuted user mesh would misapply the
+    schedule, processing slow sources early).  Returns None otherwise —
+    the kernel's ring default stands."""
+    rt = _runtime
+    if rt is None or rt.src_order is None:
+        return None
+    if getattr(rt.src_order, "shape", None) != (d_world, d_world):
+        return None
+    try:
+        flat = list(mesh.devices.flat)
+    except AttributeError:
+        return None
+    devs = jax.devices()
+    if len(flat) != d_world or any(
+            a is not b for a, b in zip(flat, devs[:d_world])):
+        return None
+    return rt.src_order
+
+
+def _heterogeneous_src_order(adj, cfg: MoEConfig, n: int):
+    """Arrival-order schedule for the fused kernel, or None when it
+    reduces to the kernel's default ring (homogeneous fabric, or the ep
+    axis doesn't span the whole adjacency).  Payload = one source rank's
+    slab toward one destination (nLx x cap x H)."""
+    import numpy as np
+
+    from flashmoe_tpu.parallel.ep import local_capacity
+    from flashmoe_tpu.parallel.topology import arrival_order
+
+    if cfg.ep <= 1 or cfg.ep != n:
+        return None
+    s_loc = max(cfg.tokens // cfg.ep, 1)
+    nlx = cfg.num_experts // cfg.ep
+    slab_mb = (nlx * local_capacity(cfg, s_loc) * cfg.hidden_size
+               * np.dtype(cfg.dtype).itemsize) / 1e6
+    order = arrival_order(adj, slab_mb)
+    from flashmoe_tpu.parallel.topology import default_ring
+
+    return None if np.array_equal(order, default_ring(n)) else order
 
 
 def finalize():

@@ -29,24 +29,23 @@ while true; do
   if [ $rc -eq 0 ] && echo "$out" | grep -q "8.0"; then
     echo "$ts attempt=$attempt OK: $out" >> "$LOG"
     echo "$ts backend is UP — running hardware pipeline" >> "$LOG"
-    # Short validation first (catches Mosaic lowering errors fast), then the
-    # headline bench, then the per-stage breakdown. Each leg is individually
-    # time-bounded so one hang cannot eat the whole window.
-    timeout 1800 python scripts/tpu_validate.py \
-      > "$OUTDIR/tpu_validate.log" 2>&1
-    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_validate rc=$? " >> "$LOG"
-    timeout 1800 python bench.py > "$OUTDIR/bench.log" 2>&1
-    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) bench rc=$?" >> "$LOG"
-    timeout 1800 python scripts/stage_bench.py > "$OUTDIR/stage_bench.log" 2>&1
-    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) stage_bench rc=$?" >> "$LOG"
-    timeout 1800 python scripts/stage_bench.py --path explicit \
-      > "$OUTDIR/stage_bench_explicit.log" 2>&1
-    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) stage_bench_explicit rc=$?" >> "$LOG"
-    timeout 1200 python scripts/stage_bench.py --path combine \
-      > "$OUTDIR/combine_modes.log" 2>&1
-    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) combine_modes rc=$?" >> "$LOG"
-    timeout 2400 python scripts/tune_sweep.py > "$OUTDIR/tune_sweep.log" 2>&1
-    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tune_sweep rc=$?" >> "$LOG"
+    # Headline bench FIRST: the window may be short, the number is the
+    # round's #1 deliverable, and every unvalidated new kernel is opt-in
+    # so bench only exercises hardware-proven paths.  Then the full
+    # validation sweep and the decision benches.  Each leg is
+    # individually time-bounded so one hang cannot eat the whole window.
+    run_leg() {  # run_leg <name> <timeout_s> <cmd...>
+      local name=$1 tmo=$2; shift 2
+      timeout "$tmo" "$@" > "$OUTDIR/$name.log" 2>&1
+      local rc=$?
+      echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) $name rc=$rc" >> "$LOG"
+    }
+    run_leg bench 1800 python bench.py
+    run_leg tpu_validate 1800 python scripts/tpu_validate.py
+    run_leg stage_bench 1800 python scripts/stage_bench.py
+    run_leg stage_bench_explicit 1800 python scripts/stage_bench.py --path explicit
+    run_leg combine_modes 1200 python scripts/stage_bench.py --path combine
+    run_leg tune_sweep 2400 python scripts/tune_sweep.py
     exit 0
   fi
   echo "$ts attempt=$attempt DOWN rc=$rc: ${out:-<no output>}" >> "$LOG"

@@ -5,6 +5,7 @@ import subprocess
 import sys
 
 import jax
+import jax.numpy as jnp
 
 import flashmoe_tpu as fm
 from flashmoe_tpu.config import MoEConfig
@@ -161,3 +162,88 @@ def test_worker_cli(devices):
     rec = json.loads(out.stdout.strip().splitlines()[-1])
     assert rec["finite"] is True
     assert rec["rank"] == 0
+
+
+def test_heterogeneous_src_order_published():
+    """bootstrap computes the fused kernel's arrival-order schedule from
+    the adjacency: homogeneous -> None (ring default); a DCN-slowed rank
+    -> an own-first order that sinks the slow source to the back."""
+    import numpy as np
+
+    from flashmoe_tpu.config import MoEConfig
+    from flashmoe_tpu.parallel.topology import Adjacency
+    from flashmoe_tpu.runtime.bootstrap import _heterogeneous_src_order
+
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                    intermediate_size=256, sequence_len=256, ep=4,
+                    dtype=jnp.float32, param_dtype=jnp.float32)
+    alpha = np.full((4, 4), 0.001); np.fill_diagonal(alpha, 0.0)
+    beta = np.full((4, 4), 0.02); np.fill_diagonal(beta, 0.0)
+    assert _heterogeneous_src_order(Adjacency(alpha, beta), cfg, 4) is None
+
+    a2, b2 = alpha.copy(), beta.copy()
+    a2[3, :3] *= 20.0; b2[3, :3] *= 20.0
+    order = _heterogeneous_src_order(Adjacency(a2, b2), cfg, 4)
+    assert order is not None
+    for r in range(3):
+        assert order[r, 0] == r and order[r, -1] == 3  # slow source last
+        assert sorted(order[r]) == [0, 1, 2, 3]
+
+    # ep != n (e.g. dp x ep job): no table, ring default
+    assert _heterogeneous_src_order(Adjacency(a2, b2),
+                                    cfg.replace(ep=2), 4) is None
+
+
+def test_fused_layer_picks_up_runtime_src_order(monkeypatch, devices):
+    """fused_ep_moe_layer adopts the bootstrapped table only when the
+    mesh's device ordering matches its rank indexing.  Proof of
+    consumption: a deliberately INVALID published table must surface as
+    the launcher's own-first validation error — which can only happen if
+    the pickup path actually read it."""
+    import numpy as np
+    import pytest as _pytest
+
+    from flashmoe_tpu.config import MoEConfig
+    from flashmoe_tpu.models.reference import init_moe_params, reference_moe
+    from flashmoe_tpu.parallel.fused import fused_ep_moe_layer
+    from flashmoe_tpu.parallel.mesh import make_mesh
+    from flashmoe_tpu.runtime import bootstrap as bs
+
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                    intermediate_size=256, sequence_len=128, ep=4,
+                    drop_tokens=False, dtype=jnp.float32,
+                    param_dtype=jnp.float32)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1),
+                          (cfg.tokens, cfg.hidden_size), jnp.float32)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:4])
+    want, _ = reference_moe(params, x, cfg)
+
+    class FakeRT:
+        src_order = None
+
+    monkeypatch.setattr(bs, "_runtime", FakeRT)
+
+    # invalid published table -> ValueError proves the pickup read it
+    FakeRT.src_order = np.array(
+        [[1, 0, 2, 3]] * 4, np.int32)  # not own-first
+    with _pytest.raises(ValueError, match="starting with"):
+        fused_ep_moe_layer(params, x, cfg, mesh, interpret=True)
+
+    # valid reverse-ring table -> consumed, numerics still match oracle
+    FakeRT.src_order = np.stack([
+        np.array([r] + [(r - s) % 4 for s in range(1, 4)], np.int32)
+        for r in range(4)
+    ])
+    out = fused_ep_moe_layer(params, x, cfg, mesh, interpret=True)
+    np.testing.assert_allclose(np.asarray(out.out), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+    # mesh whose ep devices are NOT jax.devices() order: table refused,
+    # ring default used (runs fine even though the table is garbage for
+    # this mesh)
+    perm = [devices[2], devices[0], devices[3], devices[1]]
+    mesh_p = make_mesh(cfg, dp=1, devices=perm)
+    FakeRT.src_order = np.array([[1, 0, 2, 3]] * 4, np.int32)  # invalid
+    out_p = fused_ep_moe_layer(params, x, cfg, mesh_p, interpret=True)
+    assert bool(jnp.isfinite(out_p.out).all())
