@@ -216,8 +216,17 @@ def router_pallas(x, gate_w, cfg: MoEConfig, interpret: bool = False
 _ET = 512  # expert-tile width (lanes) of the two-pass gate
 
 
-def _gate_pass1_kernel(x_ref, w_ref, logits_ref, m_ref, se_ref, tv_ref,
-                       ti_ref, mrun, serun, topv, topi, *, k, e, et):
+def _gate_pass1_kernel(x_ref, w_ref, *refs, k, e, et, spill):
+    """``spill`` controls whether the logits tile is written to HBM for
+    pass 2 (training/z-loss stats); inference skips the output entirely —
+    at E=16k, S=8k that is a ~0.5 GB write per layer."""
+    if spill:
+        logits_ref, m_ref, se_ref, tv_ref, ti_ref = refs[:5]
+        mrun, serun, topv, topi = refs[5:]
+    else:
+        logits_ref = None
+        m_ref, se_ref, tv_ref, ti_ref = refs[:4]
+        mrun, serun, topv, topi = refs[4:]
     j = pl.program_id(1)
     nj = pl.num_programs(1)
     bm = x_ref.shape[0]
@@ -237,7 +246,8 @@ def _gate_pass1_kernel(x_ref, w_ref, logits_ref, m_ref, se_ref, tv_ref,
     col = jax.lax.broadcasted_iota(jnp.int32, (bm, et), 1)
     gcol = col + j * et
     logits = jnp.where(gcol < e, logits, neg)
-    logits_ref[:] = logits
+    if spill:
+        logits_ref[:] = logits
 
     # online (max, sum) update with rescale — the softmax baton
     m_old = mrun[:, 0:1]
@@ -323,11 +333,28 @@ def _gate_pass2_kernel(logits_ref, m_ref, se_ref, ti_ref, stats_ref, *,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "interpret"))
-def router_pallas_tiled(x, gate_w, cfg: MoEConfig, interpret: bool = False
-                        ) -> RouterOutput:
+def router_pallas_tiled(x, gate_w, cfg: MoEConfig, interpret: bool = False,
+                        need_stats: bool | None = None) -> RouterOutput:
     """Two-pass fused gate for E beyond the single-tile VMEM budget.
-    x: [S, H], gate_w: [H, E];  S % 8 == 0, E > _ET recommended."""
+    x: [S, H], gate_w: [H, E];  S % 8 == 0, E > _ET recommended.
+
+    ``need_stats=None`` resolves OUTSIDE the jitted core (env vars read
+    inside a jit bind at trace time and then stick in the cache):
+    training / z-loss configs and ``FLASHMOE_GATE_STATS=1`` get the
+    stats pass; plain inference skips it (aux fields report zero)."""
+    if need_stats is None:
+        import os as _os
+
+        need_stats = (cfg.is_training or cfg.router_z_loss_coef > 0
+                      or _os.environ.get("FLASHMOE_GATE_STATS") == "1")
+    return _router_pallas_tiled_jit(x, gate_w, cfg, interpret,
+                                    bool(need_stats))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "interpret", "need_stats"))
+def _router_pallas_tiled_jit(x, gate_w, cfg: MoEConfig, interpret: bool,
+                             need_stats: bool) -> RouterOutput:
     s, h = x.shape
     e, k = cfg.num_experts, cfg.expert_top_k
     if s % 8:
@@ -343,8 +370,19 @@ def router_pallas_tiled(x, gate_w, cfg: MoEConfig, interpret: bool = False
     nt = s // bm
     w_pad = jnp.zeros((h, px), gate_w.dtype).at[:, :e].set(gate_w)
 
-    logits, m, se, tv, ti = pl.pallas_call(
-        functools.partial(_gate_pass1_kernel, k=k, e=e, et=et),
+    lane_spec = pl.BlockSpec((bm, LANE), lambda i, j: (i, 0),
+                             memory_space=pltpu.VMEM)
+    lane_shape = jax.ShapeDtypeStruct((s, LANE), jnp.float32)
+    out_specs = [lane_spec] * 4
+    out_shape = [lane_shape, lane_shape, lane_shape,
+                 jax.ShapeDtypeStruct((s, LANE), jnp.int32)]
+    if need_stats:
+        out_specs = [pl.BlockSpec((bm, et), lambda i, j: (i, j),
+                                  memory_space=pltpu.VMEM)] + out_specs
+        out_shape = [jax.ShapeDtypeStruct((s, px), jnp.float32)] + out_shape
+    res = pl.pallas_call(
+        functools.partial(_gate_pass1_kernel, k=k, e=e, et=et,
+                          spill=need_stats),
         grid=(nt, nj),
         in_specs=[
             pl.BlockSpec((bm, h), lambda i, j: (i, 0),
@@ -352,25 +390,8 @@ def router_pallas_tiled(x, gate_w, cfg: MoEConfig, interpret: bool = False
             pl.BlockSpec((h, et), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=[
-            pl.BlockSpec((bm, et), lambda i, j: (i, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((bm, LANE), lambda i, j: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((bm, LANE), lambda i, j: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((bm, LANE), lambda i, j: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((bm, LANE), lambda i, j: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((s, px), jnp.float32),
-            jax.ShapeDtypeStruct((s, LANE), jnp.float32),
-            jax.ShapeDtypeStruct((s, LANE), jnp.float32),
-            jax.ShapeDtypeStruct((s, LANE), jnp.float32),
-            jax.ShapeDtypeStruct((s, LANE), jnp.int32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((bm, LANE), jnp.float32),
             pltpu.VMEM((bm, LANE), jnp.float32),
@@ -379,32 +400,44 @@ def router_pallas_tiled(x, gate_w, cfg: MoEConfig, interpret: bool = False
         ],
         interpret=interpret,
     )(x, w_pad)
-
-    stats = pl.pallas_call(
-        functools.partial(_gate_pass2_kernel, k=k, e=e, et=et),
-        grid=(nj, nt),
-        in_specs=[
-            pl.BlockSpec((bm, et), lambda j, i: (i, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((bm, LANE), lambda j, i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((bm, LANE), lambda j, i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((bm, LANE), lambda j, i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((8, et), lambda j, i: (0, j),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((8, px), jnp.float32),
-        interpret=interpret,
-    )(logits, m, se, ti)
+    if need_stats:
+        logits, m, se, tv, ti = res
+    else:
+        m, se, tv, ti = res
 
     top_l = tv[:, :k]
     top_i = ti[:, :k].astype(jnp.int32)
     top_p = jnp.exp(top_l - m[:, 0:1]) / jnp.maximum(se[:, 0:1], 1e-30)
-    probs_sum = stats[0, :e]
-    counts = stats[1, :e].astype(jnp.int32)
-    zsum = stats[2, 0]
+
+    if need_stats:
+        stats = pl.pallas_call(
+            functools.partial(_gate_pass2_kernel, k=k, e=e, et=et),
+            grid=(nj, nt),
+            in_specs=[
+                pl.BlockSpec((bm, et), lambda j, i: (i, j),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((bm, LANE), lambda j, i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((bm, LANE), lambda j, i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((bm, LANE), lambda j, i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((8, et), lambda j, i: (0, j),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((8, px), jnp.float32),
+            interpret=interpret,
+        )(logits, m, se, ti)
+        probs_sum = stats[0, :e]
+        counts = stats[1, :e].astype(jnp.int32)
+        zsum = stats[2, 0]
+    else:
+        # selection counts are cheap XLA-side; prob sums / z-loss are
+        # training-only and reported as zero (aux_loss = 0 at inference —
+        # under AD the custom_vjp still backs through router_xla)
+        counts = jnp.zeros((e,), jnp.int32).at[top_i.reshape(-1)].add(1)
+        probs_sum = jnp.zeros((e,), jnp.float32)
+        zsum = jnp.float32(0.0)
     return _finish(cfg, top_p, top_i, probs_sum, counts, zsum, s)
 
 

@@ -199,13 +199,19 @@ def main() -> int:
                          dtype=jnp.float32, param_dtype=jnp.float32)
     w_big = jax.random.normal(jax.random.PRNGKey(10), (512, 1280),
                               jnp.float32) * 0.1
-    rt = router_pallas_tiled(x, w_big, cfg_e)
+    rt = router_pallas_tiled(x, w_big, cfg_e)  # inference: pass 1 only
     rx = router_xla(x, w_big, cfg_e)
     idx_mism = float(jnp.sum(rt.expert_idx != rx.expert_idx))
     check("tiled_gate_idx_mismatch", idx_mism, 0.5)
     check("tiled_gate_weights",
           float(jnp.max(jnp.abs(rt.combine_weights
                                 - rx.combine_weights))), 1e-4)
+    # training mode lowers the logits spill + stats pass as well
+    cfg_et = cfg_e.replace(is_training=True)
+    rtt = router_pallas_tiled(x, w_big, cfg_et)
+    rxt = router_xla(x, w_big, cfg_et)
+    check("tiled_gate_train_aux",
+          abs(float(rtt.aux_loss) - float(rxt.aux_loss)), 1e-3)
 
     print("ALL OK" if not failures else f"FAILURES: {failures}", flush=True)
     return 1 if failures else 0

@@ -80,8 +80,8 @@ def test_tiled_gate_matches_xla_large_e():
 
     for e, k in ((1280, 2), (600, 6)):
         cfg = MoEConfig(num_experts=e, expert_top_k=k, hidden_size=128,
-                        intermediate_size=256, dtype=jnp.float32,
-                        param_dtype=jnp.float32)
+                        intermediate_size=256, is_training=True,
+                        dtype=jnp.float32, param_dtype=jnp.float32)
         x = jax.random.normal(jax.random.PRNGKey(0), (64, 128),
                               jnp.float32)
         w = jax.random.normal(jax.random.PRNGKey(1), (128, e),
@@ -109,8 +109,8 @@ def test_router_dispatches_tiled_beyond_vmem_budget():
 
     e = 16384
     cfg = MoEConfig(num_experts=e, expert_top_k=2, hidden_size=128,
-                    intermediate_size=256, dtype=jnp.float32,
-                    param_dtype=jnp.float32)
+                    intermediate_size=256, is_training=True,
+                    dtype=jnp.float32, param_dtype=jnp.float32)
     assert gate_mod.gate_vmem_bytes(64, 128, e, jnp.float32) \
         > gate_mod._GATE_VMEM_BUDGET
     x = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32)
@@ -147,3 +147,27 @@ def test_router_dispatches_tiled_beyond_vmem_budget():
     finally:
         gate_mod.router_pallas_tiled = orig
     assert calls.get("tiled")
+
+
+def test_tiled_gate_inference_skips_stats():
+    """At inference (no aux/z consumers) the tiled gate runs pass 1 only
+    — no logits spill, no stats pass — while routing decisions, weights
+    and selection counts still match the oracle exactly."""
+    from flashmoe_tpu.ops.gate import router_pallas_tiled
+
+    cfg = MoEConfig(num_experts=1280, expert_top_k=2, hidden_size=128,
+                    intermediate_size=256, is_training=False,
+                    dtype=jnp.float32, param_dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (128, 1280),
+                          jnp.float32) * 0.1
+    got = router_pallas_tiled(x, w, cfg, interpret=True)
+    want = router_xla(x, w, cfg)
+    np.testing.assert_array_equal(np.asarray(got.expert_idx),
+                                  np.asarray(want.expert_idx))
+    np.testing.assert_allclose(np.asarray(got.combine_weights),
+                               np.asarray(want.combine_weights),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got.expert_counts),
+                                  np.asarray(want.expert_counts))
+    assert float(got.aux_loss) == 0.0 and float(got.z_loss) == 0.0
