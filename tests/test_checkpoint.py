@@ -1,6 +1,7 @@
 """Checkpoint save/restore round trip with shardings."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -18,6 +19,7 @@ CFG = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
                 dtype=jnp.float32, param_dtype=jnp.float32)
 
 
+@pytest.mark.slow
 def test_save_restore_roundtrip(devices, tmp_path):
     mesh = make_mesh(CFG)
     opt = make_optimizer(CFG, total_steps=4)

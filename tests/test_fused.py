@@ -209,6 +209,7 @@ def test_fuse_combine_gate_is_opt_in(monkeypatch):
     assert not _fuse_combine_enabled(cfg, 256, 128, 256, 64)
 
 
+@pytest.mark.slow
 def test_fused_custom_src_order_any_permutation(devices):
     """Correctness must never depend on the source-processing schedule:
     an adversarial src_order (own slab first, then reverse ring — the

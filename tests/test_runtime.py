@@ -5,6 +5,7 @@ import subprocess
 import sys
 
 import jax
+import pytest
 import jax.numpy as jnp
 
 import flashmoe_tpu as fm
@@ -70,6 +71,7 @@ def test_bookkeeping_and_topo_export(devices, tmp_path):
     bootstrap.finalize()
 
 
+@pytest.mark.slow
 def test_multiprocess_launcher(devices, tmp_path):
     """Two real processes form a jax.distributed cluster through the
     launcher + bootstrap env protocol (the nvshmrun-equivalent path) and
@@ -194,6 +196,7 @@ def test_heterogeneous_src_order_published():
                                     cfg.replace(ep=2), 4) is None
 
 
+@pytest.mark.slow
 def test_fused_layer_picks_up_runtime_src_order(monkeypatch, devices):
     """fused_ep_moe_layer adopts the bootstrapped table only when the
     mesh's device ordering matches its rank indexing.  Proof of

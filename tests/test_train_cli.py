@@ -5,6 +5,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 
 def _run(args, timeout=420):
     env = dict(os.environ)
@@ -34,6 +36,7 @@ def test_synthetic_training(devices):
     assert rec["final_loss"] is not None
 
 
+@pytest.mark.slow
 def test_with_data_and_checkpointing(devices, tmp_path):
     import numpy as np
     from flashmoe_tpu.runtime.data import write_token_file
