@@ -291,4 +291,16 @@ BENCH_CONFIGS = {
                           intermediate_size=1408, sequence_len=4096,
                           num_shared_experts=2, gated_ffn=True,
                           hidden_act=Activation.SILU, ep=8),
+    # 5. 256-expert weak-scaling / payload-skew bench (BASELINE.json
+    #    config #5, sized for v5p-256).  ep clamps to the devices actually
+    #    present at bench time (bench.py main), so the same name runs
+    #    single-chip for latency, on the virtual 8-device mesh for
+    #    correctness (tests/test_presets.py), and at full scale when a
+    #    v5p pod is reachable.  Per-rank tokens stay constant as ep grows
+    #    — the weak-scaling axis of the reference's scaling_gpus_8 plot
+    #    (/root/reference/README.md:46).
+    "weak_scaling_256": MoEConfig(num_experts=256, expert_top_k=2,
+                                  hidden_size=2048, intermediate_size=2048,
+                                  sequence_len=8192, capacity_factor=1.0,
+                                  ep=256),
 }

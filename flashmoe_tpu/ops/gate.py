@@ -507,4 +507,8 @@ def router(x, gate_w, cfg: MoEConfig, use_pallas: bool = True,
         <= _GATE_VMEM_BUDGET
     if fits:
         return _router_pallas_ad(x, gate_w, cfg, interpret)
+    if 2 * cfg.expert_top_k > LANE:
+        # the tiled kernel's carried+candidate top-k merge holds 2k lanes;
+        # beyond that use the XLA path instead of raising (advisor r4 #4)
+        return router_xla(x, gate_w, cfg)
     return _router_tiled_ad(x, gate_w, cfg, interpret)

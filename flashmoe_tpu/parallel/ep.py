@@ -179,8 +179,17 @@ def ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
 
     ``dcn_inner``: ranks per slice when the ep axis spans slices — the
     all-to-all then runs as a two-stage (intra-slice, inter-slice)
-    decomposition aggregating DCN traffic per slice pair.
+    decomposition aggregating DCN traffic per slice pair.  Default
+    (None): a bootstrapped runtime that detected a multislice blocking
+    (``topology.slice_structure``) publishes it, the way it publishes the
+    arrival-order schedule; pass ``0`` to force the flat exchange.
     """
+    if dcn_inner is None:
+        from flashmoe_tpu.runtime.bootstrap import current_dcn_inner
+
+        dcn_inner = current_dcn_inner(mesh, mesh.shape.get("ep", 1))
+    elif dcn_inner == 0:
+        dcn_inner = None
     if cfg.num_experts == 1:
         return MoEOutput(
             dense_ffn(params, x, cfg),

@@ -133,3 +133,58 @@ def measure_overlap(cfg: MoEConfig, mesh: Mesh, *, path: str = "fused",
         "path": path,
         "ep": ep,
     }
+
+
+def overlap_bound(cfg: MoEConfig, d: int, gen: str = "v5e", *,
+                  links: int = 4, mxu_fraction: float = 1.0) -> dict:
+    """Analytical expected overlap efficiency of the fused kernel's
+    phase-1-all-sends + ring-consume schedule — the number a future
+    hardware ``--overlap`` measurement is judged against instead of
+    being read off in isolation (VERDICT r4 next #8; the reference's
+    measured analogue is ``plots/overlap_efficiency_8.png``).
+
+    Model (per rank, homogeneous ring of ``d`` ranks, uniform routing):
+
+      C      FFN compute on the ``s_loc * k`` received rows at
+             ``mxu_fraction`` of the generation's peak bf16 throughput
+             (1.0 = roofline bound; pass the measured ``mxu_util`` for a
+             calibrated expectation).
+      t_x    egress serialization of phase 1: all (d-1)/d of the slab
+             bytes leave at once over ``links`` ICI links
+             (``topology._ICI_SPECS`` per-link GB/s).
+      T      makespan: step 0 computes the own slab while remote slabs
+             fly, step s>=1 waits slab s -> T = max(C, t_x + C/d), plus
+             the return tail of the LAST slab's y tiles (they can only
+             start after its compute finishes): t_x / (d-1).
+      OE     (C + 2*t_x) / T  — the operational metric's numerator is
+             the serialized sum of the compute-only leg and BOTH
+             all-to-alls (x out, y back).
+
+    Latency (alpha) terms are dropped: at slab sizes of MBs they are
+    <1% of the beta terms.  Returns every intermediate so tests can
+    assert the pieces, not just the ratio.
+    """
+    from flashmoe_tpu.parallel.topology import _ICI_SPECS
+
+    peak_tflops = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0,
+                   "v6e": 918.0}[gen]
+    bw_link = _ICI_SPECS[gen][1] * 1e9            # B/s one way per link
+    dt = jnp.dtype(cfg.dtype).itemsize
+    s_loc = cfg.tokens // d
+    rows = s_loc * cfg.expert_top_k
+    gemms = 3 if cfg.gated_ffn else 2
+    flops = gemms * 2.0 * rows * cfg.hidden_size * cfg.intermediate_size
+    c_s = flops / (peak_tflops * 1e12 * mxu_fraction)
+    b_dir = (d - 1) / d * rows * cfg.hidden_size * dt
+    t_x = b_dir / (links * bw_link)
+    tail = t_x / max(d - 1, 1)
+    t_over = max(c_s, t_x + c_s / d) + tail
+    oe = (c_s + 2 * t_x) / t_over
+    return {
+        "compute_ms": c_s * 1e3,
+        "t_x_ms": t_x * 1e3,
+        "tail_ms": tail * 1e3,
+        "t_overlapped_ms": t_over * 1e3,
+        "overlap_efficiency_bound": oe,
+        "compute_bound": c_s >= t_x + c_s / d,
+    }

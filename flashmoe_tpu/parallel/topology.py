@@ -150,6 +150,53 @@ def arrival_order(adj: Adjacency, payload_mb: float,
     return order
 
 
+def slice_structure(devices=None) -> tuple[int, int] | None:
+    """Detect a (num_slices, ranks_per_slice) blocking of the device
+    list, or None when it is a single slice / irregular.
+
+    This is the trigger for the two-stage ICI+DCN exchange
+    (:func:`flashmoe_tpu.parallel.ep._hierarchical_a2a`): the TPU
+    analogue of the reference resolving P2P vs remote per peer at init
+    (``bootstrap.cuh:442-446``) and branching transport per send
+    (``os/packet.cuh:221-258``).  Slice membership comes from
+    ``device.slice_index`` (fallback ``process_index``); the blocking
+    must be contiguous and equal-sized (rank = slice * inner + i), which
+    is how jax orders devices on multislice jobs — an interleaved
+    ordering returns None and the flat all-to-all stands (correct on any
+    layout, just not DCN-message-aggregated).
+
+    ``FLASHMOE_MOCK_SLICES=k`` partitions the first ``n`` devices into
+    ``k`` equal contiguous "slices" regardless of their real topology —
+    the virtual-mesh hook (CPU devices all share process 0) used by the
+    multislice tests and chaos drills.
+    """
+    import os
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    mock = os.environ.get("FLASHMOE_MOCK_SLICES")
+    if mock:
+        outer = int(mock)
+        if outer > 1 and n % outer == 0:
+            return outer, n // outer
+        return None
+    sids = [getattr(d, "slice_index", None) for d in devices]
+    if any(s is None for s in sids):
+        sids = [getattr(d, "process_index", 0) for d in devices]
+    uniq = sorted(set(sids))
+    if len(uniq) <= 1:
+        return None
+    inner = n // len(uniq)
+    if inner * len(uniq) != n:
+        return None
+    # contiguous equal blocks in device order
+    for b in range(len(uniq)):
+        block = sids[b * inner:(b + 1) * inner]
+        if len(set(block)) != 1:
+            return None
+    return len(uniq), inner
+
+
 def _torus_hops(a, b, dims):
     """Minimal hop count between coords on a (possibly wrap-around) torus."""
     hops = 0

@@ -42,6 +42,11 @@ class Runtime:
     # processing order for the fused RDMA kernel, from
     # topology.arrival_order (ring order needs no table)
     src_order: object = None
+    # ranks per slice when the ep axis spans multiple slices
+    # (topology.slice_structure): selects the two-stage ICI+DCN
+    # all-to-all in the collective EP path (the reference's per-peer
+    # P2P-vs-remote transport duality, bootstrap.cuh:442-446)
+    dcn_inner: int | None = None
 
     @property
     def num_local_experts(self) -> int:
@@ -133,10 +138,14 @@ def initialize(cfg: MoEConfig | dict | str | None = None, *,
     else:
         placement = uniform_placement(n, cfg)
 
+    from flashmoe_tpu.parallel.topology import slice_structure
+
+    ss = slice_structure(devices[:cfg.ep]) if cfg.ep > 1 else None
     _runtime = Runtime(
         cfg=cfg, mesh=mesh, placement=placement,
         num_processes=jax.process_count(), process_id=jax.process_index(),
         src_order=src_order,
+        dcn_inner=ss[1] if ss else None,
     )
     return _runtime
 
@@ -162,6 +171,28 @@ def current_src_order(mesh, d_world: int):
             a is not b for a, b in zip(flat, devs[:d_world])):
         return None
     return rt.src_order
+
+
+def current_dcn_inner(mesh, d_world: int) -> int | None:
+    """The bootstrapped ranks-per-slice for ``mesh``'s ep axis, iff the
+    mesh's devices are ``jax.devices()`` in order (same gating as
+    :func:`current_src_order`: the blocking indexes positions in that
+    order).  None -> single slice or unknown; the flat all-to-all
+    stands."""
+    rt = _runtime
+    if rt is None or rt.dcn_inner is None:
+        return None
+    if not (1 < rt.dcn_inner < d_world) or d_world % rt.dcn_inner:
+        return None
+    try:
+        flat = list(mesh.devices.flat)
+    except AttributeError:
+        return None
+    devs = jax.devices()
+    if len(flat) != d_world or any(
+            a is not b for a, b in zip(flat, devs[:d_world])):
+        return None
+    return rt.dcn_inner
 
 
 def _heterogeneous_src_order(adj, cfg: MoEConfig, n: int):

@@ -63,15 +63,20 @@ def _load(gen: str) -> list:
 def lookup(kernel: str, gen: str | None = None, **shape) -> dict:
     """Measured knob overrides for ``kernel`` at ``shape`` (h=, i=, cap=,
     dtype=...), or {} when nothing matches.  An entry matches when every
-    key in its ``match`` dict equals the corresponding shape value."""
+    key in its ``match`` dict equals the corresponding shape value; among
+    matches the one constraining the most keys wins regardless of file
+    order, so a hand-added generic entry cannot shadow a more specific
+    measured one (advisor r4 #3)."""
     gen = gen or generation()
+    best = None
     for ent in _load(gen):
         if ent.get("kernel") != kernel:
             continue
         m = ent.get("match", {})
         if all(shape.get(k) == v for k, v in m.items()):
-            return dict(ent.get("set", {}))
-    return {}
+            if best is None or len(m) > len(best[0]):
+                best = (m, dict(ent.get("set", {})))
+    return best[1] if best else {}
 
 
 def save_entries(gen: str, entries: list, path: str | None = None) -> str:

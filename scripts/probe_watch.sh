@@ -2,19 +2,19 @@
 # Watch the tunneled TPU backend; the moment it answers, run the full
 # hardware pipeline and save every output.
 #
-# Three consecutive rounds of driver bench capture produced value:-1
-# ("backend probe hung" — BENCH_r01/r02/r03.json), so round 4 keeps a
-# timestamped probe transcript (PROBE_r04.log) to make any further outage
+# Four consecutive rounds of driver bench capture produced value:-1
+# ("backend probe hung" — BENCH_r01..r04.json), so round 5 keeps a
+# timestamped probe transcript (PROBE_r05.log) to make any further outage
 # attributable to the environment, and arms an automatic capture so no
-# up-window is missed (VERDICT.md round-3 ask #1).
+# up-window is missed (VERDICT.md round-4 ask #1, the standing order).
 #
 # Usage: bash scripts/probe_watch.sh [interval_s] [probe_timeout_s]
 set -u
 cd "$(dirname "$0")/.."
 INTERVAL=${1:-240}
 PTIMEOUT=${2:-90}
-LOG=PROBE_r04.log
-OUTDIR=HWLOG_r04
+LOG=PROBE_r05.log
+OUTDIR=HWLOG_r05
 mkdir -p "$OUTDIR"
 
 attempt=0
@@ -46,6 +46,7 @@ while true; do
     run_leg stage_bench_explicit 1800 python scripts/stage_bench.py --path explicit
     run_leg combine_modes 1200 python scripts/stage_bench.py --path combine
     run_leg tune_sweep 2400 python scripts/tune_sweep.py
+    run_leg bench_weak256 1800 python bench.py --config weak_scaling_256
     exit 0
   fi
   echo "$ts attempt=$attempt DOWN rc=$rc: ${out:-<no output>}" >> "$LOG"

@@ -103,12 +103,12 @@ def main():
                     help="'combine' times the fused layer with in-kernel "
                          "vs XLA combine instead of stage prefixes")
     args = ap.parse_args()
-    if args.path == "combine":
-        combine_modes(args)
-        return
     if args.chain < 2:
         ap.error("--chain must be >= 2 (per-iteration time comes from "
                  "differencing two chain lengths)")
+    if args.path == "combine":
+        combine_modes(args)
+        return
 
     cfg = BENCH_CONFIGS[args.config].replace(ep=1)
     cap = cfg.capacity_for(cfg.tokens)

@@ -1,0 +1,191 @@
+"""The hardware-independent perf harness (VERDICT r4 next #2): the
+analytical byte model's orderings are the claims the kernels were built
+on — assert them so a refactor that silently regresses traffic fails CI,
+and cross-check the model against XLA's own compiled cost analysis where
+HLO can see the whole path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flashmoe_tpu.analysis import (
+    PathCost, candidate_table, layer_flops, path_costs, xla_cost,
+)
+from flashmoe_tpu.config import BENCH_CONFIGS, MoEConfig
+
+REF = BENCH_CONFIGS["reference"]
+F32 = dict(dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def test_gather_moves_fewer_bytes_than_explicit():
+    """The gather-fused kernel exists to kill the [E, C, H] dispatch
+    buffer's write+read; the model must show exactly that delta and
+    nothing else moving."""
+    ex = path_costs(REF, "explicit")
+    ga = path_costs(REF, "gather")
+    assert ga.total_bytes < ex.total_bytes
+    assert ga.dispatch_bytes == 0.0
+    assert ex.dispatch_bytes > 0.0
+    # identical FLOPs: it is a data-movement optimization
+    assert ga.flops == ex.flops
+
+
+def test_in_kernel_combine_clears_post_kernel_critical_path():
+    """The sorted-return combine's entire point: the combine traffic
+    runs inside the kernel (overlapping returns), so nothing remains on
+    the post-kernel critical path; the slab variant leaves the full XLA
+    combine there."""
+    d = 8
+    cfg = REF.replace(ep=d)
+    slab = path_costs(cfg, "fused", d_world=d)
+    fused = path_costs(cfg, "fused_combine", d_world=d)
+    assert fused.post_kernel_bytes == 0.0
+    assert slab.post_kernel_bytes > 0.0
+    # the in-kernel combine reads token-sorted rows (S*K) + a 4-byte
+    # weight per row; the XLA combine reads the whole padded slab
+    # (slots >= S*K).  At CF=1 slots == S*K exactly, so the sorted read
+    # ties and only the tiny weight column separates them
+    assert fused.combine_bytes <= slab.combine_bytes * 1.001
+    # with real capacity padding the sorted read is strictly smaller
+    padded = cfg.replace(capacity_factor=2.0)
+    assert (path_costs(padded, "fused_combine", d_world=d).combine_bytes
+            < path_costs(padded, "fused", d_world=d).combine_bytes)
+
+
+def test_fused_weight_restreaming_is_exposed_not_hidden():
+    """The fused kernel processes one source slab per grid step, so
+    every local expert's weights re-stream once per source rank —
+    d_world x the grouped kernels' once-per-expert reads (code-review
+    r5 finding #1).  The model must CHARGE that, not hide it: this is
+    the fused path's honest multi-chip cost and the quantitative reason
+    the collective path stays the multi-chip default until a measured
+    row says otherwise."""
+    d = 8
+    cfg = REF.replace(ep=d)
+    fused = path_costs(cfg, "fused", d_world=d)
+    xla = path_costs(cfg, "xla", d_world=d)
+    assert fused.weight_bytes == d * xla.weight_bytes
+    # at a single chip there is one source: compute-side traffic (minus
+    # the local slab round-trips counted as comm) matches the baseline
+    f1 = path_costs(REF, "fused", d_world=1)
+    x1 = path_costs(REF, "xla", d_world=1)
+    assert f1.weight_bytes == x1.weight_bytes
+    assert f1.total_bytes - f1.comm_bytes <= x1.total_bytes * 1.01
+
+
+def test_resident_schedule_flattens_weight_bytes(tmp_path, monkeypatch):
+    """VERDICT r4 weak #4 / next #4: with n_row_tiles > 1 the streaming
+    schedule pays n_row_tiles x the weight HBM traffic; the
+    weights-resident schedule must hold weight bytes flat (one read per
+    expert) at the cost of re-streamed activations."""
+    import json
+
+    from flashmoe_tpu import tuning
+
+    # deepseek-ish shape: per-(rank, expert) capacity spans many row
+    # tiles, the exact case the resident schedule exists for
+    cfg = MoEConfig(num_experts=8, expert_top_k=4, hidden_size=1024,
+                    intermediate_size=1408, sequence_len=8192,
+                    capacity_factor=1.0, drop_tokens=True, ep=2)
+
+    def with_knob(resident):
+        p = tmp_path / f"t{resident}.json"
+        p.write_text(json.dumps({"generation": "x", "entries": [{
+            "kernel": "fused_ep", "match": {},
+            "set": {"weights_resident": resident}}]}))
+        monkeypatch.setenv("FLASHMOE_TUNING_FILE", str(p))
+        tuning._load.cache_clear()
+        try:
+            return path_costs(cfg, "fused", d_world=2)
+        finally:
+            monkeypatch.delenv("FLASHMOE_TUNING_FILE")
+            tuning._load.cache_clear()
+
+    resident = with_knob(True)
+    streaming = with_knob(False)
+    assert resident.weight_bytes < streaming.weight_bytes
+    # flat = one stream of each expert's matrices per SOURCE slab (the
+    # per-source d_world factor is inherent to the slab grid — see
+    # test_fused_weight_restreaming_is_exposed_not_hidden); the resident
+    # schedule removes the per-row-tile factor on top of it
+    d = 2
+    nlx = cfg.num_experts // d
+    w_once = nlx * 2 * cfg.hidden_size * cfg.intermediate_size * \
+        jnp.dtype(cfg.dtype).itemsize
+    assert resident.weight_bytes == w_once * d
+    # the trade is explicit: activations re-stream
+    assert resident.activation_bytes >= streaming.activation_bytes
+    # and at this shape the heuristic chooser must agree with the knob
+    monkeypatch.delenv("FLASHMOE_TUNING_FILE", raising=False)
+    tuning._load.cache_clear()
+    auto = path_costs(cfg, "fused", d_world=2)
+    assert auto.weight_bytes == resident.weight_bytes
+
+
+def test_total_bytes_accounting_is_consistent():
+    for p in ("xla", "explicit", "gather", "fused", "fused_combine"):
+        c = path_costs(REF.replace(ep=4), p, d_world=4)
+        assert isinstance(c, PathCost)
+        assert c.total_bytes == pytest.approx(
+            c.weight_bytes + c.activation_bytes + c.dispatch_bytes
+            + c.comm_bytes + c.combine_bytes)
+        assert c.post_kernel_bytes <= c.total_bytes
+        assert c.flops > 0
+
+
+def test_xla_cost_analysis_matches_flop_model():
+    """Cross-check the analytical FLOP model against the compiler's own
+    cost analysis of the XLA path (HLO sees this path end to end; no
+    custom calls hide work).  Small config so the 1-core CPU compile
+    stays quick."""
+    from flashmoe_tpu.models.reference import init_moe_params
+    from flashmoe_tpu.ops.moe import moe_layer
+
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=256,
+                    intermediate_size=512, sequence_len=256,
+                    capacity_factor=1.0, drop_tokens=True, **F32)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1),
+                          (cfg.tokens, cfg.hidden_size), jnp.float32)
+
+    cost = xla_cost(
+        lambda p, xx: moe_layer(p, xx, cfg, use_pallas=False).out,
+        params, x)
+    if cost["flops"] is None:
+        pytest.skip("backend cost model reports no flops")
+    model = layer_flops(cfg)
+    # the XLA path runs the FFN over every padded capacity slot (slots
+    # >= S*K) plus routing/one-hot bookkeeping, so the compiled count
+    # brackets the model from above but must stay the same order
+    assert cost["flops"] >= 0.8 * model
+    assert cost["flops"] <= 6.0 * model
+
+
+def test_candidate_table_renders():
+    t = candidate_table(REF.replace(ep=8), d_world=8)
+    assert "fused_combine" in t and "| path |" in t
+
+
+def test_overlap_bound_reference_v5e8():
+    """The analytical bound a hardware --overlap run is judged against
+    (VERDICT r4 next #8).  At the reference config on v5e-8 the layer is
+    compute-bound at roofline (C > t_x + C/d), so the schedule should
+    hide (almost) all communication: OE_bound = (C + 2 t_x) / (C + tail)
+    — between 1 (nothing hidden) and 2 (everything hidden), and well
+    above 1.25 here because comm is a third of compute."""
+    from flashmoe_tpu.parallel.overlap import overlap_bound
+
+    b = overlap_bound(REF, 8, "v5e")
+    assert b["compute_bound"]
+    assert 1.25 <= b["overlap_efficiency_bound"] <= 2.0
+    # calibrated at the measured round-2 mxu_util (0.512): compute
+    # stretches, comm stays — the bound must drop toward serialized
+    cal = overlap_bound(REF, 8, "v5e", mxu_fraction=0.512)
+    assert cal["overlap_efficiency_bound"] < b["overlap_efficiency_bound"]
+    assert cal["overlap_efficiency_bound"] >= 1.0
+    # more ranks shrink per-rank compute faster than per-rank comm
+    # (b_dir ~ (d-1)/d), pushing toward the comm-bound regime
+    b64 = overlap_bound(REF, 64, "v5e")
+    assert b64["t_x_ms"] / b64["compute_ms"] > \
+        b["t_x_ms"] / b["compute_ms"]

@@ -1,0 +1,132 @@
+"""Two-stage ICI+DCN transport (VERDICT r4 missing #2 / next #5).
+
+The reference resolves P2P vs remote per peer at init
+(``bootstrap.cuh:442-446``) and branches transport at every send
+(``os/packet.cuh:221-258``).  The TPU equivalent: when the ep axis spans
+slices, the collective path's all-to-all decomposes into an intra-slice
+ICI exchange + ONE aggregated DCN message per slice pair
+(``parallel/ep.py:_hierarchical_a2a``), selected automatically from the
+detected slice blocking (``topology.slice_structure``) the way the
+arrival-order schedule is published.  The virtual 8-device CPU mesh
+mocks a 2x4 "two-slice" job via ``FLASHMOE_MOCK_SLICES``."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.models.reference import init_moe_params, reference_moe
+from flashmoe_tpu.parallel.ep import ep_moe_layer
+from flashmoe_tpu.parallel.mesh import make_mesh
+from flashmoe_tpu.parallel.topology import slice_structure
+
+F32 = dict(dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def _setup(cfg, seed=0):
+    pk, xk = jax.random.split(jax.random.PRNGKey(seed))
+    params = init_moe_params(pk, cfg)
+    x = jax.random.normal(xk, (cfg.tokens, cfg.hidden_size), jnp.float32)
+    return params, x
+
+
+def test_hierarchical_a2a_matches_flat_and_oracle(devices):
+    """The two-stage exchange is a pure re-decomposition: bit-identical
+    routing to the flat all-to-all, oracle-correct output, both
+    directions (dispatch and combine-return)."""
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                    intermediate_size=256, sequence_len=256,
+                    drop_tokens=False, ep=8, **F32)
+    params, x = _setup(cfg)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:8])
+    flat = ep_moe_layer(params, x, cfg, mesh, dcn_inner=0)
+    hier = ep_moe_layer(params, x, cfg, mesh, dcn_inner=4)
+    np.testing.assert_allclose(np.asarray(hier.out), np.asarray(flat.out),
+                               rtol=1e-6, atol=1e-6)
+    want, _ = reference_moe(params, x, cfg)
+    np.testing.assert_allclose(np.asarray(hier.out), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("inner", [2, 4])
+def test_hierarchical_a2a_other_factorizations(inner, devices):
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                    intermediate_size=256, sequence_len=256,
+                    capacity_factor=1.0, drop_tokens=True, ep=8, **F32)
+    params, x = _setup(cfg)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:8])
+    flat = ep_moe_layer(params, x, cfg, mesh, dcn_inner=0)
+    hier = ep_moe_layer(params, x, cfg, mesh, dcn_inner=inner)
+    np.testing.assert_allclose(np.asarray(hier.out), np.asarray(flat.out),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_slice_structure_detection(monkeypatch, devices):
+    """Mocked two-slice blocking is detected; single-slice returns None;
+    irregular mocks fall back to None (flat transport stands)."""
+    monkeypatch.delenv("FLASHMOE_MOCK_SLICES", raising=False)
+    assert slice_structure(devices[:8]) is None  # CPU: one process
+    monkeypatch.setenv("FLASHMOE_MOCK_SLICES", "2")
+    assert slice_structure(devices[:8]) == (2, 4)
+    monkeypatch.setenv("FLASHMOE_MOCK_SLICES", "3")
+    assert slice_structure(devices[:8]) is None  # 8 % 3 != 0
+    monkeypatch.setenv("FLASHMOE_MOCK_SLICES", "8")
+    assert slice_structure(devices[:8]) == (8, 1)
+
+
+def test_bootstrap_publishes_dcn_inner(monkeypatch, devices):
+    """An initialized runtime on a mocked 2-slice job publishes
+    ranks-per-slice, ep_moe_layer picks it up by default (same pattern
+    as the arrival-order table), and the gated accessor refuses meshes
+    whose device order differs from jax.devices()."""
+    from flashmoe_tpu.runtime import bootstrap
+
+    monkeypatch.setenv("FLASHMOE_MOCK_SLICES", "2")
+    monkeypatch.setattr(bootstrap, "_runtime", None)
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                    intermediate_size=256, sequence_len=256,
+                    drop_tokens=False, ep=8, **F32)
+    rt = bootstrap.initialize(cfg, use_decider=False, measure=False)
+    try:
+        assert rt.dcn_inner == 4
+        mesh = make_mesh(cfg, dp=1, devices=jax.devices()[:8])
+        assert bootstrap.current_dcn_inner(mesh, 8) == 4
+        # permuted mesh: the blocking indexes jax.devices() order
+        perm = list(jax.devices()[:8])
+        perm[0], perm[1] = perm[1], perm[0]
+        mesh_p = make_mesh(cfg, dp=1, devices=perm)
+        assert bootstrap.current_dcn_inner(mesh_p, 8) is None
+        # end to end: the default path must produce oracle output while
+        # riding the published two-stage exchange
+        params, x = _setup(cfg)
+        out = ep_moe_layer(params, x, cfg, mesh)
+        want, _ = reference_moe(params, x, cfg)
+        np.testing.assert_allclose(np.asarray(out.out), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+    finally:
+        monkeypatch.setattr(bootstrap, "_runtime", None)
+
+
+def test_transport_cost_model_prefers_aggregation():
+    """The modeled reason the two-stage exchange exists: identical
+    cross-slice bytes, inner-times fewer DCN messages — so at MoE slab
+    sizes (sub-MB per peer) the alpha savings dominate the extra
+    in-slice hop and the hierarchical total wins."""
+    from flashmoe_tpu.analysis import a2a_transport_cost
+
+    c = a2a_transport_cost(8, 4, slab_bytes=256 * 1024, gen="v5e")
+    assert c["hierarchical"]["dcn_messages"] * 4 == c["flat"]["dcn_messages"]
+    assert c["hierarchical"]["total_ms"] < c["flat"]["total_ms"]
+    # same bytes must cross DCN either way (aggregation, not elision):
+    # beta terms equal once the alpha terms are stripped
+    strip = lambda leg, n_msg: leg["dcn_ms"] - n_msg * (10.0 / 1e3)
+    np.testing.assert_allclose(
+        strip(c["flat"], c["flat"]["dcn_messages"]),
+        strip(c["hierarchical"], c["hierarchical"]["dcn_messages"]),
+        rtol=1e-9,
+    )
+    # at very large slabs the extra in-slice traffic can flip the total:
+    # the model must expose that crossover rather than hide it
+    big = a2a_transport_cost(8, 4, slab_bytes=64 * 2**20, gen="v5e")
+    assert big["hierarchical"]["ici_ms"] > big["flat"]["ici_ms"]
