@@ -213,7 +213,7 @@ def _bench_overlap(ep: int, trials: int):
     path = "fused" if on_tpu else "collective"
     m = measure_overlap(cfg, mesh, path=path, trials=trials,
                         interpret=False)
-    print(json.dumps({
+    rec = {
         "metric": f"overlap_efficiency[{path},ep={ep},E={cfg.num_experts},"
                   f"{'tpu' if on_tpu else 'virtual_cpu'}]",
         "value": round(m["overlap_efficiency"], 3),
@@ -222,7 +222,58 @@ def _bench_overlap(ep: int, trials: int):
         "t_overlapped_ms": round(m["t_overlapped_ms"], 3),
         "t_compute_ms": round(m["t_compute_ms"], 3),
         "t_comm_ms": round(m["t_comm_ms"], 3),
-    }), flush=True)
+    }
+    try:
+        rec.update(_skew_metrics(cfg, ep, m))
+    except Exception as e:  # noqa: BLE001 — the measurement stands alone
+        rec["skew_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    try:
+        from flashmoe_tpu.parallel.overlap import overlap_bound
+        from flashmoe_tpu.parallel.topology import tpu_generation
+
+        gen = tpu_generation(devices[0])
+        if gen in ("v4", "v5e", "v5p", "v6e"):
+            b = overlap_bound(cfg, ep, gen)
+            # the number this measurement is judged against (BASELINE.md
+            # round-5 note) — reported side by side, never in isolation
+            rec["expected_bound"] = round(b["overlap_efficiency_bound"], 3)
+    except Exception as e:  # noqa: BLE001 — but record the breakage
+        rec["bound_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    print(json.dumps(rec), flush=True)
+
+
+def _skew_metrics(cfg: MoEConfig, ep: int, m: dict) -> dict:
+    """Ring-vs-predicted-order stall of the fused kernel's static slab
+    schedule AT THIS BENCH'S CONFIG — the skew_sim discrete-event model
+    (scripts/skew_sim.py) keyed to the measured per-slab compute time
+    and this config's slab size, reported alongside the overlap number
+    instead of living only in a standalone simulation (VERDICT r4 #6).
+    Scenario: one source behind an 8x-slow link (the payload-skew case
+    of BASELINE config #5)."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    import skew_sim
+
+    from flashmoe_tpu.parallel.ep import local_capacity
+
+    nlx = cfg.num_experts // ep
+    s_loc = max(cfg.tokens // ep, 1)
+    slab_mb = (nlx * local_capacity(cfg, s_loc) * cfg.hidden_size
+               * jnp.dtype(cfg.dtype).itemsize) / 1e6
+    t_c = m["t_compute_ms"] / ep  # per-slab compute share
+    adj = skew_sim.torus_adj(ep)
+    adj.alpha[0, :] *= 8.0
+    adj.beta[0, :] *= 8.0
+    adj.alpha[0, 0] = adj.beta[0, 0] = 0.0
+    r = skew_sim.simulate(adj, adj, slab_mb, t_c)
+    return {
+        "skew8_ring_stall_ms": round(r["ring"] - r["oracle"], 4),
+        "skew8_pred_stall_ms": round(r["pred"] - r["oracle"], 4),
+        "skew8_arrival_spread_ms": round(r["spread"], 4),
+        "skew_slab_mb": round(slab_mb, 3),
+    }
 
 
 def _sweep_ep(trials: int):

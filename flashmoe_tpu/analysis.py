@@ -92,14 +92,15 @@ class PathCost:
                 + self.combine_bytes)
 
 
-def _geom(cfg: MoEConfig, d_world: int):
+def _geom(cfg: MoEConfig, d_world: int, fuse_combine: bool = False):
     """Shared geometry: local tokens, per-(rank, expert) capacity, row
-    tiling, and weight-streaming factors, resolved exactly as the
-    kernels resolve them."""
+    tiling, and the fused kernel's FFN schedule, resolved exactly as the
+    kernels resolve them — ``fuse_combine`` must mirror the path being
+    priced, because the combine chunks claim VMEM the schedule gate
+    accounts for (a mismatch here once under-charged the fused_combine
+    table 4x; code-review r5 pass 2 finding #2)."""
     from flashmoe_tpu.parallel.ep import local_capacity
-    from flashmoe_tpu.parallel.fused import (
-        _resolve_tiles, _weights_resident_choice,
-    )
+    from flashmoe_tpu.parallel.fused import _fused_schedule, _resolve_tiles
     from flashmoe_tpu import tuning
 
     s_loc = cfg.tokens // d_world
@@ -108,17 +109,18 @@ def _geom(cfg: MoEConfig, d_world: int):
     cap = local_capacity(cfg, s_loc)
     cap_pad = -(-cap // 32) * 32
     cm, bi = _resolve_tiles(cap_pad, h, i, jnp.dtype(cfg.dtype).name,
-                            False)
+                            fuse_combine)
     gated = cfg.gated_ffn
-    resident, _bh = _weights_resident_choice(
-        cap_pad, h, i, dt, gated, cm, bi, False, cfg.expert_top_k,
+    schedule, _bh = _fused_schedule(
+        cap_pad, h, i, dt, gated, cm, bi, fuse_combine,
+        cfg.expert_top_k, d_world,
         tuning.lookup("fused_ep", h=h, i=i,
                       dtype=jnp.dtype(cfg.dtype).name))
     n_row_tiles = cap_pad // cm
     n_i_chunks = i // bi
     return dict(s_loc=s_loc, h=h, i=i, dt=dt, cap=cap_pad, cm=cm, bi=bi,
-                gated=gated, resident=resident, n_row_tiles=n_row_tiles,
-                n_i_chunks=n_i_chunks)
+                gated=gated, schedule=schedule,
+                n_row_tiles=n_row_tiles, n_i_chunks=n_i_chunks)
 
 
 def path_costs(cfg: MoEConfig, path: str, d_world: int = 1) -> PathCost:
@@ -137,7 +139,7 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1) -> PathCost:
       fused_combine  RDMA kernel with the in-kernel sorted-return combine
                      (``parallel/fused.py`` + ``dispatch.sorted_return_maps``)
     """
-    g = _geom(cfg, d_world)
+    g = _geom(cfg, d_world, fuse_combine=(path == "fused_combine"))
     s, h, i, dt, cap = g["s_loc"], g["h"], g["i"], g["dt"], g["cap"]
     k = cfg.expert_top_k
     e = cfg.num_experts
@@ -151,16 +153,20 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1) -> PathCost:
     #   * the grouped kernels (ops/expert.py) sort rows by expert, so a
     #     weight block is fetched once per consecutive expert run —
     #     explicit/gather/xla read weights ONCE per expert;
-    #   * the fused RDMA kernel processes one SOURCE SLAB per grid step
-    #     (parallel/fused.py expert_body runs per (source, expert)), so
-    #     under balanced routing every local expert's weights re-stream
-    #     once per source rank: d_world x — times n_row_tiles when the
-    #     per-source streaming schedule re-reads per row tile (the
-    #     weights-resident schedule removes that inner factor only).
-    #     This d_world factor is the fused path's honest multi-chip
-    #     cost and the reason the collective path stays the multi-chip
-    #     default until a measured row says otherwise.
-    fused_streams = d_world * (1 if g["resident"] else g["n_row_tiles"])
+    #   * the fused RDMA kernel's multiplicity depends on its FFN
+    #     schedule (parallel/fused.py:_fused_schedule): the per-source
+    #     schedules re-stream every local expert's weights once per
+    #     source rank — d_world x (times n_row_tiles when streaming
+    #     per row tile); the round-5 arrival-batched schedule processes
+    #     the own slab at step 0 and every remote slab expert-major at
+    #     the final step, streaming weights exactly TWICE.  The d_world
+    #     factor was this model's headline finding (BASELINE.md round-5
+    #     reading #2) and motivated the batched schedule.
+    fused_streams = {
+        "batched": 2 if d_world > 1 else 1,
+        "resident": d_world,
+        "stream": d_world * g["n_row_tiles"],
+    }[g["schedule"]]
     gate_bytes = s * h * dt + h * e * dt
     flops = layer_flops(cfg, tokens=s)
 
@@ -192,11 +198,11 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1) -> PathCost:
     if path in ("fused", "fused_combine"):
         # dispatch builds x_send; phase-1 RDMAs read x_send and write
         # x_recv on the peers (slots bytes each side); the FFN streams
-        # x_recv once (resident: n_i_chunks times) + weights; results
+        # x_recv once (two-pass schedules: n_i_chunks times) + weights;
         # stage to y_stage and return-RDMA to the source (read + write)
         dispatch = s * h * dt + slots * h * dt
         comm = 2 * slots * h * dt                     # x out + x in
-        x_refactor = 1 if not g["resident"] else g["n_i_chunks"]
+        x_refactor = (g["n_i_chunks"] if g["schedule"] != "stream" else 1)
         act_bytes = (gate_bytes + slots * h * dt * x_refactor
                      + slots * h * dt)                # x_recv reads + y_stage
         comm += 2 * slots * h * dt                    # y back out + in

@@ -17,8 +17,11 @@ Knobs per kernel family:
   capacity_ffn   block_m (row tile), block_i (intermediate chunk) of the
                  grouped capacity-buffer / gather-fused FFN kernels
                  (``ops/expert.py:_capacity_tiling``).
-  fused_ep       cm (slab row tile), bi_cap (streamed-weight chunk cap)
-                 of the fused RDMA kernel (``parallel/fused.py``).
+  fused_ep       cm (slab row tile), bi_cap (streamed-weight chunk cap),
+                 weights_resident (bool: per-source two-pass schedule),
+                 batched (bool: arrival-batched expert-major schedule —
+                 overrides the d>=3 default either way) of the fused
+                 RDMA kernel (``parallel/fused.py:_fused_schedule``).
 """
 
 from __future__ import annotations

@@ -252,6 +252,32 @@ def test_fused_weights_resident_matches_oracle(resident, monkeypatch,
         tuning._load.cache_clear()
 
 
+def test_fused_batched_schedule_matches_per_source(monkeypatch, devices):
+    """The arrival-batched schedule (default at ep >= 3: own slab at
+    step 0, remote slabs expert-major at the final step with weights
+    streamed once — the fix for the d x weight re-streaming the round-5
+    cost model exposed) must be numerically identical to the per-source
+    schedule and the oracle, drops included."""
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                    intermediate_size=256, sequence_len=512,
+                    capacity_factor=1.0, drop_tokens=True, ep=4, **F32)
+    params, x = _setup(cfg)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:4])
+    monkeypatch.delenv("FLASHMOE_FUSED_BATCHED", raising=False)
+    batched = fused_ep_moe_layer(params, x, cfg, mesh, interpret=True,
+                                 detect_races=True)
+    monkeypatch.setenv("FLASHMOE_FUSED_BATCHED", "0")
+    per_src = fused_ep_moe_layer(params, x, cfg, mesh, interpret=True)
+    monkeypatch.delenv("FLASHMOE_FUSED_BATCHED")
+    np.testing.assert_allclose(np.asarray(batched.out),
+                               np.asarray(per_src.out),
+                               rtol=1e-5, atol=1e-5)
+    want = ep_moe_layer(params, x, cfg, mesh, use_pallas=False)
+    np.testing.assert_allclose(np.asarray(batched.out),
+                               np.asarray(want.out),
+                               rtol=2e-4, atol=2e-4)
+
+
 @pytest.mark.slow
 def test_fused_combine_gradients_match_collective_path(monkeypatch,
                                                        devices):

@@ -38,3 +38,30 @@ def test_preset_layer_runs_small(name):
         np.testing.assert_allclose(
             np.asarray(out.out), np.asarray(want), rtol=2e-4, atol=2e-4
         )
+
+
+def test_weak_scaling_256_bench_config(devices):
+    """BASELINE config #5 (256-expert weak-scaling / payload-skew) must be
+    driver-invokable by name (bench.py --config weak_scaling_256) and
+    correct: the full 256-expert routing runs through the collective EP
+    layer on the virtual 8-device mesh at shrunken H/I/S, matching the
+    dense oracle."""
+    from flashmoe_tpu.config import BENCH_CONFIGS
+    from flashmoe_tpu.parallel.ep import ep_moe_layer
+    from flashmoe_tpu.parallel.mesh import make_mesh
+
+    cfg = BENCH_CONFIGS["weak_scaling_256"].replace(
+        hidden_size=128, intermediate_size=128, sequence_len=1024,
+        ep=8, drop_tokens=False, capacity_factor=1.0,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    assert cfg.num_experts == 256
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1),
+                          (cfg.tokens, cfg.hidden_size), jnp.float32)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:8])
+    out = ep_moe_layer(params, x, cfg, mesh)
+    want, _ = reference_moe(params, x, cfg)
+    np.testing.assert_allclose(
+        np.asarray(out.out), np.asarray(want), rtol=3e-4, atol=3e-4
+    )
