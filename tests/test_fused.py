@@ -279,6 +279,38 @@ def test_fused_batched_schedule_matches_per_source(monkeypatch, devices):
 
 
 @pytest.mark.slow
+def test_fused_batched_forced_at_two_ranks(monkeypatch, tmp_path,
+                                           devices):
+    """ep=2 sits below the batched default (the schedules tie on weight
+    bytes there) but a measured `batched: true` tuning entry must force
+    it — the single-remote-source edge of the generalized two-pass
+    (first_q=1, n_srcs=1)."""
+    import json
+
+    from flashmoe_tpu import tuning
+
+    p = tmp_path / "t.json"
+    p.write_text(json.dumps({"generation": "x", "entries": [{
+        "kernel": "fused_ep", "match": {"h": 128},
+        "set": {"batched": True}}]}))
+    monkeypatch.setenv("FLASHMOE_TUNING_FILE", str(p))
+    monkeypatch.delenv("FLASHMOE_FUSED_BATCHED", raising=False)
+    tuning._load.cache_clear()
+    try:
+        cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                        intermediate_size=256, sequence_len=256,
+                        drop_tokens=False, ep=2, **F32)
+        params, x = _setup(cfg)
+        mesh = make_mesh(cfg, dp=1, devices=devices[:2])
+        out = fused_ep_moe_layer(params, x, cfg, mesh, interpret=True)
+        want, _ = reference_moe(params, x, cfg)
+        np.testing.assert_allclose(np.asarray(out.out), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+    finally:
+        tuning._load.cache_clear()
+
+
+@pytest.mark.slow
 def test_fused_combine_gradients_match_collective_path(monkeypatch,
                                                        devices):
     """Router + FFN + input gradients must flow correctly through the
