@@ -279,6 +279,66 @@ def test_fused_batched_schedule_matches_per_source(monkeypatch, devices):
 
 
 @pytest.mark.slow
+def test_fused_batched_with_in_kernel_combine(monkeypatch, devices):
+    """The two round-5 features compose: arrival-batched FFN (ep=4
+    default) + sorted-return combine.  All remote returns issue at the
+    final grid step, immediately before the drain's row waits and the
+    segment-sum — the tightest schedule the combine's semaphore
+    accounting has to survive.  Race detector on."""
+    monkeypatch.setenv("FLASHMOE_FUSED_COMBINE", "1")
+    monkeypatch.delenv("FLASHMOE_FUSED_BATCHED", raising=False)
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                    intermediate_size=256, sequence_len=512,
+                    capacity_factor=1.0, drop_tokens=True, ep=4, **F32)
+    params, x = _setup(cfg)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:4])
+    got = fused_ep_moe_layer(params, x, cfg, mesh, interpret=True,
+                             detect_races=True)
+    want = ep_moe_layer(params, x, cfg, mesh, use_pallas=False)
+    np.testing.assert_allclose(
+        np.asarray(got.out), np.asarray(want.out), rtol=2e-4, atol=2e-4
+    )
+
+
+def _assert_fused_grads_match_collective(params, x, cfg, mesh):
+    """Shared gradient contract: jitted grads (un-jitted grad through
+    the fused kernels can deadlock the interpreter — see the note on
+    the combine gradient test) compared param-by-param."""
+    def loss_fused(p, xx):
+        o = fused_ep_moe_layer(p, xx, cfg, mesh, interpret=True)
+        return (o.out.astype(jnp.float32) ** 2).sum()
+
+    def loss_coll(p, xx):
+        o = ep_moe_layer(p, xx, cfg, mesh, use_pallas=False)
+        return (o.out.astype(jnp.float32) ** 2).sum()
+
+    gf = jax.jit(jax.grad(loss_fused, argnums=(0, 1)))(params, x)
+    gc = jax.jit(jax.grad(loss_coll, argnums=(0, 1)))(params, x)
+    np.testing.assert_allclose(np.asarray(gf[1]), np.asarray(gc[1]),
+                               rtol=5e-3, atol=5e-3)
+    for k in gc[0]:
+        np.testing.assert_allclose(
+            np.asarray(gf[0][k]), np.asarray(gc[0][k]),
+            rtol=5e-3, atol=5e-3, err_msg=k,
+        )
+
+
+@pytest.mark.slow
+def test_fused_batched_gradients(monkeypatch, devices):
+    """Autodiff through the batched-schedule forward (the custom VJP's
+    backward is schedule-independent, but the fwd kernel under
+    linearize is not)."""
+    monkeypatch.delenv("FLASHMOE_FUSED_BATCHED", raising=False)
+    monkeypatch.delenv("FLASHMOE_FUSED_COMBINE", raising=False)
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                    intermediate_size=256, sequence_len=256,
+                    drop_tokens=False, ep=4, **F32)
+    params, x = _setup(cfg)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:4])
+    _assert_fused_grads_match_collective(params, x, cfg, mesh)
+
+
+@pytest.mark.slow
 def test_fused_batched_forced_at_two_ranks(monkeypatch, tmp_path,
                                            devices):
     """ep=2 sits below the batched default (the schedules tie on weight
@@ -331,24 +391,7 @@ def test_fused_combine_gradients_match_collective_path(monkeypatch,
                     capacity_factor=1.0, drop_tokens=True, ep=2, **F32)
     params, x = _setup(cfg)
     mesh = make_mesh(cfg, dp=1, devices=devices[:2])
-
-    def loss_fused(p, xx):
-        o = fused_ep_moe_layer(p, xx, cfg, mesh, interpret=True)
-        return (o.out.astype(jnp.float32) ** 2).sum()
-
-    def loss_coll(p, xx):
-        o = ep_moe_layer(p, xx, cfg, mesh, use_pallas=False)
-        return (o.out.astype(jnp.float32) ** 2).sum()
-
-    gf = jax.jit(jax.grad(loss_fused, argnums=(0, 1)))(params, x)
-    gc = jax.jit(jax.grad(loss_coll, argnums=(0, 1)))(params, x)
-    np.testing.assert_allclose(np.asarray(gf[1]), np.asarray(gc[1]),
-                               rtol=5e-3, atol=5e-3)
-    for k in gc[0]:
-        np.testing.assert_allclose(
-            np.asarray(gf[0][k]), np.asarray(gc[0][k]),
-            rtol=5e-3, atol=5e-3, err_msg=k,
-        )
+    _assert_fused_grads_match_collective(params, x, cfg, mesh)
 
 
 @pytest.mark.slow
