@@ -137,20 +137,56 @@ def main():
 
 
 def combine_modes(args):
-    """The VERDICT r3 #2 decision row: the fused RDMA layer with the
-    in-kernel combine (FLASHMOE_FUSED_COMBINE=1) vs the XLA combine, on
-    a 1-rank mesh on the real chip.  The in-kernel combine's per-row VPU
-    scatter is the suspected serializer; whichever mode wins here sets
-    the default."""
+    """Decision row: the fused RDMA layer with the in-kernel
+    sorted-return combine (FLASHMOE_FUSED_COMBINE=1) vs the XLA combine.
+
+    Since the round-5 restructure the in-kernel combine REQUIRES a
+    multi-rank ep world (at one rank there is no return traffic to
+    overlap and the gate falls back to the XLA combine by design), so
+    this row can only be measured with >= 2 chips: both "modes" on one
+    chip would time the identical kernel and report a noise winner.
+    With one device the record says so explicitly instead."""
     from flashmoe_tpu.parallel.fused import fused_ep_moe_layer
     from flashmoe_tpu.parallel.mesh import make_mesh
 
-    cfg = BENCH_CONFIGS[args.config].replace(ep=1)
+    def bail(**why):
+        print(json.dumps({
+            "bench": "fused_combine_modes", "config": args.config, **why,
+        }), flush=True)
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        bail(requires_multichip=True,
+             note="in-kernel combine is ep>1-only since the round-5 "
+                  "sorted-return restructure; 1 device present — both "
+                  "modes would time the identical kernel")
+        return
+    base = BENCH_CONFIGS[args.config]
+    if base.num_experts % 2:
+        bail(error=f"num_experts={base.num_experts} not divisible by "
+                   f"ep=2")
+        return
+    cfg = base.replace(ep=2)
+    # the gate can also fall back on SMEM/VMEM infeasibility — detect it
+    # up front so the record never reports a noise winner between two
+    # identical kernels (review r5 pass 6 #2)
+    from flashmoe_tpu.parallel.ep import local_capacity
+    from flashmoe_tpu.parallel.fused import _fuse_combine_budget_ok
+
+    s_loc = cfg.tokens // cfg.ep
+    cap_pad = -(-local_capacity(cfg, s_loc) // 32) * 32
+    if not _fuse_combine_budget_ok(cfg, s_loc, cfg.hidden_size,
+                                   cfg.intermediate_size, cap_pad):
+        bail(combine_infeasible=True,
+             note="combine maps/chunks exceed the SMEM/VMEM budget at "
+                  "this config; the gate would fall back to the XLA "
+                  "combine for both modes")
+        return
     params = init_moe_params(jax.random.PRNGKey(0), cfg)
     params = jax.tree_util.tree_map(lambda p: p.astype(cfg.dtype), params)
     x = jax.random.normal(
         jax.random.PRNGKey(1), (cfg.tokens, cfg.hidden_size), cfg.dtype)
-    mesh = make_mesh(cfg, dp=1, devices=jax.devices()[:1])
+    mesh = make_mesh(cfg, dp=1, devices=jax.devices()[:cfg.ep])
     out = {}
     for mode in ("0", "1"):
         os.environ["FLASHMOE_FUSED_COMBINE"] = mode

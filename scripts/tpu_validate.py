@@ -157,7 +157,6 @@ def main() -> int:
     # arm because the op has no CPU lowering — this is the only place the
     # ragged arm executes for real).  ep=1 mesh: proves compilation +
     # numerics of the full ragged layout path vs the dense arm.
-    import os as _os
 
     from flashmoe_tpu.parallel.mesh import make_mesh
     from flashmoe_tpu.parallel.ragged_ep import ragged_ep_moe_layer
@@ -182,13 +181,13 @@ def main() -> int:
     got_f = fused_ep_moe_layer(params, x, cfg_r, mesh1)
     check("fused_kernel_xla_combine",
           float(jnp.max(jnp.abs(got_f.out - want2))), 1e-4)
-    _os.environ["FLASHMOE_FUSED_COMBINE"] = "1"
-    try:
-        got_fc = fused_ep_moe_layer(params, x, cfg_r, mesh1)
-        check("fused_kernel_in_kernel_combine",
-              float(jnp.max(jnp.abs(got_fc.out - want2))), 1e-4)
-    finally:
-        _os.environ.pop("FLASHMOE_FUSED_COMBINE", None)
+    # the in-kernel sorted-return combine is ep>1-only since round 5
+    # (the gate falls back to the XLA combine at one rank), so its
+    # Mosaic lowering cannot be validated on this single tunneled chip —
+    # re-running here would just compile the identical kernel twice and
+    # burn ~90 s of a hardware window
+    print("  fused_kernel_in_kernel_combine: SKIPPED (ep>1-only; "
+          "needs a multi-chip window)", flush=True)
 
     # 9. two-pass expert-tiled gate (large E): Mosaic-lowering check of
     # the multi-tile online-softmax/top-k kernel vs the XLA router
