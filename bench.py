@@ -233,10 +233,15 @@ def _bench_overlap(ep: int, trials: int):
 
         gen = tpu_generation(devices[0])
         if gen in ("v4", "v5e", "v5p", "v6e"):
-            b = overlap_bound(cfg, ep, gen)
+            b = overlap_bound(
+                cfg, ep, gen,
+                fuse_combine=os.environ.get(
+                    "FLASHMOE_FUSED_COMBINE") == "1")
             # the number this measurement is judged against (BASELINE.md
-            # round-5 note) — reported side by side, never in isolation
+            # round-5 note) — reported side by side, never in isolation;
+            # resolved for the FFN schedule that will actually run
             rec["expected_bound"] = round(b["overlap_efficiency_bound"], 3)
+            rec["expected_bound_schedule"] = b["schedule"]
     except Exception as e:  # noqa: BLE001 — but record the breakage
         rec["bound_error"] = f"{type(e).__name__}: {str(e)[:120]}"
     print(json.dumps(rec), flush=True)

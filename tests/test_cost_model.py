@@ -209,25 +209,35 @@ def test_candidate_table_renders():
     assert "fused_combine" in t and "| path |" in t
 
 
-def test_overlap_bound_reference_v5e8():
+def test_overlap_bound_reference_v5e8(monkeypatch):
     """The analytical bound a hardware --overlap run is judged against
-    (VERDICT r4 next #8).  At the reference config on v5e-8 the layer is
-    compute-bound at roofline (C > t_x + C/d), so the schedule should
-    hide (almost) all communication: OE_bound = (C + 2 t_x) / (C + tail)
-    — between 1 (nothing hidden) and 2 (everything hidden), and well
-    above 1.25 here because comm is a third of compute."""
+    (VERDICT r4 next #8), per FFN schedule.  Per-source at the reference
+    config on v5e-8 is compute-bound at roofline (C > t_x + C/d), so it
+    should hide (almost) all communication: OE well above 1.25.  The
+    batched schedule trades some of that overlap for its 2x weight
+    streams (only the own slab's C/d hides arrivals, and returns issue
+    per expert, so the tail waits t_x/nlx), so its bound sits strictly
+    lower — both are reported so a measurement is judged against the
+    schedule that actually ran."""
     from flashmoe_tpu.parallel.overlap import overlap_bound
 
-    b = overlap_bound(REF, 8, "v5e")
+    monkeypatch.delenv("FLASHMOE_FUSED_BATCHED", raising=False)
+    b = overlap_bound(REF, 8, "v5e", schedule="per_source")
     assert b["compute_bound"]
     assert 1.25 <= b["overlap_efficiency_bound"] <= 2.0
+    # the default resolution at d=8 is the batched schedule
+    bb = overlap_bound(REF, 8, "v5e")
+    assert bb["schedule"] == "batched"
+    assert 1.0 <= bb["overlap_efficiency_bound"] < \
+        b["overlap_efficiency_bound"]
     # calibrated at the measured round-2 mxu_util (0.512): compute
     # stretches, comm stays — the bound must drop toward serialized
-    cal = overlap_bound(REF, 8, "v5e", mxu_fraction=0.512)
+    cal = overlap_bound(REF, 8, "v5e", mxu_fraction=0.512,
+                        schedule="per_source")
     assert cal["overlap_efficiency_bound"] < b["overlap_efficiency_bound"]
     assert cal["overlap_efficiency_bound"] >= 1.0
     # more ranks shrink per-rank compute faster than per-rank comm
     # (b_dir ~ (d-1)/d), pushing toward the comm-bound regime
-    b64 = overlap_bound(REF, 64, "v5e")
+    b64 = overlap_bound(REF, 64, "v5e", schedule="per_source")
     assert b64["t_x_ms"] / b64["compute_ms"] > \
         b["t_x_ms"] / b["compute_ms"]
