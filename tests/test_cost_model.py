@@ -204,6 +204,26 @@ def test_xla_dispatch_bytes_match_model():
         (cost, model)
 
 
+def test_schedule_resolution_decision_table(monkeypatch):
+    """The BASELINE decision table: which FFN schedule each bench config
+    resolves to at d=8, and the mixtral warning — its 14336-wide expert
+    hidden slab exceeds VMEM for every weights-once schedule, so the
+    fused path degrades to stream (40x the collective path's weight
+    traffic) and the framework's guidance is to stay collective there."""
+    from flashmoe_tpu.analysis import _geom
+
+    monkeypatch.delenv("FLASHMOE_FUSED_BATCHED", raising=False)
+    assert _geom(REF, 8)["schedule"] == "batched"
+    assert _geom(BENCH_CONFIGS["deepseek"], 8)["schedule"] == "batched"
+    assert _geom(BENCH_CONFIGS["weak_scaling_256"], 8)["schedule"] == \
+        "batched"
+    mix = _geom(BENCH_CONFIGS["mixtral"], 8)
+    assert mix["schedule"] == "stream"
+    fused = path_costs(BENCH_CONFIGS["mixtral"], "fused", d_world=8)
+    coll = path_costs(BENCH_CONFIGS["mixtral"], "xla", d_world=8)
+    assert fused.weight_bytes > 20 * coll.weight_bytes
+
+
 def test_candidate_table_renders():
     t = candidate_table(REF.replace(ep=8), d_world=8)
     assert "fused_combine" in t and "| path |" in t
