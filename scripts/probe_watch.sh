@@ -47,6 +47,13 @@ while true; do
     run_leg combine_modes 1200 python scripts/stage_bench.py --path combine
     run_leg tune_sweep 2400 python scripts/tune_sweep.py
     run_leg bench_weak256 1800 python bench.py --config weak_scaling_256
+    # commit whatever the window produced, so results survive even if
+    # the session's turns ran out before contact
+    git add "$OUTDIR" flashmoe_tpu/tuning_data "$LOG" 2>> "$LOG"
+    git -c user.name=distsys-graft \
+        -c user.email=distsys-graft@users.noreply.github.com \
+        commit -q -m "Hardware window captured: $OUTDIR (bench, validate, stage benches, tune sweep)" \
+        >> "$LOG" 2>&1 || true
     exit 0
   fi
   echo "$ts attempt=$attempt DOWN rc=$rc: ${out:-<no output>}" >> "$LOG"
