@@ -120,12 +120,6 @@ def bench_moe_layer(cfg: MoEConfig, trials: int, chain: int = 16,
     return out["fused"], out["xla"]
 
 
-_PEAK_TFLOPS = {
-    # bf16 peak matmul TFLOP/s per chip (public spec sheets)
-    "v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0,
-}
-
-
 def _layer_flops(cfg: MoEConfig) -> float:
     """Model FLOPs of one MoE layer forward: gate GEMM + routed expert
     FFN (2 or 3 GEMMs per token-slot)."""
@@ -140,12 +134,49 @@ def _mxu_util(cfg: MoEConfig, seconds: float) -> float | None:
     """Achieved fraction of peak MXU throughput — the TPU analogue of the
     reference's headline SM-utilization metric (``README.md:43-44``,
     ``plots/sm_util.png``), computed from model FLOPs over wall time."""
-    from flashmoe_tpu.parallel.topology import tpu_generation
+    from flashmoe_tpu.parallel.topology import _PEAK_TFLOPS, tpu_generation
 
     peak = _PEAK_TFLOPS.get(tpu_generation(jax.devices()[0]))
     if peak is None or seconds <= 0:
         return None
     return _layer_flops(cfg) / seconds / (peak * 1e12)
+
+
+def _planner_fields(cfg, t_fused, t_xla) -> dict:
+    """Predicted-vs-measured fields for this record: the analytical
+    planner's prediction of the measured path, the signed relative
+    error, and the planner's predicted winner at this config — every
+    bench run doubles as a calibration point for the cost model
+    (``docs/PLANNER.md``).  Empty off known generations (the virtual
+    CPU backend has no roofline to predict against; pin
+    ``FLASHMOE_TPU_GEN`` to force one)."""
+    from flashmoe_tpu.parallel.topology import _PEAK_TFLOPS, tpu_generation
+    from flashmoe_tpu.planner.model import predict_paths
+
+    gen = tpu_generation(jax.devices()[0])
+    if gen not in _PEAK_TFLOPS:
+        gen = os.environ.get("FLASHMOE_TPU_GEN", "")
+        if gen not in _PEAK_TFLOPS:
+            return {}
+    preds = {p.path: p for p in predict_paths(cfg, 1, gen)}
+    measured_path = ("gather" if _PARTIAL.get("fused_variant") == "gather"
+                     else "explicit")
+    out = {"planner_gen": gen}
+    winner = next((p for p in preds.values() if p.feasible), None)
+    if winner is not None:
+        out["predicted_winner"] = winner.path
+    p = preds.get(measured_path)
+    if p is not None:
+        out["predicted_path"] = measured_path
+        out["predicted_ms"] = round(p.total_ms, 3)
+        out["prediction_error"] = round(
+            t_fused * 1e3 / p.total_ms - 1.0, 3)
+    px = preds.get("xla")
+    if t_xla and px is not None:
+        out["xla_predicted_ms"] = round(px.total_ms, 3)
+        out["xla_prediction_error"] = round(
+            t_xla * 1e3 / px.total_ms - 1.0, 3)
+    return out
 
 
 def _emit(cfg, name, t_fused, t_xla, note: str | None = None):
@@ -173,6 +204,16 @@ def _emit(cfg, name, t_fused, t_xla, note: str | None = None):
     if "gather_fused" in _PARTIAL:
         rec["gather_fused_ms"] = round(_PARTIAL["gather_fused"] * 1e3, 3)
         rec["fused_variant"] = _PARTIAL.get("fused_variant", "explicit")
+    # path/d identify this measurement for the planner's measured-winner
+    # override (planner/select.py:_bench_record_latencies): the headline
+    # bench times the single-chip (d=1) kernels
+    rec["path"] = ("gather" if _PARTIAL.get("fused_variant") == "gather"
+                   else "explicit")
+    rec["d"] = 1
+    try:
+        rec.update(_planner_fields(cfg, t_fused, t_xla))
+    except Exception as e:  # noqa: BLE001 — never lose the record
+        rec["planner_error"] = f"{type(e).__name__}: {str(e)[:120]}"
     if note:
         rec["partial"] = note
     print(json.dumps(rec), flush=True)
@@ -257,8 +298,13 @@ def _skew_metrics(cfg: MoEConfig, ep: int, m: dict) -> dict:
     of BASELINE config #5)."""
     import sys as _sys
 
-    _sys.path.insert(0, os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    # insert only if absent: an unconditional insert accumulated one
+    # duplicate entry per overlap run and kept scripts/ ahead of every
+    # other import root (module-shadowing risk; ADVICE round 5)
+    _scripts = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts")
+    if _scripts not in _sys.path:
+        _sys.path.insert(0, _scripts)
     import skew_sim
 
     from flashmoe_tpu.parallel.ep import local_capacity

@@ -92,13 +92,19 @@ class PathCost:
                 + self.combine_bytes)
 
 
-def _geom(cfg: MoEConfig, d_world: int, fuse_combine: bool = False):
+def _geom(cfg: MoEConfig, d_world: int, fuse_combine: bool = False,
+          schedule: str | None = None):
     """Shared geometry: local tokens, per-(rank, expert) capacity, row
     tiling, and the fused kernel's FFN schedule, resolved exactly as the
     kernels resolve them — ``fuse_combine`` must mirror the path being
     priced, because the combine chunks claim VMEM the schedule gate
     accounts for (a mismatch here once under-charged the fused_combine
-    table 4x; code-review r5 pass 2 finding #2)."""
+    table 4x; code-review r5 pass 2 finding #2).
+
+    ``schedule`` overrides the kernel's own resolution ('batched',
+    'resident', 'stream') so the planner can price every schedule, not
+    just the one the heuristics would pick; None keeps the kernel's
+    choice."""
     from flashmoe_tpu.parallel.ep import local_capacity
     from flashmoe_tpu.parallel.fused import _fused_schedule, _resolve_tiles
     from flashmoe_tpu import tuning
@@ -111,19 +117,24 @@ def _geom(cfg: MoEConfig, d_world: int, fuse_combine: bool = False):
     cm, bi = _resolve_tiles(cap_pad, h, i, jnp.dtype(cfg.dtype).name,
                             fuse_combine)
     gated = cfg.gated_ffn
-    schedule, _bh = _fused_schedule(
+    resolved, _bh = _fused_schedule(
         cap_pad, h, i, dt, gated, cm, bi, fuse_combine,
         cfg.expert_top_k, d_world,
         tuning.lookup("fused_ep", h=h, i=i,
                       dtype=jnp.dtype(cfg.dtype).name))
+    if schedule is not None:
+        if schedule not in ("batched", "resident", "stream"):
+            raise ValueError(f"unknown fused schedule {schedule!r}")
+        resolved = schedule
     n_row_tiles = cap_pad // cm
     n_i_chunks = i // bi
     return dict(s_loc=s_loc, h=h, i=i, dt=dt, cap=cap_pad, cm=cm, bi=bi,
-                gated=gated, schedule=schedule,
+                gated=gated, schedule=resolved,
                 n_row_tiles=n_row_tiles, n_i_chunks=n_i_chunks)
 
 
-def path_costs(cfg: MoEConfig, path: str, d_world: int = 1) -> PathCost:
+def path_costs(cfg: MoEConfig, path: str, d_world: int = 1,
+               schedule: str | None = None) -> PathCost:
     """Analytical per-chip HBM bytes for one forward of ``path``.
 
     Paths (single-chip unless noted):
@@ -138,8 +149,13 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1) -> PathCost:
                      (``parallel/fused.py``, slab returns)
       fused_combine  RDMA kernel with the in-kernel sorted-return combine
                      (``parallel/fused.py`` + ``dispatch.sorted_return_maps``)
+
+    ``schedule`` (fused paths only) forces the FFN schedule being priced;
+    None resolves the kernel's actual choice.
     """
-    g = _geom(cfg, d_world, fuse_combine=(path == "fused_combine"))
+    g = _geom(cfg, d_world, fuse_combine=(path == "fused_combine"),
+              schedule=schedule if path in ("fused", "fused_combine")
+              else None)
     s, h, i, dt, cap = g["s_loc"], g["h"], g["i"], g["dt"], g["cap"]
     k = cfg.expert_top_k
     e = cfg.num_experts
@@ -195,6 +211,19 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1) -> PathCost:
         return PathCost(path, w_once,
                         gate_bytes + rows * h * dt + rows * h * dt,
                         0.0, 0.0, combine, combine, flops)
+    if path == "ragged":
+        # dropless ragged EP (parallel/ragged_ep.py): tokens sort into
+        # expert-contiguous rows with NO capacity padding — under the
+        # uniform-routing expectation exactly the s*k routed rows move
+        # (a skewed batch moves more; this prices the expectation, the
+        # same stance the capacity paths take on padding).  Build the
+        # sorted send rows, FFN reads/writes them, combine gathers k
+        # rows per token.
+        dispatch = s * h * dt + rows * h * dt
+        combine = rows * h * dt + s * h * 4
+        return PathCost(path, w_once,
+                        gate_bytes + rows * h * dt + rows * h * dt,
+                        dispatch, 0.0, combine, combine, flops)
     if path in ("fused", "fused_combine"):
         # dispatch builds x_send; phase-1 RDMAs read x_send and write
         # x_recv on the peers (slots bytes each side); the FFN streams
@@ -205,7 +234,15 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1) -> PathCost:
         x_refactor = (g["n_i_chunks"] if g["schedule"] != "stream" else 1)
         act_bytes = (gate_bytes + slots * h * dt * x_refactor
                      + slots * h * dt)                # x_recv reads + y_stage
-        comm += 2 * slots * h * dt                    # y back out + in
+        if path == "fused_combine":
+            # sorted per-row returns carry only the rows actually routed
+            # (dispatch.sorted_return_maps): rows*h out + rows*h in — the
+            # slab path below returns full capacity-padded slabs, which
+            # overstated this path's comm at capacity_factor > 1
+            # (ADVICE round 5)
+            comm += 2 * rows * h * dt                 # y back out + in
+        else:
+            comm += 2 * slots * h * dt                # y back out + in
         if path == "fused":
             combine = slots * h * dt + s * h * 4      # XLA reads y_recv
             post = combine
@@ -220,7 +257,7 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1) -> PathCost:
 
 
 def a2a_transport_cost(d: int, inner: int, slab_bytes: float,
-                       gen: str = "v5e") -> dict:
+                       gen: str = "v5e", links: int = 1) -> dict:
     """Model the flat vs two-stage (ICI+DCN) all-to-all on a ``d``-rank
     ep axis spanning ``d // inner`` slices, per rank per direction
     (``parallel/ep.py:_hierarchical_a2a``; the reference's per-peer
@@ -234,13 +271,23 @@ def a2a_transport_cost(d: int, inner: int, slab_bytes: float,
     of inner slabs) — identical cross-slice bytes, ``inner``x fewer DCN
     messages, so the alpha term shrinks by (inner-1)(outer-1) DCN
     latencies at the price of (outer-1) extra in-slice slab transfers.
+
+    ``links``: ICI links per chip striping each in-slice transfer (the
+    beta term divides; per-message alpha and the host-NIC DCN path do
+    not) — pass the mesh's link count so single-slice and multi-slice
+    predictions stay comparable (planner code-review finding).
     """
     from flashmoe_tpu.parallel.topology import _DCN_SPEC, _ICI_SPECS
 
+    if inner < 1 or d % inner:
+        raise ValueError(
+            f"ep axis d={d} is not divisible into slices of inner={inner} "
+            f"ranks; the two-stage decomposition needs d % inner == 0")
     a_ici, bw_ici = _ICI_SPECS.get(gen, _ICI_SPECS["default"])
     a_dcn, bw_dcn = _DCN_SPEC
     a_ici, a_dcn = a_ici / 1e3, a_dcn / 1e3              # ms
-    bw_ici, bw_dcn = bw_ici * 1e6, bw_dcn * 1e6          # B/ms
+    bw_ici = bw_ici * 1e6 * max(links, 1)                # B/ms, striped
+    bw_dcn = bw_dcn * 1e6                                # B/ms
     outer = d // inner
     flat = {
         "dcn_messages": d - inner,
@@ -260,7 +307,8 @@ def a2a_transport_cost(d: int, inner: int, slab_bytes: float,
 def candidate_table(cfg: MoEConfig, d_world: int = 1) -> str:
     """Markdown table of every path's modeled bytes at ``cfg`` — the
     BASELINE.md evidence table (VERDICT r4 next #2)."""
-    paths = ["xla", "explicit", "gather", "fused", "fused_combine"]
+    paths = ["xla", "explicit", "gather", "ragged", "fused",
+             "fused_combine"]
     lines = [
         f"| path | weights MB | acts MB | dispatch MB | comm MB | "
         f"combine MB | total MB | post-kernel MB |",

@@ -118,7 +118,10 @@ class MoEConfig:
 
     # distributed MoE transport when ep > 1: "collective" (XLA all-to-all,
     # the robust default), "fused" (in-kernel RDMA, the FlashDMoE path),
-    # "ragged" (dropless ragged all-to-all)
+    # "ragged" (dropless ragged all-to-all), or "auto" — the analytical
+    # planner (flashmoe_tpu/planner/) picks per (config, mesh,
+    # generation): predicted-latency winner, measured-winner when
+    # tuning-table / bench measurements cover the shape
     moe_backend: str = "collective"
 
     # Inference-only: fuse the dispatch gather into the FFN kernel
@@ -143,10 +146,11 @@ class MoEConfig:
             raise ValueError("num_experts must divide evenly over ep")
         if self.capacity_factor <= 0:
             raise ValueError("capacity_factor must be > 0")
-        if self.moe_backend not in ("collective", "fused", "ragged"):
+        if self.moe_backend not in ("collective", "fused", "ragged",
+                                    "auto"):
             raise ValueError(
                 f"moe_backend {self.moe_backend!r} not in "
-                f"('collective', 'fused', 'ragged')"
+                f"('collective', 'fused', 'ragged', 'auto')"
             )
         # reject combinations the specialized transports cannot serve
         # rather than silently falling back to the collective path

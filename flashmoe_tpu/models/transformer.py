@@ -148,6 +148,17 @@ def attention(layer, x, cfg: MoEConfig, positions=None, mesh=None,
     return ctx @ layer["wo"].astype(x.dtype)
 
 
+def _resolved_backend(cfg: MoEConfig, mesh) -> str:
+    """cfg.moe_backend with 'auto' resolved by the analytical planner
+    (predicted-latency winner, measured override; decision recorded in
+    telemetry)."""
+    if cfg.moe_backend != "auto":
+        return cfg.moe_backend
+    from flashmoe_tpu.parallel.ep import resolve_moe_backend
+
+    return resolve_moe_backend(cfg, mesh)
+
+
 def _ffn(layer, x, cfg: MoEConfig, li: int, mesh, use_pallas):
     """FFN sub-block: MoE (possibly expert-parallel) or dense."""
     b, t, h = x.shape
@@ -157,7 +168,8 @@ def _ffn(layer, x, cfg: MoEConfig, li: int, mesh, use_pallas):
     )
     if mesh is not None and layer_cfg.num_experts > 1 and cfg.ep > 1:
         axes = ("dp", "ep") + (("sp",) if cfg.sp > 1 else ())
-        if cfg.moe_backend == "fused" and cfg.tp == 1:
+        backend = _resolved_backend(cfg, mesh)
+        if backend == "fused" and cfg.tp == 1:
             from flashmoe_tpu.parallel.fused import fused_ep_moe_layer
 
             # distinct collective_id per layer: each fused kernel in the
@@ -168,7 +180,7 @@ def _ffn(layer, x, cfg: MoEConfig, li: int, mesh, use_pallas):
                                    token_axes=axes,
                                    collective_id=7 + (li % 16),
                                    interpret=jax.default_backend() != "tpu")
-        elif (cfg.moe_backend == "ragged" and cfg.tp == 1
+        elif (backend == "ragged" and cfg.tp == 1
                 and not layer_cfg.num_shared_experts):
             from flashmoe_tpu.parallel.ragged_ep import ragged_ep_moe_layer
 
@@ -210,9 +222,9 @@ def forward(params, tokens, cfg: MoEConfig, mesh=None, use_pallas=None):
     # fused branch — its kernel's side effects cannot be partially
     # evaluated under checkpoint, and its custom VJP already avoids
     # storing the exchange intermediates).  Non-MoE blocks keep remat.
-    fused_active = (cfg.moe_backend == "fused" and cfg.ep > 1
-                    and cfg.tp == 1 and mesh is not None
-                    and cfg.num_experts > 1)
+    fused_active = (cfg.ep > 1 and cfg.tp == 1 and mesh is not None
+                    and cfg.num_experts > 1
+                    and _resolved_backend(cfg, mesh) == "fused")
     blk_remat = jax.checkpoint(
         block, static_argnums=(2, 3, 4, 5),
         policy=jax.checkpoint_policies.nothing_saveable,

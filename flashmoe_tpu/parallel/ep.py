@@ -228,3 +228,46 @@ def ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
         check_vma=False,
     )
     return fn(params, x)
+
+
+def resolve_moe_backend(cfg: MoEConfig, mesh: Mesh | None = None) -> str:
+    """The concrete moe_backend this layer stack should run.
+
+    Pass-through for explicit configs; ``moe_backend='auto'`` consults
+    the analytical planner (:mod:`flashmoe_tpu.planner.select`) — the
+    predicted per-path latency winner, overridden by measured entries
+    when the tuning table or bench records cover this shape.  The
+    decision and its full breakdown land in telemetry
+    (``metrics.decision('planner.path_select', ...)``)."""
+    from flashmoe_tpu.planner.select import resolve_moe_backend as _resolve
+
+    return _resolve(cfg, mesh)
+
+
+def auto_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
+                      use_pallas: bool = False,
+                      token_axes: tuple[str, ...] = ("ep",),
+                      interpret: bool = False,
+                      collective_id: int = 7) -> MoEOutput:
+    """Expert-parallel MoE layer on the planner-selected path.
+
+    Same contract as :func:`ep_moe_layer`; the transport (collective /
+    ragged / fused RDMA) is chosen by :func:`resolve_moe_backend` for
+    this (cfg, mesh) instead of being hard-coded by the caller."""
+    backend = resolve_moe_backend(cfg, mesh)
+    if backend == "fused":
+        from flashmoe_tpu.parallel.fused import fused_ep_moe_layer
+
+        return fused_ep_moe_layer(params, x, cfg, mesh,
+                                  token_axes=token_axes,
+                                  collective_id=collective_id,
+                                  interpret=interpret)
+    if backend == "ragged":
+        from flashmoe_tpu.parallel.ragged_ep import ragged_ep_moe_layer
+
+        return ragged_ep_moe_layer(params, x, cfg, mesh,
+                                   use_pallas=use_pallas,
+                                   interpret=interpret,
+                                   token_axes=token_axes)
+    return ep_moe_layer(params, x, cfg, mesh, use_pallas=use_pallas,
+                        token_axes=token_axes, interpret=interpret)

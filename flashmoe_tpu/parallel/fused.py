@@ -866,6 +866,47 @@ def _fused_schedule(cap: int, h: int, i_dim: int, dt_size: int,
     return ("resident", bh) if resident else ("stream", None)
 
 
+def schedule_metadata(cfg: MoEConfig, d_world: int, *,
+                      fuse_combine: bool = False) -> dict:
+    """Resolved execution geometry of the fused kernel at (cfg, d_world)
+    — the schedule the kernel would actually run plus the VMEM
+    feasibility of every schedule, for consumers that price alternatives
+    (the analytical planner, :mod:`flashmoe_tpu.planner`) rather than
+    launch the kernel.
+
+    Returns ``{schedule, feasible: {batched, resident, stream}, cap, cm,
+    bi, n_row_tiles, n_i_chunks}``.  ``schedule`` honors the same tuning
+    entries / env knobs as the launch path; ``feasible`` reports only the
+    hard VMEM gates (a schedule can be feasible yet not chosen)."""
+    from flashmoe_tpu import tuning
+
+    s_loc = cfg.tokens // d_world
+    h, i_dim = cfg.hidden_size, cfg.intermediate_size
+    dt = jnp.dtype(cfg.dtype).itemsize
+    cap = -(-local_capacity(cfg, s_loc) // 32) * 32
+    cm, bi = _resolve_tiles(cap, h, i_dim, jnp.dtype(cfg.dtype).name,
+                            fuse_combine)
+    gated = cfg.gated_ffn
+    k = cfg.expert_top_k
+    tuned = tuning.lookup("fused_ep", h=h, i=i_dim,
+                          dtype=jnp.dtype(cfg.dtype).name)
+    schedule, _ = _fused_schedule(cap, h, i_dim, dt, gated, cm, bi,
+                                  fuse_combine, k, d_world, tuned)
+    batched_ok = d_world >= 2 and _resident_budget_ok(
+        cap, h, i_dim, dt, gated, cm, bi, fuse_combine, k,
+        hid_rows=(d_world - 1) * cap)[0]
+    resident_ok = cap // cm > 1 and _resident_budget_ok(
+        cap, h, i_dim, dt, gated, cm, bi, fuse_combine, k,
+        hid_rows=cap)[0]
+    return {
+        "schedule": schedule,
+        "feasible": {"batched": batched_ok, "resident": resident_ok,
+                     "stream": True},
+        "cap": cap, "cm": cm, "bi": bi,
+        "n_row_tiles": cap // cm, "n_i_chunks": i_dim // bi,
+    }
+
+
 def _fused_shard(send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
                  b_down, *,
                  cfg: MoEConfig, axis: str, interpret, collective_id: int,

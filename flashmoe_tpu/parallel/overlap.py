@@ -179,14 +179,16 @@ def overlap_bound(cfg: MoEConfig, d: int, gen: str = "v5e", *,
     Returns every intermediate so tests can assert the pieces, not just
     the ratio.
     """
-    from flashmoe_tpu.parallel.topology import _ICI_SPECS
+    from flashmoe_tpu.parallel.topology import _ICI_SPECS, chip_spec
 
     if schedule is None:
         from flashmoe_tpu.analysis import _geom
 
         schedule = _geom(cfg, d, fuse_combine=fuse_combine)["schedule"]
-    peak_tflops = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0,
-                   "v6e": 918.0}[gen]
+    # ValueError naming the supported generations for anything outside
+    # {v4, v5e, v5p, v6e} — the planner calls this with arbitrary gen
+    # strings, so it must fail cleanly (ADVICE round 5)
+    peak_tflops, _ = chip_spec(gen)
     bw_link = _ICI_SPECS[gen][1] * 1e9            # B/s one way per link
     dt = jnp.dtype(cfg.dtype).itemsize
     s_loc = cfg.tokens // d

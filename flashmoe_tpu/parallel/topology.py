@@ -39,6 +39,27 @@ _ICI_SPECS = {
 }
 _DCN_SPEC = (10.0, 25.0)  # (latency_us, GB/s) per host NIC, conservative
 
+# Per-chip compute / memory peaks (public spec sheets, bf16 matmul) —
+# the roofline ceilings the analytical planner prices against.  One
+# table for every consumer (overlap bound, bench MXU label, planner):
+# a generation added here becomes plannable everywhere at once.
+_PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+_HBM_GBPS = {"v4": 1228.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1638.0}
+
+
+def chip_spec(gen: str) -> tuple[float, float]:
+    """(peak bf16 TFLOP/s, HBM GB/s) for a TPU generation.
+
+    Raises ``ValueError`` naming the supported set for anything else —
+    the planner and overlap bound call this with arbitrary user strings,
+    and a bare ``KeyError`` carried no hint of what is accepted
+    (ADVICE round 5)."""
+    if gen not in _PEAK_TFLOPS:
+        raise ValueError(
+            f"unknown TPU generation {gen!r}; supported: "
+            f"{', '.join(sorted(_PEAK_TFLOPS))}")
+    return _PEAK_TFLOPS[gen], _HBM_GBPS[gen]
+
 
 def tpu_generation(device) -> str:
     """Map a device to a generation key for the spec tables.
@@ -176,7 +197,13 @@ def slice_structure(devices=None) -> tuple[int, int] | None:
     n = len(devices)
     mock = os.environ.get("FLASHMOE_MOCK_SLICES")
     if mock:
-        outer = int(mock)
+        try:
+            outer = int(mock)
+        except ValueError:
+            # malformed value = no mock blocking, matching the
+            # "irregular returns None" contract of the real detector
+            # (ADVICE round 5) — the flat transport stands
+            return None
         if outer > 1 and n % outer == 0:
             return outer, n // outer
         return None

@@ -1,0 +1,102 @@
+"""Planner CLI: print the explain-table for any config.
+
+Examples::
+
+    python -m flashmoe_tpu.planner                      # reference, d=8,
+                                                        # all generations
+    python -m flashmoe_tpu.planner --config mixtral --d 8 --gen v5p
+    python -m flashmoe_tpu.planner --slices 2           # ep spans 2 slices
+    python -m flashmoe_tpu.planner --json               # machine-readable
+    python -m flashmoe_tpu.planner --write-golden       # refresh the
+                                                        # CI-gated tables
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    from flashmoe_tpu.config import BENCH_CONFIGS, MoEConfig
+    from flashmoe_tpu.planner.golden import GOLDEN_GENS, write_golden
+    from flashmoe_tpu.planner.model import explain_table
+    from flashmoe_tpu.planner.select import select_path
+
+    ap = argparse.ArgumentParser(prog="python -m flashmoe_tpu.planner")
+    ap.add_argument("--config", default="reference",
+                    help="BENCH_CONFIGS name or path to a "
+                         "flashmoe_config.json")
+    ap.add_argument("--d", type=int, default=8,
+                    help="expert-parallel ranks (1 = single chip)")
+    ap.add_argument("--gen", action="append", default=None,
+                    choices=list(GOLDEN_GENS),
+                    help="TPU generation(s); default: all supported")
+    ap.add_argument("--slices", type=int, default=1,
+                    help="DCN-connected slices the ep axis spans")
+    ap.add_argument("--links", type=int, default=4,
+                    help="ICI links per chip serving the exchange")
+    ap.add_argument("--mxu", type=float, default=1.0,
+                    help="achieved fraction of peak matmul throughput "
+                         "(1.0 = roofline; pass a measured mxu_util "
+                         "for a calibrated prediction)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON document instead of tables")
+    ap.add_argument("--write-golden", action="store_true",
+                    help="regenerate the CI-gated golden tables")
+    args = ap.parse_args(argv)
+
+    if args.write_golden:
+        path = write_golden()
+        print(f"wrote {path}")
+        return 0
+
+    if args.config in BENCH_CONFIGS:
+        cfg = BENCH_CONFIGS[args.config]
+    else:
+        cfg = MoEConfig.from_json(args.config)
+    gens = args.gen or list(GOLDEN_GENS)
+
+    doc = {"config": args.config, "d": args.d, "slices": args.slices,
+           "gens": {}}
+    for gen in gens:
+        sel = select_path(cfg, args.d, gen, slices=args.slices,
+                          links=args.links, mxu_fraction=args.mxu,
+                          record=False)
+        preds = sel.predictions
+        if args.json:
+            doc["gens"][gen] = {
+                "winner": sel.winner, "backend": sel.backend,
+                "mode": sel.mode, "measured": sel.measured,
+                "paths": [
+                    {k: v for k, v in dataclasses.asdict(p).items()
+                     if k != "cost"}
+                    for p in preds
+                ],
+            }
+            continue
+        print(f"\n# {args.config}: E={cfg.num_experts} "
+              f"k={cfg.expert_top_k} H={cfg.hidden_size} "
+              f"I={cfg.intermediate_size} S={cfg.tokens} "
+              f"d={args.d} gen={gen} slices={args.slices} "
+              f"mxu={args.mxu:.2f}")
+        print(explain_table(preds))
+        if sel.mode == "measured":
+            print(f"winner: {sel.winner} (MEASURED "
+                  f"{sel.measured_ms:.3f} ms beats prediction; "
+                  f"predicted winner was {sel.predicted_winner}) -> "
+                  f"moe_backend={sel.backend!r}")
+        else:
+            print(f"predicted winner: {sel.winner} "
+                  f"({sel.predicted_ms:.3f} ms) -> "
+                  f"moe_backend={sel.backend!r}")
+    if args.json:
+        json.dump(doc, sys.stdout)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

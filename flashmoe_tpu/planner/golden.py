@@ -1,0 +1,67 @@
+"""CI-gated golden prediction tables.
+
+``golden.json`` (committed next to this module) freezes the planner's
+predicted per-path latencies and winners on the canonical configs at
+d=8 across every supported generation.  ``tests/test_planner.py``
+recomputes and compares: any change to the cost model, the kernels'
+schedule resolution, or the spec tables that moves a prediction by
+more than the tolerance — or flips a predicted winner — fails CI and
+must be re-approved by regenerating the table
+(``python -m flashmoe_tpu.planner --write-golden``) in the same PR, so
+the diff shows exactly which numbers moved.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from flashmoe_tpu.planner.model import predict_paths
+
+GOLDEN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "golden.json")
+GOLDEN_CONFIGS = ("reference", "mixtral", "deepseek")
+GOLDEN_GENS = ("v4", "v5e", "v5p", "v6e")
+GOLDEN_D = 8
+# relative tolerance of the CI gate: generous enough for float noise,
+# far below any modeling change worth reviewing
+GOLDEN_RTOL = 1e-3
+
+_TERMS = ("compute_ms", "hbm_ms", "ici_ms", "dcn_ms", "total_ms")
+
+
+def golden_snapshot() -> dict:
+    """Recompute the full golden structure from the live model."""
+    from flashmoe_tpu.config import BENCH_CONFIGS
+
+    out = {"d": GOLDEN_D, "configs": {}}
+    for name in GOLDEN_CONFIGS:
+        cfg = BENCH_CONFIGS[name]
+        gens = {}
+        for gen in GOLDEN_GENS:
+            preds = predict_paths(cfg, GOLDEN_D, gen)
+            winner = next(p for p in preds if p.feasible)
+            gens[gen] = {
+                "winner": winner.path,
+                "backend": winner.backend,
+                "paths": {
+                    p.path: dict(
+                        {t: round(getattr(p, t), 6) for t in _TERMS},
+                        feasible=p.feasible)
+                    for p in preds
+                },
+            }
+        out["configs"][name] = gens
+    return out
+
+
+def write_golden(path: str = GOLDEN_PATH) -> str:
+    with open(path, "w") as f:
+        json.dump(golden_snapshot(), f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def load_golden(path: str = GOLDEN_PATH) -> dict:
+    with open(path) as f:
+        return json.load(f)

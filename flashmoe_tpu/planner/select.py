@@ -1,0 +1,213 @@
+"""Path selection policy: predicted winner, measured winner when
+measurements exist.
+
+The policy (VERDICT r3 #4 "measured-winner", applied framework-wide):
+
+  1. :func:`flashmoe_tpu.planner.model.predict_paths` prices every
+     candidate path; the fastest *feasible* prediction is the
+     **predicted winner**.
+  2. If measured end-to-end latencies exist for this shape — committed
+     ``path_latency`` tuning entries
+     (:func:`flashmoe_tpu.tuning.measured_path_latencies`), a bench
+     records file (``FLASHMOE_BENCH_RECORDS`` pointing at bench.py
+     JSONL output), or an explicit ``measured=`` dict — the fastest
+     *measured* path overrides the prediction (**measured winner**).
+     Measurements only override for paths the predictor considers
+     runnable; a stale measurement of an infeasible path is ignored.
+  3. The decision and its full latency breakdown go through
+     :mod:`flashmoe_tpu.utils.telemetry` (``metrics.decision``), so a
+     postmortem can always answer "why did this run take this path".
+
+Measurements are keyed at path-family granularity ('fused', not
+'fused[batched]') because that is what a wall-clock measurement of the
+kernel observes — the kernel resolves its own schedule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+
+import jax.numpy as jnp
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.planner.model import PathPrediction, predict_paths
+from flashmoe_tpu.utils.telemetry import metrics
+
+
+@dataclasses.dataclass(frozen=True)
+class Selection:
+    """The planner's verdict for one (cfg, d, gen) point."""
+
+    winner: str                 # winning path (family name if measured)
+    backend: str                # moe_backend that runs it
+    mode: str                   # 'predicted' | 'measured'
+    predicted_winner: str       # what the model alone would pick
+    predicted_ms: float         # the winner's predicted latency
+    measured_ms: float | None   # the winner's measured latency (if any)
+    predictions: tuple[PathPrediction, ...]
+    measured: dict              # family -> measured ms consulted
+
+
+def _shape_key(cfg: MoEConfig, d: int) -> dict:
+    return dict(h=cfg.hidden_size, i=cfg.intermediate_size,
+                e=cfg.num_experts, k=cfg.expert_top_k, s=cfg.tokens,
+                d=d, dtype=jnp.dtype(cfg.dtype).name)
+
+
+def _bench_record_latencies(cfg: MoEConfig, d: int) -> dict:
+    """Measured path latencies mined from a bench.py JSONL records file
+    (``FLASHMOE_BENCH_RECORDS``).  A record matches when its metric
+    string carries this exact shape signature (dtype included) AND its
+    ``d`` field matches the queried rank count — a single-chip timing
+    must never override an 8-rank selection.  ``path``/``value`` (ms)
+    name the primary measurement; ``xla_path_ms`` contributes the xla
+    leg of the same record.  Unreadable files contribute nothing."""
+    path = os.environ.get("FLASHMOE_BENCH_RECORDS")
+    if not path or not os.path.exists(path):
+        return {}
+    sig = (f"E={cfg.num_experts},k={cfg.expert_top_k},"
+           f"H={cfg.hidden_size},I={cfg.intermediate_size},"
+           f"S={cfg.tokens},{jnp.dtype(cfg.dtype).name}")
+    out: dict[str, float] = {}
+
+    def keep(p, v):
+        if p and isinstance(v, (int, float)) and v > 0:
+            out[p] = min(float(v), out.get(p, float("inf")))
+
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if sig not in str(rec.get("metric", "")):
+                    continue
+                if int(rec.get("d", 1)) != d:
+                    continue
+                keep(rec.get("path"), rec.get("value"))
+                keep("xla", rec.get("xla_path_ms"))
+    except OSError:
+        return {}
+    return out
+
+
+def select_path(cfg: MoEConfig, d: int = 1, gen: str | None = None, *,
+                slices: int = 1, links: int = 4,
+                mxu_fraction: float = 1.0,
+                measured: dict | None = None,
+                record: bool = True) -> Selection:
+    """Pick the execution path for (cfg, d ranks, gen).
+
+    ``measured``: explicit {path_family: ms} overrides (highest
+    precedence); the tuning table and ``FLASHMOE_BENCH_RECORDS`` are
+    consulted automatically.  ``record=False`` suppresses the telemetry
+    decision record (pure queries, e.g. the CLI's golden writer).
+    """
+    from flashmoe_tpu import tuning
+
+    gen = gen or tuning.generation()
+    preds = predict_paths(cfg, d, gen, slices=slices, links=links,
+                          mxu_fraction=mxu_fraction)
+    feasible = [p for p in preds if p.feasible]
+    if not feasible:
+        raise ValueError(f"no feasible path at d={d} for this config")
+    pred_win = min(feasible, key=lambda p: p.total_ms)
+
+    meas: dict[str, float] = {}
+    meas.update(tuning.measured_path_latencies(gen, **_shape_key(cfg, d)))
+    meas.update(_bench_record_latencies(cfg, d))
+    if measured:
+        meas.update(measured)
+    runnable = {p.family for p in feasible}
+    usable = {f: ms for f, ms in meas.items() if f in runnable}
+
+    if usable:
+        win_family = min(usable, key=usable.get)
+        win_pred = min((p for p in feasible if p.family == win_family),
+                       key=lambda p: p.total_ms)
+        sel = Selection(
+            winner=win_family, backend=win_pred.backend, mode="measured",
+            predicted_winner=pred_win.path, predicted_ms=win_pred.total_ms,
+            measured_ms=usable[win_family], predictions=tuple(preds),
+            measured=dict(usable))
+    else:
+        sel = Selection(
+            winner=pred_win.path, backend=pred_win.backend,
+            mode="predicted", predicted_winner=pred_win.path,
+            predicted_ms=pred_win.total_ms, measured_ms=None,
+            predictions=tuple(preds), measured={})
+
+    if record:
+        metrics.decision(
+            "planner.path_select",
+            winner=sel.winner, backend=sel.backend, mode=sel.mode,
+            predicted_winner=sel.predicted_winner,
+            predicted_ms=round(sel.predicted_ms, 4),
+            measured_ms=(round(sel.measured_ms, 4)
+                         if sel.measured_ms is not None else None),
+            gen=gen, d=d, slices=slices,
+            config=_shape_key(cfg, d),
+            breakdown=[{
+                "path": p.path, "feasible": p.feasible,
+                "compute_ms": round(p.compute_ms, 4),
+                "hbm_ms": round(p.hbm_ms, 4),
+                "ici_ms": round(p.ici_ms, 4),
+                "dcn_ms": round(p.dcn_ms, 4),
+                "total_ms": round(p.total_ms, 4),
+            } for p in preds])
+    return sel
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_backend(cfg: MoEConfig, d: int, gen: str, slices: int) -> str:
+    # constraint filter first: combinations config.py rejects outright
+    # never reach the latency comparison
+    if cfg.tp > 1:
+        return "collective"
+    sel = select_path(cfg, d, gen, slices=slices)
+    backend = sel.backend
+    if backend == "ragged" and cfg.num_shared_experts:
+        # the ragged layer cannot host shared experts; the demotion is
+        # its own telemetry record so the path_select breakdown never
+        # silently disagrees with what actually ran
+        backend = "collective"
+        metrics.decision(
+            "planner.backend_constraint", winner=sel.winner,
+            requested="ragged", backend=backend,
+            reason="shared experts need the collective layer")
+    if backend == "local":
+        backend = "collective"
+    return backend
+
+
+def resolve_moe_backend(cfg: MoEConfig, mesh=None) -> str:
+    """The moe_backend an ``moe_backend='auto'`` config should run.
+
+    Non-auto configs pass through untouched.  Auto consults the planner
+    at this mesh's ep width, the trace-time generation pin
+    (:func:`flashmoe_tpu.tuning.generation` — never touches a possibly
+    wedged backend), and the detected slice structure.  Results are
+    cached per (cfg, d, gen, slices); the decision itself is recorded
+    in telemetry once per cache fill.
+    """
+    if cfg.moe_backend != "auto":
+        return cfg.moe_backend
+    from flashmoe_tpu import tuning
+
+    d = int(mesh.shape.get("ep", cfg.ep)) if mesh is not None else cfg.ep
+    if d <= 1:
+        return "collective"
+    slices = 1
+    try:
+        from flashmoe_tpu.parallel.topology import slice_structure
+
+        ss = slice_structure()
+        if ss and d % ss[0] == 0:
+            slices = ss[0]
+    except Exception:  # noqa: BLE001 — detection must never block trace
+        slices = 1
+    return _cached_backend(cfg, d, tuning.generation(), slices)

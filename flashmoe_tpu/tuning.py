@@ -82,6 +82,39 @@ def lookup(kernel: str, gen: str | None = None, **shape) -> dict:
     return best[1] if best else {}
 
 
+def measured_path_latencies(gen: str | None = None, **shape) -> dict:
+    """Measured end-to-end path latencies for ``shape`` (h=, i=, e=, k=,
+    s=, d=, dtype=...): ``{path_name: measured_ms}``.
+
+    Entries use ``kernel: "path_latency"`` with the path name inside the
+    ``match`` dict (so the generic most-specific-match machinery applies
+    per path) and the timing in ``measured_ms``::
+
+        {"kernel": "path_latency",
+         "match": {"path": "fused", "h": 2048, "i": 2048, "d": 8},
+         "measured_ms": 2.71}
+
+    The planner's measured-winner override
+    (:mod:`flashmoe_tpu.planner.select`) consults this: a committed
+    bench/tune_sweep measurement beats any prediction for the paths it
+    covers.  Unknown shapes return {} and the roofline prediction stands.
+    """
+    gen = gen or generation()
+    best: dict[str, tuple[int, float]] = {}
+    for ent in _load(gen):
+        if ent.get("kernel") != "path_latency":
+            continue
+        m = dict(ent.get("match", {}))
+        path = m.pop("path", None)
+        ms = ent.get("measured_ms", ent.get("set", {}).get("measured_ms"))
+        if path is None or ms is None:
+            continue
+        if all(shape.get(kk) == v for kk, v in m.items()):
+            if path not in best or len(m) > best[path][0]:
+                best[path] = (len(m), float(ms))
+    return {p: ms for p, (_, ms) in best.items()}
+
+
 def save_entries(gen: str, entries: list, path: str | None = None) -> str:
     """Write a measured table (used by scripts/tune_sweep.py).  Replaces
     existing entries for the same (kernel, match) keys, keeps others."""

@@ -51,18 +51,38 @@ def capture_trace(log_dir: str):
 
 
 class Metrics:
-    """Host-side metrics registry: counters, gauges, and wall timers."""
+    """Host-side metrics registry: counters, gauges, wall timers, and
+    structured decision records (planner path selections, schedule
+    choices — anything a postmortem needs the full breakdown of, not
+    just a scalar)."""
 
     def __init__(self):
         self.counters: dict[str, float] = defaultdict(float)
         self.gauges: dict[str, float] = {}
         self.times: dict[str, list[float]] = defaultdict(list)
+        self.decisions: list[dict] = []
 
     def count(self, name: str, inc: float = 1.0):
         self.counters[name] += inc
 
     def gauge(self, name: str, value: float):
         self.gauges[name] = float(value)
+
+    def decision(self, name: str, **fields) -> dict:
+        """Record a structured decision (e.g. the planner's path choice
+        with its full latency breakdown).  Kept as a list so repeated
+        decisions (one per layer/config) are all visible; ``summary()``
+        reports the count per decision name."""
+        rec = {"decision": name, **fields}
+        self.decisions.append(rec)
+        self.counters[f"decision.{name}"] += 1
+        return rec
+
+    def last_decision(self, name: str) -> dict | None:
+        for rec in reversed(self.decisions):
+            if rec["decision"] == name:
+                return rec
+        return None
 
     @contextlib.contextmanager
     def timer(self, name: str):
@@ -88,6 +108,13 @@ class Metrics:
         with open(path, "a") as f:
             f.write(json.dumps(rec) + "\n")
         return rec
+
+    def dump_decisions_jsonl(self, path: str) -> int:
+        """Append every recorded decision (full breakdowns) as JSONL."""
+        with open(path, "a") as f:
+            for rec in self.decisions:
+                f.write(json.dumps(rec) + "\n")
+        return len(self.decisions)
 
 
 metrics = Metrics()
