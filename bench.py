@@ -214,11 +214,55 @@ def _emit(cfg, name, t_fused, t_xla, note: str | None = None):
         rec.update(_planner_fields(cfg, t_fused, t_xla))
     except Exception as e:  # noqa: BLE001 — never lose the record
         rec["planner_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    # drift monitor: every bench measurement is a calibration point —
+    # the planner.drift decision (and its warning past the threshold)
+    # closes the predict -> measure -> correct loop (docs/OBSERVABILITY.md)
+    if rec.get("predicted_ms"):
+        try:
+            from flashmoe_tpu.planner.drift import record_drift
+
+            dr = record_drift(cfg, rec["path"], t_fused * 1e3,
+                              d=rec["d"], gen=rec.get("planner_gen"),
+                              predicted_ms=rec["predicted_ms"])
+            rec["drift_exceeded"] = dr.exceeded
+            if t_xla and rec.get("xla_predicted_ms"):
+                record_drift(cfg, "xla", t_xla * 1e3, d=rec["d"],
+                             gen=rec.get("planner_gen"),
+                             predicted_ms=rec["xla_predicted_ms"],
+                             warn=False)
+        except Exception as e:  # noqa: BLE001 — never lose the record
+            rec["drift_error"] = f"{type(e).__name__}: {str(e)[:120]}"
     if note:
         rec["partial"] = note
     print(json.dumps(rec), flush=True)
+    _flush_observability(rec)
     # consumed: a late SIGALRM must not re-emit this record as "partial"
     _PARTIAL.clear()
+
+
+# Observability artifact dir (--obs-dir / FLASHMOE_OBS_DIR): every
+# emitted record appends to bench_records.jsonl and new telemetry
+# decisions (planner.path_select, planner.drift) drain into
+# decisions.jsonl — both are inputs `python -m flashmoe_tpu.observe`
+# summarizes.  [dir, decisions-already-written] so sweep points never
+# duplicate decisions.
+_OBS: list = [None, 0]
+
+
+def _flush_observability(rec: dict):
+    if not _OBS[0]:
+        return
+    try:
+        from flashmoe_tpu.utils.telemetry import metrics
+
+        os.makedirs(_OBS[0], exist_ok=True)
+        with open(os.path.join(_OBS[0], "bench_records.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        _OBS[1] = metrics.dump_decisions_jsonl(
+            os.path.join(_OBS[0], "decisions.jsonl"), start=_OBS[1])
+    except Exception as e:  # noqa: BLE001 — artifacts are best-effort
+        print(f"# obs-dir write failed: {type(e).__name__}: "
+              f"{str(e)[:120]}", file=sys.stderr, flush=True)
 
 
 def _bench_overlap(ep: int, trials: int):
@@ -286,6 +330,7 @@ def _bench_overlap(ep: int, trials: int):
     except Exception as e:  # noqa: BLE001 — but record the breakage
         rec["bound_error"] = f"{type(e).__name__}: {str(e)[:120]}"
     print(json.dumps(rec), flush=True)
+    _flush_observability(rec)
 
 
 def _skew_metrics(cfg: MoEConfig, ep: int, m: dict) -> dict:
@@ -445,7 +490,13 @@ def main():
                     default=int(os.environ.get("FLASHMOE_PROBE_BUDGET", 300)),
                     help="how long to keep retrying the backend probe (s) "
                          "before giving up")
+    ap.add_argument("--obs-dir",
+                    default=os.environ.get("FLASHMOE_OBS_DIR"),
+                    help="directory for observability artifacts "
+                         "(bench_records.jsonl + decisions.jsonl, "
+                         "summarized by `python -m flashmoe_tpu.observe`)")
     args = ap.parse_args()
+    _OBS[0] = args.obs_dir
 
     def emit_error(msg, code=2):
         print(json.dumps({

@@ -11,6 +11,7 @@ __version__ = "0.1.0"
 
 from flashmoe_tpu.config import Activation, MoEConfig, BENCH_CONFIGS
 from flashmoe_tpu.ops.moe import moe_layer, MoEOutput
+from flashmoe_tpu.ops.stats import MoEStats
 from flashmoe_tpu.api import (
     get_bookkeeping,
     get_compiled_config,
@@ -24,6 +25,7 @@ __all__ = [
     "BENCH_CONFIGS",
     "moe_layer",
     "MoEOutput",
+    "MoEStats",
     "run_moe",
     "get_bookkeeping",
     "get_compiled_config",

@@ -124,6 +124,16 @@ class MoEConfig:
     # tuning-table / bench measurements cover the shape
     moe_backend: str = "collective"
 
+    # In-graph MoE observability (flashmoe_tpu/ops/stats.py): when True,
+    # every MoE layer additionally returns a MoEStats tuple (per-expert
+    # load histogram, dropped-token fraction, capacity utilization,
+    # imbalance factor, router entropy, top-k confidence) on
+    # MoEOutput.stats, and the transformer/trainer thread them into step
+    # metrics and the flight recorder.  Default False: the hot path is
+    # bit-identical to a stats-free build and the EP layers add no extra
+    # collectives (asserted by tests/test_observe.py).
+    collect_stats: bool = False
+
     # Inference-only: fuse the dispatch gather into the FFN kernel
     # (ops/expert.py:grouped_ffn_tokens — no [E, C, H] HBM buffer).
     # None = auto: follow the FLASHMOE_GATHER_FUSED env var, else stay on

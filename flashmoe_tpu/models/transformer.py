@@ -195,17 +195,20 @@ def _ffn(layer, x, cfg: MoEConfig, li: int, mesh, use_pallas):
                              token_axes=axes)
     else:
         o = moe_layer(layer["moe"], flat, layer_cfg, use_pallas=use_pallas)
-    return o.out.reshape(b, t, h).astype(x.dtype), o.aux_loss + o.z_loss
+    return (o.out.reshape(b, t, h).astype(x.dtype),
+            o.aux_loss + o.z_loss, o.stats)
 
 
 def block(layer, x, cfg: MoEConfig, li: int, mesh=None, use_pallas=None):
-    """One pre-norm transformer block. Returns (x, moe_losses)."""
+    """One pre-norm transformer block.  Returns (x, moe_losses,
+    moe_stats) — stats is the layer's MoEStats when ``cfg.collect_stats``
+    and this is an MoE layer, else None (an empty pytree leaf)."""
     a = attention(layer, rms_norm(x, layer["attn_norm"]), cfg, mesh=mesh,
                   use_pallas=use_pallas)
     x = x + a
-    f, moe_loss = _ffn(layer, rms_norm(x, layer["ffn_norm"]), cfg, li, mesh,
-                       use_pallas)
-    return x + f, moe_loss
+    f, moe_loss, moe_stats = _ffn(layer, rms_norm(x, layer["ffn_norm"]),
+                                  cfg, li, mesh, use_pallas)
+    return x + f, moe_loss, moe_stats
 
 
 # ----------------------------------------------------------------------
@@ -214,9 +217,12 @@ def block(layer, x, cfg: MoEConfig, li: int, mesh=None, use_pallas=None):
 
 def forward(params, tokens, cfg: MoEConfig, mesh=None, use_pallas=None):
     """tokens: [B, T] int32 -> logits [B, T, V]; also returns summed MoE
-    aux losses."""
+    aux losses.  With ``cfg.collect_stats`` a third element is returned:
+    a tuple of per-MoE-layer :class:`flashmoe_tpu.ops.stats.MoEStats`
+    (flag off keeps the two-tuple contract every existing caller uses)."""
     x = params["embed"].astype(cfg.dtype)[tokens]
     total_aux = jnp.zeros((), cfg.accum_dtype)
+    layer_stats = []
     # per-block remat keeps HBM bounded; excluded exactly for the blocks
     # where the fused RDMA backend actually runs (same condition as _ffn's
     # fused branch — its kernel's side effects cannot be partially
@@ -233,13 +239,17 @@ def forward(params, tokens, cfg: MoEConfig, mesh=None, use_pallas=None):
     for li, layer in enumerate(params["layers"]):
         fused_block = fused_active and li in moe_layers
         blk = blk_remat if (cfg.is_training and not fused_block) else block
-        x, moe_loss = blk(layer, x, cfg, li, mesh, use_pallas)
+        x, moe_loss, moe_stats = blk(layer, x, cfg, li, mesh, use_pallas)
         total_aux = total_aux + moe_loss
+        if moe_stats is not None:
+            layer_stats.append(moe_stats)
     x = rms_norm(x, params["final_norm"])
     logits = jnp.dot(
         x.astype(cfg.dtype), params["lm_head"].astype(cfg.dtype),
         preferred_element_type=jnp.float32,
     )
+    if cfg.collect_stats:
+        return logits, total_aux, tuple(layer_stats)
     return logits, total_aux
 
 
@@ -251,12 +261,21 @@ def loss_fn(params, batch, cfg: MoEConfig, mesh=None, use_pallas=None):
     """
     tokens = batch["tokens"]
     inp, tgt = tokens[:, :-1], tokens[:, 1:]
-    logits, aux = forward(params, inp, cfg, mesh, use_pallas)
+    if cfg.collect_stats:
+        logits, aux, stats = forward(params, inp, cfg, mesh, use_pallas)
+    else:
+        logits, aux = forward(params, inp, cfg, mesh, use_pallas)
+        stats = ()
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
     mask = batch.get("mask", jnp.ones_like(tgt, jnp.float32))
     ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    return ce + aux, {"ce": ce, "aux": aux}
+    metrics = {"ce": ce, "aux": aux}
+    if cfg.collect_stats:
+        # per-MoE-layer MoEStats, consumed by the trainer's flight
+        # recorder; stays a pytree of arrays so it flows through jit
+        metrics["moe_stats"] = stats
+    return ce + aux, metrics
 
 
 def sgd_train_step(params, batch, cfg: MoEConfig, lr=1e-3, mesh=None,

@@ -1,0 +1,227 @@
+"""Flight-recorder analysis CLI: turn JSONL telemetry dumps into the
+reports a perf postmortem starts from.
+
+Input: any mix of JSONL files produced by this framework —
+
+  * flight-recorder exports (``runtime.trainer.train(flight_path=...)``,
+    records with ``step`` + optional per-layer ``moe`` stats),
+  * telemetry decision logs (``Metrics.dump_decisions_jsonl`` — planner
+    path selections and ``planner.drift`` comparisons),
+  * bench.py output lines (``metric``/``value`` records with
+    ``predicted_ms``/``prediction_error`` calibration fields),
+  * metrics summaries (``Metrics.dump_jsonl`` — phase timers).
+
+Output: an expert-load imbalance report (per-expert histogram), the
+drop-rate timeline, a phase-time breakdown, and the planner drift report
+(:func:`flashmoe_tpu.planner.drift.drift_report`).  ``--json`` emits one
+machine-readable document instead of text.
+
+Usage::
+
+    python -m flashmoe_tpu.observe flight.jsonl [decisions.jsonl ...]
+    python -m flashmoe_tpu.observe --json flight.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_jsonl(paths: list[str]) -> list[dict]:
+    """All parseable JSON objects from the given files, in order.
+    Unparseable lines (partial writes, comments) are skipped."""
+    records: list[dict] = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+    return records
+
+
+def _layer_stats(rec: dict) -> list[dict]:
+    """Per-layer MoE stat dicts of one flight record (either the
+    trainer's ``moe`` list or a bare top-level stats record)."""
+    if isinstance(rec.get("moe"), list):
+        return [m for m in rec["moe"] if isinstance(m, dict)]
+    if isinstance(rec.get("expert_load"), list):
+        return [rec]
+    return []
+
+
+def imbalance_report(flight: list[dict]) -> dict:
+    """Aggregate expert-load histogram across steps and layers."""
+    load: list[float] = []
+    imb = []
+    ent = []
+    for rec in flight:
+        for m in _layer_stats(rec):
+            el = m.get("expert_load") or []
+            if len(load) < len(el):
+                load.extend([0.0] * (len(el) - len(load)))
+            for i, v in enumerate(el):
+                load[i] += float(v)
+            if "imbalance" in m:
+                imb.append(float(m["imbalance"]))
+            if "router_entropy" in m:
+                ent.append(float(m["router_entropy"]))
+    total = sum(load)
+    mean = total / len(load) if load else 0.0
+    return {
+        "experts": len(load),
+        "expert_load": [round(v, 1) for v in load],
+        "total_assignments": round(total, 1),
+        "imbalance": round(max(load) / mean, 4) if mean > 0 else None,
+        "mean_step_imbalance": round(sum(imb) / len(imb), 4) if imb
+        else None,
+        "mean_router_entropy": round(sum(ent) / len(ent), 4) if ent
+        else None,
+    }
+
+
+def drop_report(flight: list[dict]) -> dict:
+    """Drop-rate / capacity-utilization timeline and aggregates."""
+    timeline = []
+    for rec in flight:
+        stats = _layer_stats(rec)
+        drops = [float(m["dropped_fraction"]) for m in stats
+                 if "dropped_fraction" in m]
+        utils = [float(m["capacity_utilization"]) for m in stats
+                 if "capacity_utilization" in m]
+        if drops:
+            timeline.append({
+                "step": rec.get("step"),
+                "dropped_fraction": round(sum(drops) / len(drops), 6),
+                "capacity_utilization": round(sum(utils) / len(utils), 6)
+                if utils else None,
+            })
+    dr = [t["dropped_fraction"] for t in timeline]
+    return {
+        "steps": len(timeline),
+        "mean_dropped_fraction": round(sum(dr) / len(dr), 6) if dr
+        else None,
+        "max_dropped_fraction": round(max(dr), 6) if dr else None,
+        "timeline": timeline,
+    }
+
+
+def phase_report(records: list[dict]) -> dict:
+    """Mean of every ``*_ms`` field across records (flight ``step_ms``,
+    bench leg timings) plus ``*_ms_p50`` phase timers from metrics
+    summaries — the comm/compute phase breakdown."""
+    sums: dict[str, float] = {}
+    counts: dict[str, int] = {}
+    # prediction fields are drift inputs, not phases — keep them out
+    skip = {"predicted_ms", "xla_predicted_ms", "measured_ms"}
+    for rec in records:
+        for k, v in rec.items():
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            if k in skip:
+                continue
+            if k.endswith("_ms") or k.endswith("_ms_p50"):
+                sums[k] = sums.get(k, 0.0) + float(v)
+                counts[k] = counts.get(k, 0) + 1
+    return {k: round(sums[k] / counts[k], 4) for k in sorted(sums)}
+
+
+def summarize(records: list[dict]) -> dict:
+    """The full analysis document over a mixed record pile."""
+    from flashmoe_tpu.planner.drift import drift_report
+
+    flight = [r for r in records if _layer_stats(r) or "step" in r]
+    return {
+        "records": len(records),
+        "flight_steps": len(flight),
+        "imbalance": imbalance_report(flight),
+        "drops": drop_report(flight),
+        "phases": phase_report(records),
+        "drift": drift_report(records),
+        "decisions": sorted({r["decision"] for r in records
+                             if isinstance(r.get("decision"), str)}),
+    }
+
+
+def _bar(value: float, peak: float, width: int = 40) -> str:
+    n = int(round(width * value / peak)) if peak > 0 else 0
+    return "#" * max(n, 1 if value > 0 else 0)
+
+
+def render_text(s: dict) -> str:
+    lines = [f"records: {s['records']}  flight steps: {s['flight_steps']}"]
+    imb = s["imbalance"]
+    if imb["experts"]:
+        lines.append("")
+        lines.append(f"expert load histogram ({imb['experts']} experts, "
+                     f"{imb['total_assignments']:g} assignments, "
+                     f"imbalance max/mean = {imb['imbalance']}):")
+        peak = max(imb["expert_load"])
+        for i, v in enumerate(imb["expert_load"]):
+            lines.append(f"  e{i:<3d} {v:>10.1f} {_bar(v, peak)}")
+        if imb["mean_router_entropy"] is not None:
+            lines.append(f"  mean router entropy: "
+                         f"{imb['mean_router_entropy']} nats")
+    drops = s["drops"]
+    if drops["steps"]:
+        lines.append("")
+        lines.append(f"drop rate: mean {drops['mean_dropped_fraction']} "
+                     f"max {drops['max_dropped_fraction']} over "
+                     f"{drops['steps']} steps")
+        for t in drops["timeline"][-10:]:
+            lines.append(f"  step {t['step']}: dropped "
+                         f"{t['dropped_fraction']}  capacity util "
+                         f"{t['capacity_utilization']}")
+    if s["phases"]:
+        lines.append("")
+        lines.append("phase times (mean):")
+        for k, v in s["phases"].items():
+            lines.append(f"  {k:<32s} {v:>10.3f}")
+    drift = s["drift"]
+    if drift["n"]:
+        lines.append("")
+        lines.append(f"planner drift: {drift['n']} comparisons, "
+                     f"{drift['exceeded']} over threshold")
+        for key, b in drift["by_path"].items():
+            lines.append(
+                f"  {key:<24s} n={b['n']} mean|rel|="
+                f"{b['mean_abs_rel_error']} worst={b['worst_rel_error']}"
+                f"{'  ** DRIFTING' if b['exceeded'] else ''}")
+    if s["decisions"]:
+        lines.append("")
+        lines.append("decision records: " + ", ".join(s["decisions"]))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m flashmoe_tpu.observe",
+        description="Summarize flight-recorder / telemetry JSONL dumps")
+    ap.add_argument("files", nargs="+", help="JSONL files to analyze")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON document")
+    args = ap.parse_args(argv)
+
+    records = load_jsonl(args.files)
+    if not records:
+        print("no parseable records found", file=sys.stderr)
+        return 2
+    s = summarize(records)
+    if args.json:
+        json.dump(s, sys.stdout)
+        print()
+    else:
+        print(render_text(s))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -340,12 +340,15 @@ def router_pallas_tiled(x, gate_w, cfg: MoEConfig, interpret: bool = False,
 
     ``need_stats=None`` resolves OUTSIDE the jitted core (env vars read
     inside a jit bind at trace time and then stick in the cache):
-    training / z-loss configs and ``FLASHMOE_GATE_STATS=1`` get the
-    stats pass; plain inference skips it (aux fields report zero)."""
+    training / z-loss configs, ``cfg.collect_stats`` (the flight
+    recorder's router-entropy signal wants real probability sums), and
+    ``FLASHMOE_GATE_STATS=1`` get the stats pass; plain inference skips
+    it (aux fields report zero)."""
     if need_stats is None:
         import os as _os
 
         need_stats = (cfg.is_training or cfg.router_z_loss_coef > 0
+                      or cfg.collect_stats
                       or _os.environ.get("FLASHMOE_GATE_STATS") == "1")
     return _router_pallas_tiled_jit(x, gate_w, cfg, interpret,
                                     bool(need_stats))

@@ -19,7 +19,7 @@ all-to-all.
 from __future__ import annotations
 
 import os
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +48,10 @@ class MoEOutput(NamedTuple):
     aux_loss: jnp.ndarray
     z_loss: jnp.ndarray
     expert_counts: jnp.ndarray  # [E]
+    # MoEStats (ops/stats.py) when cfg.collect_stats, else None — a None
+    # leaf is an empty pytree node, so the default changes no existing
+    # sharding spec or custom-VJP structure
+    stats: Any = None
 
 
 def dense_ffn(params, x, cfg: MoEConfig):
@@ -73,7 +77,18 @@ def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
     r = router(x, params["gate_w"], cfg, use_pallas=use_pallas,
                interpret=interpret)
     s, h = x.shape
-    if use_pallas and not cfg.drop_tokens and capacity is None:
+    dropless = use_pallas and not cfg.drop_tokens and capacity is None
+    stats = None
+    if cfg.collect_stats:
+        # in-graph routing health (ops/stats.py): pure function of the
+        # router outputs + the same capacity constant the dispatch clamps
+        # against, so the layer's numerics cannot shift
+        from flashmoe_tpu.ops.stats import moe_stats
+
+        stats_cap = None if dropless else (
+            capacity if capacity is not None else cfg.capacity_for(s))
+        stats = moe_stats(r, cfg, stats_cap)
+    if dropless:
         # dropless: ragged expert-sorted grouping + block-sparse grouped FFN
         # (S*K + E*block rows instead of the capacity path's E*S)
         bm = BLOCK_M if s >= BLOCK_M else max(8, ((s + 7) // 8) * 8)
@@ -126,6 +141,7 @@ def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
         r.aux_loss * cfg.aux_loss_coef,
         r.z_loss,
         r.expert_counts,
+        stats,
     )
 
 

@@ -1,0 +1,166 @@
+"""In-graph MoE routing statistics — the flight recorder's data plane.
+
+The reference reads ``%globaltimer`` inside its kernels and wraps every
+host phase in NVTX ranges (``csrc/include/flashmoe/telemetry.cuh``)
+because distributed-MoE performance lives or dies on runtime state that
+is invisible from outside: expert load imbalance, dropped tokens, and
+capacity waste.  This module computes those quantities *inside the
+compiled graph*, from values the layers already materialize (router
+counts, combine weights, the capacity constant) — no extra HBM traffic
+beyond a few scalar reductions, jit- and vmap-safe, and entirely absent
+from the graph unless ``MoEConfig.collect_stats`` is set.
+
+Consumers: ``ops/moe.py`` / ``parallel/ep.py`` / ``parallel/fused.py`` /
+``parallel/ragged_ep.py`` attach a :class:`MoEStats` to their
+``MoEOutput``; ``models/transformer.py`` threads per-layer stats into
+the loss metrics; ``runtime/trainer.py`` lands them in the flight
+recorder (:mod:`flashmoe_tpu.utils.telemetry`), which
+``python -m flashmoe_tpu.observe`` summarizes offline.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from flashmoe_tpu.config import MoEConfig
+
+
+class MoEStats(NamedTuple):
+    """One MoE layer's routing health, all float32 so every field psums /
+    pmeans uniformly across expert-parallel ranks.
+
+    expert_load:          [E] pre-drop (token, k) selections per expert.
+    dropped_fraction:     [] fraction of routed assignments dropped at
+                          the capacity clamp (0 on dropless paths).
+    capacity_utilization: [] kept rows / (E * capacity) buffer slots
+                          (1.0 on dropless paths — no fixed buffer).
+    imbalance:            [] max over experts / mean — 1.0 is perfectly
+                          balanced, E is total collapse onto one expert.
+    router_entropy:       [] entropy (nats) of the router's expert
+                          distribution: the mean softmax probabilities
+                          when the gate reports them, else the empirical
+                          selection distribution.  ln(E) = uniform.
+    topk_confidence:      [] mean normalized weight of each token's
+                          top-1 expert (1.0 = the top expert takes all).
+    """
+
+    expert_load: jnp.ndarray
+    dropped_fraction: jnp.ndarray
+    capacity_utilization: jnp.ndarray
+    imbalance: jnp.ndarray
+    router_entropy: jnp.ndarray
+    topk_confidence: jnp.ndarray
+
+
+def load_imbalance(expert_load) -> jnp.ndarray:
+    """max/mean load factor of an [E] load vector (f32 scalar)."""
+    load = expert_load.astype(jnp.float32)
+    mean = jnp.mean(load, axis=-1)
+    return jnp.max(load, axis=-1) / jnp.maximum(mean, 1e-9)
+
+
+def dist_entropy(weights) -> jnp.ndarray:
+    """Entropy (nats) of an unnormalized nonnegative [E] weight vector."""
+    w = weights.astype(jnp.float32)
+    p = w / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-9)
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.maximum(p, 1e-30)),
+                              0.0), axis=-1)
+
+
+def router_entropy(probs_mean, expert_load) -> jnp.ndarray:
+    """Entropy of the router's expert distribution.
+
+    Prefers the gate's mean softmax probabilities; the inference-mode
+    tiled gate reports ``probs_mean`` as zeros when its stats pass is
+    skipped, in which case the empirical selection distribution stands
+    in (a ``where``-select so the choice stays jit-safe)."""
+    have_probs = jnp.sum(probs_mean.astype(jnp.float32), axis=-1) > 0
+    return jnp.where(have_probs, dist_entropy(probs_mean),
+                     dist_entropy(expert_load))
+
+
+def drop_stats(expert_load, cfg: MoEConfig, capacity: int | None
+               ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(dropped_fraction, capacity_utilization) of an [E] load vector
+    against a per-expert ``capacity``; ``None`` = dropless path."""
+    load = expert_load.astype(jnp.float32)
+    total = jnp.maximum(jnp.sum(load, axis=-1), 1.0)
+    if capacity is None:
+        zero = jnp.zeros(total.shape, jnp.float32)
+        return zero, jnp.ones(total.shape, jnp.float32)
+    kept = jnp.sum(jnp.minimum(load, jnp.float32(capacity)), axis=-1)
+    dropped = 1.0 - kept / total
+    util = kept / jnp.float32(cfg.num_experts * capacity)
+    return dropped, util
+
+
+def moe_stats(router_out, cfg: MoEConfig, capacity: int | None
+              ) -> MoEStats:
+    """Stats for one token shard from its RouterOutput.
+
+    ``capacity`` is the per-expert buffer size the dispatch will clamp
+    against (the same constant :func:`flashmoe_tpu.ops.dispatch.make_plan`
+    receives), or ``None`` on dropless paths.  Every output is a pure
+    function of the router's existing outputs, so attaching stats can
+    never perturb the layer's numerics.
+    """
+    load = router_out.expert_counts.astype(jnp.float32)
+    dropped, util = drop_stats(load, cfg, capacity)
+    # combine weights are sorted by the top-k extraction: slot 0 is each
+    # token's strongest expert, pre-normalized over the k survivors
+    conf = jnp.mean(router_out.combine_weights[..., 0].astype(jnp.float32),
+                    axis=-1)
+    return MoEStats(
+        expert_load=load,
+        dropped_fraction=dropped,
+        capacity_utilization=util,
+        imbalance=load_imbalance(load),
+        router_entropy=router_entropy(router_out.probs_mean, load),
+        topk_confidence=conf,
+    )
+
+
+def reduce_stats(local: MoEStats, probs_mean, reduce_axes) -> MoEStats:
+    """Cross-rank reduction of per-shard stats inside a shard_map body.
+
+    The load histogram psums; ratio scalars pmean (every rank holds the
+    same token count, so the mean of per-shard fractions is the exact
+    global fraction); imbalance and entropy are recomputed from the
+    GLOBAL load so a skew concentrated on one rank is never averaged
+    away.  Only called when ``cfg.collect_stats`` — the stats-off graph
+    contains none of these collectives."""
+    import jax
+
+    g_load = jax.lax.psum(local.expert_load, reduce_axes)
+    g_probs = jax.lax.pmean(probs_mean.astype(jnp.float32), reduce_axes)
+    return MoEStats(
+        expert_load=g_load,
+        dropped_fraction=jax.lax.pmean(local.dropped_fraction, reduce_axes),
+        capacity_utilization=jax.lax.pmean(local.capacity_utilization,
+                                           reduce_axes),
+        imbalance=load_imbalance(g_load),
+        router_entropy=router_entropy(g_probs, g_load),
+        topk_confidence=jax.lax.pmean(local.topk_confidence, reduce_axes),
+    )
+
+
+def stats_to_host(stats: MoEStats) -> dict:
+    """One flight-recorder-ready dict (python floats/lists) per layer."""
+    import jax
+    import numpy as np
+
+    # ONE bulk device->host transfer for the whole tuple — per-field
+    # float() calls would each block on their own copy, inflating the
+    # very step time the flight recorder is measuring
+    host = jax.device_get(stats)
+    return {
+        "expert_load": np.asarray(host.expert_load,
+                                  dtype=np.float64).tolist(),
+        "dropped_fraction": float(host.dropped_fraction),
+        "capacity_utilization": float(host.capacity_utilization),
+        "imbalance": float(host.imbalance),
+        "router_entropy": float(host.router_entropy),
+        "topk_confidence": float(host.topk_confidence),
+    }

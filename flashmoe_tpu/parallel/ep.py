@@ -35,11 +35,14 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.utils.compat import axis_size, shard_map
 from flashmoe_tpu.models.reference import shared_expert_ffn
 from flashmoe_tpu.ops import dispatch as dsp
 from flashmoe_tpu.ops import expert as exp
+from flashmoe_tpu.ops import stats as st
 from flashmoe_tpu.ops.gate import router
 from flashmoe_tpu.ops.moe import MoEOutput, dense_ffn
+from flashmoe_tpu.utils.telemetry import trace_span
 
 
 def local_capacity(cfg: MoEConfig, s_local: int) -> int:
@@ -99,66 +102,82 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
     efficiency measurement (:mod:`flashmoe_tpu.parallel.overlap`); the
     result is numerically meaningless (tokens meet the wrong experts).
     """
-    d = jax.lax.axis_size(axis)
+    d = axis_size(axis)
     s_loc, h = x.shape
     e, nlx = cfg.num_experts, cfg.num_experts // d
     cap = local_capacity(cfg, s_loc)
 
-    r = router(x, params["gate_w"], cfg, use_pallas=use_pallas,
-               interpret=interpret)
-    plan = dsp.make_plan(r.expert_idx, cfg, cap)
-    xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)  # [E, C, H]
+    # phase spans mirror the reference's NVTX "Flashmoe" domain
+    # (telemetry.cuh): named HLO scopes so xprof traces show gate /
+    # dispatch / a2a / expert / combine as distinct phases.  Pure
+    # metadata — no ops added, the stats-off graph is unchanged.
+    with trace_span("moe.gate"):
+        r = router(x, params["gate_w"], cfg, use_pallas=use_pallas,
+                   interpret=interpret)
+    with trace_span("moe.dispatch"):
+        plan = dsp.make_plan(r.expert_idx, cfg, cap)
+        xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)  # [E, C, H]
 
     # exchange expert-major slabs: [E, C, H] -> [D, nLx, C, H] received
-    if skip_exchange:
-        recv = xbuf.reshape(d, nlx, cap, h)
-    elif dcn_inner is not None and 1 < dcn_inner < d:
-        recv = _hierarchical_a2a(
-            xbuf.reshape(d, nlx, cap, h), axis, d, dcn_inner, reverse=False,
-        )
-    else:
-        recv = jax.lax.all_to_all(
-            xbuf.reshape(d, nlx, cap, h), axis, split_axis=0, concat_axis=0,
-            tiled=False,
-        )  # [D, nLx, C, H] — dim 0 now indexes source rank
+    with trace_span("moe.a2a_dispatch"):
+        if skip_exchange:
+            recv = xbuf.reshape(d, nlx, cap, h)
+        elif dcn_inner is not None and 1 < dcn_inner < d:
+            recv = _hierarchical_a2a(
+                xbuf.reshape(d, nlx, cap, h), axis, d, dcn_inner,
+                reverse=False,
+            )
+        else:
+            recv = jax.lax.all_to_all(
+                xbuf.reshape(d, nlx, cap, h), axis, split_axis=0,
+                concat_axis=0, tiled=False,
+            )  # [D, nLx, C, H] — dim 0 now indexes source rank
     ybuf_in = recv.transpose(1, 0, 2, 3).reshape(nlx, d * cap, h)
 
     ffn_params = params
     if tp_axis is not None:
         # row-parallel down bias: each tp rank contributes 1/tp of it so
         # the psum reconstructs it exactly once
-        tp = jax.lax.axis_size(tp_axis)
+        tp = axis_size(tp_axis)
         ffn_params = dict(params, b_down=params["b_down"] / tp)
-    if use_pallas:
-        yloc = exp.capacity_buffer_ffn_ad(ybuf_in, ffn_params, cfg,
-                                          interpret)
-    else:
-        yloc = exp.expert_ffn_dense(ybuf_in, ffn_params, cfg)
-    if tp_axis is not None:
-        yloc = jax.lax.psum(yloc, tp_axis)
+    with trace_span("moe.expert"):
+        if use_pallas:
+            yloc = exp.capacity_buffer_ffn_ad(ybuf_in, ffn_params, cfg,
+                                              interpret)
+        else:
+            yloc = exp.expert_ffn_dense(ybuf_in, ffn_params, cfg)
+        if tp_axis is not None:
+            yloc = jax.lax.psum(yloc, tp_axis)
 
     # reverse: [nLx, D*C, H] -> [D, nLx, C, H] -> all_to_all -> [E, C, H]
-    ysend = yloc.reshape(nlx, d, cap, h).transpose(1, 0, 2, 3)
-    if skip_exchange:
-        yback = ysend
-    elif dcn_inner is not None and 1 < dcn_inner < d:
-        yback = _hierarchical_a2a(ysend, axis, d, dcn_inner, reverse=True)
-    else:
-        yback = jax.lax.all_to_all(
-            ysend, axis, split_axis=0, concat_axis=0, tiled=False
-        )  # [D, nLx, C, H] — dim 0 indexes expert-owner rank
+    with trace_span("moe.a2a_combine"):
+        ysend = yloc.reshape(nlx, d, cap, h).transpose(1, 0, 2, 3)
+        if skip_exchange:
+            yback = ysend
+        elif dcn_inner is not None and 1 < dcn_inner < d:
+            yback = _hierarchical_a2a(ysend, axis, d, dcn_inner,
+                                      reverse=True)
+        else:
+            yback = jax.lax.all_to_all(
+                ysend, axis, split_axis=0, concat_axis=0, tiled=False
+            )  # [D, nLx, C, H] — dim 0 indexes expert-owner rank
     ybuf = yback.reshape(e, cap, h)
 
-    out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)
-    if cfg.num_shared_experts:
-        out = out + shared_expert_ffn(
-            x.astype(cfg.dtype), params, cfg
-        ).astype(out.dtype)
+    with trace_span("moe.combine"):
+        out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)
+        if cfg.num_shared_experts:
+            out = out + shared_expert_ffn(
+                x.astype(cfg.dtype), params, cfg
+            ).astype(out.dtype)
 
     aux = jax.lax.pmean(r.aux_loss, reduce_axes) * cfg.aux_loss_coef
     z = jax.lax.pmean(r.z_loss, reduce_axes)
     counts = jax.lax.psum(r.expert_counts, reduce_axes)
-    return MoEOutput(out.astype(cfg.dtype), aux, z, counts)
+    stats = None
+    if cfg.collect_stats:
+        local = st.moe_stats(r, cfg, cap)
+        stats = st.reduce_stats(local, r.probs_mean, reduce_axes)
+    return MoEOutput(out.astype(cfg.dtype), aux, z, counts, stats)
 
 
 def ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
@@ -221,10 +240,13 @@ def ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
         dcn_inner=dcn_inner, interpret=interpret,
         skip_exchange=skip_exchange,
     )
-    fn = jax.shard_map(
+    stats_specs = (st.MoEStats(*([P()] * len(st.MoEStats._fields)))
+                   if cfg.collect_stats else None)
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(pspecs, P(token_axes, None)),
-        out_specs=MoEOutput(P(token_axes, None), P(), P(), P()),
+        out_specs=MoEOutput(P(token_axes, None), P(), P(), P(),
+                            stats_specs),
         check_vma=False,
     )
     return fn(params, x)

@@ -93,11 +93,14 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.utils.compat import axis_size, shard_map
 from flashmoe_tpu.models.reference import activation_fn, shared_expert_ffn
 from flashmoe_tpu.ops import dispatch as dsp
+from flashmoe_tpu.ops import stats as st
 from flashmoe_tpu.ops.gate import router
 from flashmoe_tpu.ops.moe import MoEOutput
 from flashmoe_tpu.parallel.ep import local_capacity
+from flashmoe_tpu.utils.telemetry import trace_span
 
 
 def _fused_kernel(
@@ -1416,7 +1419,7 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
         src_order = jnp.asarray(src_order, jnp.int32)
 
     def body(params, x, src_order):
-        d = jax.lax.axis_size("ep")
+        d = axis_size("ep")
         s_loc, h = x.shape
         nlx = cfg.num_experts // d
         cap = local_capacity(cfg, s_loc)
@@ -1430,13 +1433,17 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
             if use_pallas_gate is not None
             else (interpret or jax.default_backend() == "tpu")
         )
-        r = router(x, params["gate_w"], cfg, use_pallas=use_gate_pallas,
-                   interpret=interpret)
-        plan = dsp.make_plan(r.expert_idx, cfg, cap)
-        xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)
-        if cap_pad != cap:
-            xbuf = jnp.pad(xbuf, ((0, 0), (0, cap_pad - cap), (0, 0)))
-        x_send = xbuf.reshape(d, nlx, cap_pad, h)
+        # phase spans (telemetry.trace_span): the xprof counterpart of the
+        # reference's NVTX "Flashmoe" domain — metadata only, no ops
+        with trace_span("moe.gate"):
+            r = router(x, params["gate_w"], cfg, use_pallas=use_gate_pallas,
+                       interpret=interpret)
+        with trace_span("moe.dispatch"):
+            plan = dsp.make_plan(r.expert_idx, cfg, cap)
+            xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)
+            if cap_pad != cap:
+                xbuf = jnp.pad(xbuf, ((0, 0), (0, cap_pad - cap), (0, 0)))
+            x_send = xbuf.reshape(d, nlx, cap_pad, h)
 
         # routed-count matrices: what I send each (dest, expert) and what
         # each source sends my experts — shared knowledge on both ends, so
@@ -1472,18 +1479,22 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
             recv_pos = jax.lax.all_to_all(
                 ret_pos, "ep", split_axis=0, concat_axis=0, tiled=False,
             )
-            out = _fused_combine_core(
-                send_cnt, recv_cnt, src_order, ret_pos, recv_pos,
-                w_sorted[:, None], x_send, *w_args,
-                cfg, "ep", interpret, collective_id, detect_races, cu,
-            )[:s_loc]
+            with trace_span("moe.fused_kernel"):
+                out = _fused_combine_core(
+                    send_cnt, recv_cnt, src_order, ret_pos, recv_pos,
+                    w_sorted[:, None], x_send, *w_args,
+                    cfg, "ep", interpret, collective_id, detect_races, cu,
+                )[:s_loc]
         else:
-            y_recv = _fused_core(
-                send_cnt, recv_cnt, src_order, x_send, *w_args,
-                cfg, "ep", interpret, collective_id, detect_races,
-            )
-            ybuf = y_recv.reshape(cfg.num_experts, cap_pad, h)
-            out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap_pad)
+            with trace_span("moe.fused_kernel"):
+                y_recv = _fused_core(
+                    send_cnt, recv_cnt, src_order, x_send, *w_args,
+                    cfg, "ep", interpret, collective_id, detect_races,
+                )
+            with trace_span("moe.combine"):
+                ybuf = y_recv.reshape(cfg.num_experts, cap_pad, h)
+                out = dsp.combine(ybuf, plan, r.combine_weights, cfg,
+                                  cap_pad)
         if cfg.num_shared_experts:
             out = out + shared_expert_ffn(
                 x.astype(cfg.dtype), params, cfg
@@ -1492,14 +1503,24 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
         aux = jax.lax.pmean(r.aux_loss, token_axes) * cfg.aux_loss_coef
         z = jax.lax.pmean(r.z_loss, token_axes)
         counts = jax.lax.psum(r.expert_counts, token_axes)
-        return MoEOutput(out.astype(cfg.dtype), aux, z, counts)
+        stats = None
+        if cfg.collect_stats:
+            # the fused kernel drops at the same capacity clamp (send_cnt
+            # = min(counts, cap)), so the collective layer's stats math
+            # applies verbatim
+            local = st.moe_stats(r, cfg, cap)
+            stats = st.reduce_stats(local, r.probs_mean, token_axes)
+        return MoEOutput(out.astype(cfg.dtype), aux, z, counts, stats)
 
     pspecs = {k: P("ep") if k != "gate_w" and not k.startswith("shared")
               else P() for k in params}
-    fn = jax.shard_map(
+    stats_specs = (st.MoEStats(*([P()] * len(st.MoEStats._fields)))
+                   if cfg.collect_stats else None)
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(pspecs, P(token_axes, None), P()),
-        out_specs=MoEOutput(P(token_axes, None), P(), P(), P()),
+        out_specs=MoEOutput(P(token_axes, None), P(), P(), P(),
+                            stats_specs),
         check_vma=False,
     )
     out = fn(params, x, src_order)

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.utils.compat import axis_size, shard_map
 from flashmoe_tpu.models.reference import init_moe_params
 from flashmoe_tpu.parallel.ep import ep_moe_layer, local_capacity
 from flashmoe_tpu.parallel.fused import fused_ep_moe_layer
@@ -43,7 +44,7 @@ def _comm_only(x, cfg: MoEConfig, mesh: Mesh):
     """Both all-to-alls on dispatch-shaped slabs, no compute between."""
 
     def body(x):
-        d = jax.lax.axis_size("ep")
+        d = axis_size("ep")
         s_loc, h = x.shape
         nlx = cfg.num_experts // d
         cap = local_capacity(cfg, s_loc)
@@ -60,7 +61,7 @@ def _comm_only(x, cfg: MoEConfig, mesh: Mesh):
         # nothing for XLA to dead-code-eliminate)
         return back.reshape(rows, h)[:s_loc]
 
-    return jax.shard_map(
+    return shard_map(
         body, mesh=mesh, in_specs=P("ep", None), out_specs=P("ep", None),
         check_vma=False,
     )(x)

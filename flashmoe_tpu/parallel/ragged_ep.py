@@ -31,8 +31,10 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from flashmoe_tpu.config import BLOCK_M, MoEConfig
+from flashmoe_tpu.utils.compat import axis_size, shard_map
 from flashmoe_tpu.ops import expert as exp
 from flashmoe_tpu.ops import ragged as rag
+from flashmoe_tpu.ops import stats as st
 from flashmoe_tpu.ops.gate import router
 from flashmoe_tpu.ops.moe import MoEOutput
 
@@ -40,7 +42,7 @@ from flashmoe_tpu.ops.moe import MoEOutput
 def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
                      use_pallas: bool, interpret: bool, exchange: str,
                      block_m: int, reduce_axes):
-    d = jax.lax.axis_size(axis)
+    d = axis_size(axis)
     s_loc, h = x.shape
     e = cfg.num_experts
     nlx = e // d
@@ -249,7 +251,12 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
     aux = jax.lax.pmean(r.aux_loss, reduce_axes) * cfg.aux_loss_coef
     z = jax.lax.pmean(r.z_loss, reduce_axes)
     cnts = jax.lax.psum(r.expert_counts, reduce_axes)
-    return MoEOutput(out.astype(cfg.dtype), aux, z, cnts)
+    stats = None
+    if cfg.collect_stats:
+        # dropless: capacity=None reports zero drops / full utilization
+        local = st.moe_stats(r, cfg, None)
+        stats = st.reduce_stats(local, r.probs_mean, reduce_axes)
+    return MoEOutput(out.astype(cfg.dtype), aux, z, cnts, stats)
 
 
 def ragged_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
@@ -274,10 +281,13 @@ def ragged_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
         reduce_axes=token_axes,
     )
     pspecs = {k: P("ep") if k != "gate_w" else P() for k in params}
-    fn = jax.shard_map(
+    stats_specs = (st.MoEStats(*([P()] * len(st.MoEStats._fields)))
+                   if cfg.collect_stats else None)
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(pspecs, P(token_axes, None)),
-        out_specs=MoEOutput(P(token_axes, None), P(), P(), P()),
+        out_specs=MoEOutput(P(token_axes, None), P(), P(), P(),
+                            stats_specs),
         check_vma=False,
     )
     return fn(params, x)

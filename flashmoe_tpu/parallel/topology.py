@@ -302,6 +302,8 @@ def probe_dcn_costs(sizes_mb=(0.25, 4.0), trials: int = 3,
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+    from flashmoe_tpu.utils.compat import shard_map
+
     p = jax.process_count()
     if p <= 1:
         return None
@@ -318,7 +320,7 @@ def probe_dcn_costs(sizes_mb=(0.25, 4.0), trials: int = 3,
     def probe_fn(perm, rows):
         def body(s):
             return jax.lax.ppermute(s, "x", perm=list(perm))
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             body, mesh=mesh, in_specs=PartitionSpec("x", None),
             out_specs=PartitionSpec("x", None), check_vma=False,
         ))

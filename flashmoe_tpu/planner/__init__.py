@@ -18,6 +18,9 @@ Model details: :mod:`flashmoe_tpu.planner.model` docstring and
 ``docs/PLANNER.md``.
 """
 
+from flashmoe_tpu.planner.drift import (  # noqa: F401
+    DriftRecord, drift_report, record_drift,
+)
 from flashmoe_tpu.planner.model import (  # noqa: F401
     BACKEND_OF, PathPrediction, explain_table, predict_paths,
 )

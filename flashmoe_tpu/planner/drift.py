@@ -1,0 +1,191 @@
+"""Planner drift monitor: predicted vs measured, continuously.
+
+PR 1's planner predicts per-path latency and selects execution paths
+from those predictions (plus committed measurements).  Nothing, however,
+measured the *prediction error in production* or said when the golden
+tables have drifted from reality — the feedback loop RaMP
+(arXiv:2604.26039) closes by selecting kernels from measured runtime
+signals.  This module is that loop's sensor: every real timing that
+flows through it is compared against the analytical prediction for the
+same (config, path, d, generation) point, the relative error lands in
+telemetry as a ``planner.drift`` decision (plus an error histogram), and
+errors past a threshold raise a visible warning that the cost model /
+golden tables need recalibration.
+
+Wired in: ``bench.py`` records drift for every executed path;
+``python -m flashmoe_tpu.observe`` summarizes accumulated drift records
+offline (:func:`drift_report`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import warnings
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.utils.telemetry import metrics
+
+# Relative-error tolerance before a drift warning fires.  The cost model
+# is a roofline — it deliberately predicts a *bound*, so real kernels sit
+# above it by a config-dependent factor; 0.5 flags only gross divergence
+# (a schedule regression, a stale golden table, wrong generation pin),
+# not normal roofline optimism.  FLASHMOE_DRIFT_THRESHOLD overrides.
+DEFAULT_THRESHOLD = 0.5
+
+
+def drift_threshold() -> float:
+    try:
+        return float(os.environ.get("FLASHMOE_DRIFT_THRESHOLD",
+                                    DEFAULT_THRESHOLD))
+    except ValueError:
+        return DEFAULT_THRESHOLD
+
+
+@dataclasses.dataclass(frozen=True)
+class DriftRecord:
+    """One predicted-vs-measured comparison."""
+
+    path: str
+    gen: str
+    d: int
+    predicted_ms: float
+    measured_ms: float
+    rel_error: float            # measured / predicted - 1 (signed)
+    threshold: float
+    exceeded: bool
+
+
+def record_drift(cfg: MoEConfig, path: str, measured_ms: float, *,
+                 d: int = 1, gen: str | None = None,
+                 predicted_ms: float | None = None,
+                 threshold: float | None = None,
+                 warn: bool = True) -> DriftRecord:
+    """Compare one measured latency against the planner's prediction.
+
+    ``path`` is a planner path or family name ('explicit', 'fused',
+    'collective', ...).  ``predicted_ms=None`` asks the cost model for
+    the prediction (fastest row of the family at this point); pass the
+    value a caller already computed to keep the two sides consistent.
+    The comparison is recorded as a ``planner.drift`` telemetry decision
+    and in the ``planner.drift_abs_rel_error`` histogram; past the
+    threshold a RuntimeWarning names the likely causes.
+    """
+    from flashmoe_tpu import tuning
+
+    gen = gen or tuning.generation()
+    if predicted_ms is None:
+        from flashmoe_tpu.planner.model import predict_paths
+
+        preds = predict_paths(cfg, d, gen)
+        match = [p for p in preds if p.path == path or p.family == path]
+        if not match:
+            raise ValueError(
+                f"no prediction for path {path!r} at d={d}; candidates: "
+                f"{sorted({p.path for p in preds})}")
+        predicted_ms = min(p.total_ms for p in match)
+    if predicted_ms <= 0:
+        raise ValueError(f"predicted_ms must be > 0, got {predicted_ms}")
+    threshold = drift_threshold() if threshold is None else threshold
+    rel = measured_ms / predicted_ms - 1.0
+    exceeded = abs(rel) > threshold
+    rec = DriftRecord(path=path, gen=gen, d=int(d),
+                      predicted_ms=float(predicted_ms),
+                      measured_ms=float(measured_ms),
+                      rel_error=float(rel), threshold=float(threshold),
+                      exceeded=exceeded)
+    metrics.decision(
+        "planner.drift", path=path, gen=gen, d=int(d),
+        predicted_ms=round(float(predicted_ms), 4),
+        measured_ms=round(float(measured_ms), 4),
+        rel_error=round(float(rel), 4), threshold=float(threshold),
+        exceeded=exceeded,
+        config=dict(e=cfg.num_experts, k=cfg.expert_top_k,
+                    h=cfg.hidden_size, i=cfg.intermediate_size,
+                    s=cfg.tokens))
+    metrics.histogram("planner.drift_abs_rel_error", abs(rel))
+    if exceeded and warn:
+        warnings.warn(
+            f"planner drift on {path!r} (gen={gen}, d={d}): measured "
+            f"{measured_ms:.3f} ms vs predicted {predicted_ms:.3f} ms "
+            f"({rel:+.0%}, threshold ±{threshold:.0%}) — the cost model "
+            f"or golden tables may be stale for this shape; recalibrate "
+            f"with `python -m flashmoe_tpu.planner --write-golden` or "
+            f"pass a measured mxu_fraction", RuntimeWarning, stacklevel=2)
+    return rec
+
+
+def _as_drift_fields(rec: dict) -> dict | None:
+    """Normalize a JSONL record to drift fields, or None.
+
+    Accepts ``planner.drift`` decision records and bench.py records
+    (which carry ``predicted_ms`` / ``value`` / ``path``)."""
+    if rec.get("decision") == "planner.drift":
+        return rec
+    if ("predicted_ms" in rec and "value" in rec
+            and isinstance(rec.get("value"), (int, float))):
+        pred = rec["predicted_ms"]
+        if not isinstance(pred, (int, float)) or pred <= 0:
+            return None
+        meas = float(rec["value"])
+        return {
+            "path": rec.get("predicted_path") or rec.get("path", "?"),
+            "gen": rec.get("planner_gen", "?"),
+            "d": rec.get("d", 1),
+            "predicted_ms": float(pred),
+            "measured_ms": meas,
+            "rel_error": rec.get("prediction_error",
+                                 meas / float(pred) - 1.0),
+            "exceeded": rec.get("drift_exceeded", False),
+        }
+    return None
+
+
+def drift_report(records: list[dict]) -> dict:
+    """Summarize drift across a pile of JSONL records (decision logs,
+    bench records, flight-recorder dumps — unrecognized records are
+    skipped).  Per (path, gen): count, mean/worst |relative error|, and
+    how many comparisons exceeded their threshold."""
+    by_key: dict[str, dict] = {}
+    seen: set = set()
+    n = exceeded = 0
+    for raw in records:
+        d = _as_drift_fields(raw)
+        if d is None:
+            continue
+        # bench.py mirrors each measurement into a planner.drift decision
+        # (record_drift), so an obs-dir pair (bench_records.jsonl +
+        # decisions.jsonl) presents the SAME comparison twice — dedup on
+        # the (path, gen, d, predicted, measured) identity the mirror
+        # preserves exactly.  Records without both numbers (synthetic /
+        # partial) carry no such identity and always count.
+        pred = d.get("predicted_ms")
+        meas = d.get("measured_ms")
+        if isinstance(pred, (int, float)) and pred > 0 \
+                and isinstance(meas, (int, float)) and meas > 0:
+            # 3 decimals: the coarser of the two mirrors' precisions
+            # (bench rounds value to 3, record_drift measured_ms to 4)
+            ident = (d.get("path"), d.get("gen"), d.get("d"),
+                     round(float(pred), 3), round(float(meas), 3))
+            if ident in seen:
+                continue
+            seen.add(ident)
+        n += 1
+        exceeded += bool(d.get("exceeded"))
+        key = f"{d.get('path', '?')}@{d.get('gen', '?')}"
+        b = by_key.setdefault(key, {
+            "path": d.get("path", "?"), "gen": d.get("gen", "?"),
+            "n": 0, "exceeded": 0, "mean_abs_rel_error": 0.0,
+            "worst_rel_error": 0.0,
+        })
+        rel = float(d.get("rel_error", 0.0))
+        b["n"] += 1
+        b["exceeded"] += bool(d.get("exceeded"))
+        b["mean_abs_rel_error"] += abs(rel)
+        if abs(rel) > abs(b["worst_rel_error"]):
+            b["worst_rel_error"] = rel
+    for b in by_key.values():
+        b["mean_abs_rel_error"] = round(b["mean_abs_rel_error"] / b["n"], 4)
+        b["worst_rel_error"] = round(b["worst_rel_error"], 4)
+    return {"n": n, "exceeded": exceeded,
+            "by_path": dict(sorted(by_key.items()))}

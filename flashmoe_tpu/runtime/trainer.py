@@ -122,10 +122,50 @@ def make_train_step(cfg: MoEConfig, mesh: Mesh, optimizer,
     )
 
 
+def host_metrics(step_metrics: dict, moe_layers=None) -> dict:
+    """Device step metrics -> one JSON-ready dict: scalars to floats,
+    per-layer MoEStats (``moe_stats``, present when cfg.collect_stats)
+    to the flight-recorder ``moe`` schema that
+    ``python -m flashmoe_tpu.observe`` consumes.
+
+    ``moe_layers``: the transformer layer index per stats entry
+    (``cfg.moe_layer_indices`` — forward only collects stats for MoE
+    layers, so position i of the tuple is that sequence's i-th layer);
+    None falls back to the positional index."""
+    from flashmoe_tpu.ops.stats import stats_to_host
+
+    out: dict = {}
+    for k, v in step_metrics.items():
+        if k == "moe_stats":
+            out["moe"] = [
+                dict(layer=(moe_layers[i] if moe_layers is not None
+                            and i < len(moe_layers) else i),
+                     **stats_to_host(st))
+                for i, st in enumerate(v)
+            ]
+        else:
+            out[k] = float(v)
+    return out
+
+
 def train(cfg: MoEConfig, mesh: Mesh, data_iter, num_steps: int,
           key=None, log_every: int = 10, state: TrainState | None = None,
-          use_pallas: bool | None = None):
-    """Simple host training loop (see runtime.worker for the CLI)."""
+          use_pallas: bool | None = None,
+          recorder: "FlightRecorder | None" = None,
+          flight_path: str | None = None):
+    """Simple host training loop (see runtime.worker for the CLI).
+
+    ``recorder``: a :class:`flashmoe_tpu.utils.telemetry.FlightRecorder`
+    capturing EVERY step (ring-bounded), independent of ``log_every``;
+    with ``flight_path`` one is created if needed and its JSONL is
+    exported there when the loop ends — the artifact
+    ``python -m flashmoe_tpu.observe`` summarizes.  Set
+    ``cfg.collect_stats`` to include the in-graph MoE stats per record.
+    """
+    import time
+
+    from flashmoe_tpu.utils.telemetry import FlightRecorder, metrics as tm
+
     key = key if key is not None else jax.random.PRNGKey(0)
     optimizer = make_optimizer(cfg, total_steps=num_steps)
     if state is None:
@@ -133,10 +173,31 @@ def train(cfg: MoEConfig, mesh: Mesh, data_iter, num_steps: int,
         sh = state_shardings(state, cfg, mesh)
         state = jax.device_put(state, sh)
     step = make_train_step(cfg, mesh, optimizer, use_pallas=use_pallas)
+    if flight_path is not None and recorder is None:
+        recorder = FlightRecorder()
     history = []
     for i in range(num_steps):
         batch = next(data_iter)
-        state, metrics = step(state, batch)
-        if i % log_every == 0 or i == num_steps - 1:
-            history.append({k: float(v) for k, v in metrics.items()})
+        log_step = i % log_every == 0 or i == num_steps - 1
+        if recorder is not None or log_step:
+            # block before reading the clock: jit dispatch is async, so
+            # an unsynchronized timer would record ~0 host-dispatch ms.
+            # With a recorder every step is timed exactly; log-only runs
+            # time the logged step plus whatever backlog drained with it.
+            t0 = time.perf_counter()
+            state, metrics = step(state, batch)
+            jax.block_until_ready(metrics)
+            step_ms = (time.perf_counter() - t0) * 1e3
+            rec = host_metrics(metrics, moe_layers=cfg.moe_layer_indices)
+            rec["step_ms"] = step_ms
+            # bounded: the histogram aggregates, no per-step list grows
+            tm.histogram("trainer.step_ms", step_ms)
+            if recorder is not None:
+                recorder.record(step=i, **rec)
+            if log_step:
+                history.append(rec)
+        else:
+            state, metrics = step(state, batch)
+    if flight_path is not None and recorder is not None:
+        recorder.export_jsonl(flight_path)
     return state, history

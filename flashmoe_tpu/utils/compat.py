@@ -1,0 +1,36 @@
+"""JAX version-compatibility shims.
+
+The codebase targets current jax, where ``jax.shard_map`` is a public
+top-level API and the replication check is spelled ``check_vma``.  Pinned
+container images may carry an older release where shard_map still lives
+in ``jax.experimental.shard_map`` and the same knob is ``check_rep`` —
+without this shim every shard_map-based layer (ep / fused / ragged /
+pipeline / ring attention / DCN probe) dies on AttributeError before it
+can trace.  One resolution point keeps the seven call sites identical on
+both versions.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` where available, else the experimental API with
+    ``check_vma`` mapped onto its older ``check_rep`` spelling."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` where available; on older releases the
+    constant-folded ``psum(1, axis)`` idiom yields the same static int
+    inside shard_map bodies."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)

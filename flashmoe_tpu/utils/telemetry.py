@@ -7,20 +7,31 @@ reads inside kernels, and cudaEvent kernel timing.  TPU equivalents:
 
   * :func:`trace_span` — ``jax.profiler.TraceAnnotation`` +
     ``jax.named_scope``: shows up both in host traces and as HLO op-name
-    prefixes in xprof;
+    prefixes in xprof; the ep and fused MoE layers wrap their gate /
+    dispatch / a2a / expert / combine phases so traces read like the
+    reference's NVTX domain;
   * :func:`start_trace` / :func:`stop_trace` — whole-program profiler
     capture for tensorboard/xprof (the SM-utilization analogue: MXU
     utilization comes from the captured trace);
-  * :class:`Metrics` — lightweight host-side counters/timers with JSONL
-    export (the reference's per-rank ``fmt::println`` timings, structured).
+  * :class:`Metrics` — lightweight host-side counters/gauges/timers/
+    histograms with JSONL export and Prometheus text exposition (the
+    reference's per-rank ``fmt::println`` timings, structured);
+  * :class:`FlightRecorder` — a bounded per-step ring buffer of
+    structured records (the in-graph MoE stats of
+    :mod:`flashmoe_tpu.ops.stats`, losses, step timings) with JSONL
+    export, summarized offline by ``python -m flashmoe_tpu.observe``.
 """
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import json
+import math
+import os
+import re
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 
 import jax
 
@@ -50,16 +61,125 @@ def capture_trace(log_dir: str):
         stop_trace()
 
 
+class Histogram:
+    """Fixed-bucket histogram with percentile estimates and
+    Prometheus-compatible cumulative buckets.
+
+    Default bounds span 1 µs – 1000 ms style magnitudes (1-2.5-5 decades)
+    — wide enough for both per-step seconds and per-phase milliseconds
+    without configuration; pass explicit ``buckets`` when the quantity
+    has a known range."""
+
+    DEFAULT_BUCKETS = tuple(
+        m * 10.0 ** e for e in range(-3, 4) for m in (1.0, 2.5, 5.0)
+    )
+
+    def __init__(self, buckets=None):
+        self.buckets = tuple(sorted(buckets)) if buckets \
+            else self.DEFAULT_BUCKETS
+        # counts[i] = observations <= buckets[i] (exclusive of earlier
+        # buckets); counts[-1] = overflow (> buckets[-1])
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.n = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, value: float):
+        v = float(value)
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.n += 1
+        self.total += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    def percentile(self, q: float) -> float:
+        """Approximate q-quantile (0..1) from the bucket boundaries."""
+        if not self.n:
+            return 0.0
+        target = q * self.n
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target and c:
+                hi = self.buckets[i] if i < len(self.buckets) else self.max
+                return min(hi, self.max)
+        return self.max
+
+    def summary(self) -> dict:
+        if not self.n:
+            return {"count": 0}
+        return {
+            "count": self.n, "sum": self.total,
+            "min": self.min, "max": self.max,
+            "mean": self.total / self.n,
+            "p50": self.percentile(0.5), "p99": self.percentile(0.99),
+        }
+
+
+class FlightRecorder:
+    """Bounded ring buffer of per-step structured records — the
+    postmortem black box.  Old steps fall off the back, so a recorder
+    left attached to a long run costs O(capacity) memory forever; export
+    dumps whatever the window still holds.
+
+    Capacity: explicit argument, else ``FLASHMOE_FLIGHT_CAPACITY``,
+    else 1024 steps."""
+
+    def __init__(self, capacity: int | None = None):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get(
+                    "FLASHMOE_FLIGHT_CAPACITY", "1024"))
+            except ValueError:
+                capacity = 1024
+        self._buf: deque = deque(maxlen=max(1, int(capacity)))
+
+    @property
+    def capacity(self) -> int:
+        return self._buf.maxlen
+
+    @property
+    def records(self) -> list[dict]:
+        return list(self._buf)
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def record(self, **fields) -> dict:
+        rec = dict(fields)
+        self._buf.append(rec)
+        return rec
+
+    def export_jsonl(self, path: str) -> int:
+        """Write every retained record, one JSON object per line.
+        Returns the number written."""
+        with open(path, "w") as f:
+            for rec in self._buf:
+                f.write(json.dumps(rec) + "\n")
+        return len(self._buf)
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize to the Prometheus metric-name grammar
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*``."""
+    n = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not n or n[0].isdigit():
+        n = "_" + n
+    return n
+
+
 class Metrics:
-    """Host-side metrics registry: counters, gauges, wall timers, and
-    structured decision records (planner path selections, schedule
-    choices — anything a postmortem needs the full breakdown of, not
-    just a scalar)."""
+    """Host-side metrics registry: counters, gauges, wall timers,
+    histograms, and structured decision records (planner path
+    selections, schedule choices — anything a postmortem needs the full
+    breakdown of, not just a scalar)."""
 
     def __init__(self):
         self.counters: dict[str, float] = defaultdict(float)
         self.gauges: dict[str, float] = {}
         self.times: dict[str, list[float]] = defaultdict(list)
+        self.histograms: dict[str, Histogram] = {}
         self.decisions: list[dict] = []
 
     def count(self, name: str, inc: float = 1.0):
@@ -67,6 +187,13 @@ class Metrics:
 
     def gauge(self, name: str, value: float):
         self.gauges[name] = float(value)
+
+    def histogram(self, name: str, value: float, buckets=None):
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = Histogram(buckets)
+        h.observe(value)
+        return h
 
     def decision(self, name: str, **fields) -> dict:
         """Record a structured decision (e.g. the planner's path choice
@@ -101,7 +228,51 @@ class Metrics:
                 out[f"{k}_ms_p50"] = s[len(s) // 2] * 1e3
                 out[f"{k}_ms_sum"] = sum(v) * 1e3
                 out[f"{k}_calls"] = len(v)
+        for k, h in self.histograms.items():
+            for stat, val in h.summary().items():
+                out[f"{k}_{stat}"] = val
         return out
+
+    def prometheus_text(self, prefix: str = "flashmoe") -> str:
+        """Prometheus text-exposition rendering of the registry: counters
+        as ``*_total``, gauges as gauges, timers as summaries (seconds),
+        histograms with cumulative ``le`` buckets — scrape-ready from any
+        debug endpoint or dumped next to the flight recorder."""
+        lines: list[str] = []
+
+        def fmt(v: float) -> str:
+            return repr(float(v))
+
+        for name in sorted(self.counters):
+            n = f"{prefix}_{_prom_name(name)}_total"
+            lines += [f"# TYPE {n} counter", f"{n} {fmt(self.counters[name])}"]
+        for name in sorted(self.gauges):
+            n = f"{prefix}_{_prom_name(name)}"
+            lines += [f"# TYPE {n} gauge", f"{n} {fmt(self.gauges[name])}"]
+        for name in sorted(self.times):
+            v = self.times[name]
+            if not v:
+                continue
+            n = f"{prefix}_{_prom_name(name)}_seconds"
+            s = sorted(v)
+            lines += [
+                f"# TYPE {n} summary",
+                f'{n}{{quantile="0.5"}} {fmt(s[len(s) // 2])}',
+                f"{n}_sum {fmt(sum(v))}",
+                f"{n}_count {len(v)}",
+            ]
+        for name in sorted(self.histograms):
+            h = self.histograms[name]
+            n = f"{prefix}_{_prom_name(name)}"
+            lines.append(f"# TYPE {n} histogram")
+            cum = 0
+            for bound, c in zip(h.buckets, h.counts):
+                cum += c
+                lines.append(f'{n}_bucket{{le="{bound:g}"}} {cum}')
+            lines.append(f'{n}_bucket{{le="+Inf"}} {h.n}')
+            lines.append(f"{n}_sum {fmt(h.total)}")
+            lines.append(f"{n}_count {h.n}")
+        return "\n".join(lines) + "\n"
 
     def dump_jsonl(self, path: str, **extra):
         rec = dict(self.summary(), **extra)
@@ -109,10 +280,13 @@ class Metrics:
             f.write(json.dumps(rec) + "\n")
         return rec
 
-    def dump_decisions_jsonl(self, path: str) -> int:
-        """Append every recorded decision (full breakdowns) as JSONL."""
+    def dump_decisions_jsonl(self, path: str, start: int = 0) -> int:
+        """Append recorded decisions (full breakdowns) as JSONL from
+        index ``start`` on — callers that flush repeatedly (bench sweeps)
+        pass the previous return value so no decision is written twice.
+        Returns the total decision count (the next call's ``start``)."""
         with open(path, "a") as f:
-            for rec in self.decisions:
+            for rec in self.decisions[start:]:
                 f.write(json.dumps(rec) + "\n")
         return len(self.decisions)
 
