@@ -1,0 +1,173 @@
+"""Chaos engineering for the fault-tolerance ladder.
+
+The three recovery tiers (``docs/RESILIENCE.md``) only count if each rung
+is *proven* to catch its fault class.  This package provides
+deterministic, seeded fault injectors behind one :class:`FaultPlan` API,
+plugging into two places:
+
+  * **in-graph points** (:mod:`flashmoe_tpu.chaos.inject`): NaN expert
+    outputs, router skew, gradient NaN/spikes — spliced into the traced
+    computation, exercising tier 0 (expert masking) and tier 1 (update
+    skipping);
+  * **host-level hooks**: :func:`make_injector` returns a
+    ``fail_injector(step)`` for :func:`flashmoe_tpu.runtime.resilient.
+    resilient_train` (checkpoint corruption, path failures) and
+    :func:`wrap_step` wraps a train step (stalls) — exercising tier 2
+    (timeout + restore, intact-fallback restore, planner path fallback).
+
+``python -m flashmoe_tpu.chaos`` runs the full drill matrix against a
+small model and reports recovery outcome, loss-of-work, and telemetry
+evidence per fault (:mod:`flashmoe_tpu.chaos.drill`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+from flashmoe_tpu.chaos import inject
+
+#: the drill matrix: every fault class the ladder claims to survive
+FAULTS = ("nan_expert", "nan_grad", "grad_spike", "slow_step",
+          "corrupt_ckpt", "skewed_routing", "path_raise")
+
+#: which recovery tier is expected to absorb each fault
+EXPECTED_TIER = {
+    "nan_expert": "tier0:expert_mask",
+    "skewed_routing": "tier0:telemetry",
+    "nan_grad": "tier1:skip_update",
+    "grad_spike": "tier1:skip_update",
+    "slow_step": "tier2:timeout_retry",
+    "corrupt_ckpt": "tier2:fallback_restore",
+    "path_raise": "tier2:planner_fallback",
+}
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """One deterministic fault to inject.
+
+    ``fault``: one of :data:`FAULTS`.
+    ``step``:  the step index the fault fires at (host faults fire when
+               the training loop reaches it; the in-graph gradient
+               faults compare against the traced ``state.step``).
+    ``expert``: target expert for nan_expert / skewed_routing.
+    ``scale``: gradient multiplier for grad_spike.
+    ``bias``:  router logit bias for skewed_routing.
+    ``sleep_s``: stall duration for slow_step (must exceed the
+               ResilienceConfig step deadline to be detected).
+    ``once``:  host faults fire once then disarm (the transient-fault
+               model); False = fire at every visit of ``step``.
+    ``seed``:  reserved for randomized plans; recorded for provenance.
+    """
+
+    fault: str
+    step: int = 3
+    expert: int = 0
+    scale: float = 1e4
+    bias: float = 100.0
+    sleep_s: float = 2.0
+    once: bool = True
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.fault not in FAULTS:
+            raise ValueError(
+                f"unknown fault {self.fault!r}; known: {FAULTS}")
+
+
+def clear() -> None:
+    """Disarm every in-graph point and forget reported path failures —
+    call between drills so faults never leak across scenarios."""
+    inject.disarm()
+    from flashmoe_tpu.planner import select
+
+    select.reset_path_failures()
+
+
+def arm_plan(plan: FaultPlan) -> None:
+    """Arm the plan's in-graph injection point (no-op for host faults).
+    Arm BEFORE building/jitting the computation under test."""
+    if plan.fault == "nan_expert":
+        inject.arm("nan_expert", expert=plan.expert)
+    elif plan.fault == "skewed_routing":
+        inject.arm("skewed_routing", expert=plan.expert, bias=plan.bias)
+    elif plan.fault == "nan_grad":
+        inject.arm("nan_grad", step=plan.step)
+    elif plan.fault == "grad_spike":
+        inject.arm("grad_spike", step=plan.step, scale=plan.scale)
+
+
+def _corrupt_latest_checkpoint(directory: str) -> str | None:
+    """Flip bytes in the newest checkpoint's largest payload file.
+    Returns the corrupted path (None when there is nothing to corrupt)."""
+    from flashmoe_tpu.runtime import checkpoint as ckpt
+
+    step = ckpt.latest_step(directory)
+    if step is None:
+        return None
+    victim, size = None, -1
+    for root, _dirs, files in os.walk(ckpt.step_dir(directory, step)):
+        for f in files:
+            p = os.path.join(root, f)
+            s = os.path.getsize(p)
+            if s > size:
+                victim, size = p, s
+    if victim is None:
+        return None
+    with open(victim, "r+b") as f:
+        f.seek(max(0, size // 2))
+        f.write(b"\xde\xad\xbe\xef")
+    return victim
+
+
+def make_injector(plan: FaultPlan, rcfg=None):
+    """A ``fail_injector(step)`` callable for ``resilient_train`` that
+    fires the plan's HOST-level fault (corrupt_ckpt / path_raise).
+    In-graph and wrapper faults return a no-op injector so one code path
+    installs any plan."""
+    fired = {"n": 0}
+
+    def injector(i: int):
+        if i != plan.step or (plan.once and fired["n"]):
+            return
+        if plan.fault == "corrupt_ckpt":
+            fired["n"] += 1
+            directory = getattr(rcfg, "checkpoint_dir", None)
+            if directory:
+                _corrupt_latest_checkpoint(directory)
+            raise RuntimeError(
+                f"chaos: injected crash after corrupting newest "
+                f"checkpoint in {directory!r} (step {i})")
+        if plan.fault == "path_raise":
+            fired["n"] += 1
+            from flashmoe_tpu.planner.select import PathFailure
+
+            raise PathFailure(
+                "fused", f"chaos: injected path failure at step {i}")
+
+    return injector
+
+
+def wrap_step(step_fn, plan: FaultPlan, deadline_s: float | None = None):
+    """Wrap a train step with the plan's stall fault (slow_step): the
+    wrapped step sleeps ``plan.sleep_s`` when the state reaches
+    ``plan.step``, which the resilient runner's wall-clock deadline
+    converts into a detected StepFailure.  Other faults pass through."""
+    if plan.fault != "slow_step":
+        return step_fn
+    fired = {"n": 0}
+
+    def wrapped(state, batch):
+        i = int(state.step)
+        if i == plan.step and not (plan.once and fired["n"]):
+            fired["n"] += 1
+            time.sleep(plan.sleep_s)
+        return step_fn(state, batch)
+
+    return wrapped
+
+
+__all__ = ["FAULTS", "EXPECTED_TIER", "FaultPlan", "arm_plan", "clear",
+           "inject", "make_injector", "wrap_step"]

@@ -1,0 +1,243 @@
+"""Chaos drills: prove each fault class recovers at its intended tier.
+
+One drill = one :class:`flashmoe_tpu.chaos.FaultPlan` run against a small
+real training job under :func:`flashmoe_tpu.runtime.resilient.
+resilient_train` with the full ladder armed (tier-0 expert masking,
+tier-1 gradient guard, tier-2 verified checkpoints + path fallback).
+The drill then interrogates the run the way an SRE would: did training
+reach the last step, how many steps of work were re-executed, and does
+the telemetry carry evidence that the *intended* tier absorbed the fault
+(:data:`flashmoe_tpu.chaos.EXPECTED_TIER`)?
+
+``python -m flashmoe_tpu.chaos`` runs the whole matrix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flashmoe_tpu.chaos import (
+    EXPECTED_TIER, FAULTS, FaultPlan, arm_plan, clear, make_injector,
+    wrap_step,
+)
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.parallel.mesh import make_mesh
+from flashmoe_tpu.runtime.resilient import (
+    ResilienceConfig, StepFailure, resilient_train,
+)
+from flashmoe_tpu.runtime.trainer import (
+    GradGuardConfig, init_state, make_optimizer, make_train_step,
+    state_shardings,
+)
+from flashmoe_tpu.utils.telemetry import Metrics, metrics as global_metrics
+
+
+def drill_config(**overrides) -> MoEConfig:
+    """The drill model: small enough to train on one CPU device in
+    seconds, MoE enough (4 experts, top-2, capacity drops possible) that
+    every tier-0 path is exercised.  The full ladder is armed."""
+    base = dict(num_experts=4, expert_top_k=2, hidden_size=64,
+                intermediate_size=128, sequence_len=32, num_layers=1,
+                moe_frequency=1, vocab_size=256, num_heads=2,
+                drop_tokens=True, capacity_factor=1.5, is_training=True,
+                dtype=jnp.float32, param_dtype=jnp.float32,
+                degrade_unhealthy_experts=True, collect_stats=True)
+    base.update(overrides)
+    return MoEConfig(**base)
+
+
+def data_stream(cfg: MoEConfig, batch: int = 2, seed: int = 0):
+    """Deterministic seeded batch stream (step-indexed keys, so two
+    streams with one seed are bit-identical — the property the replay
+    assertions lean on)."""
+    i = 0
+    while True:
+        yield {"tokens": jax.random.randint(
+            jax.random.PRNGKey(seed * 100003 + i),
+            (batch, cfg.sequence_len + 1), 0, cfg.vocab_size)}
+        i += 1
+
+
+@dataclasses.dataclass
+class DrillResult:
+    fault: str
+    expected_tier: str
+    recovered: bool
+    reason: str            # why recovered is False ("" when True)
+    final_step: int
+    steps_rerun: int       # loss-of-work: successful step executions
+                           # beyond num_steps (replays after rewinds)
+    wall_s: float
+    evidence: dict         # telemetry proof the intended tier fired
+    decisions: list        # structured decisions recorded during the run
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _stats_probe(cfg: MoEConfig, params, key=11):
+    """One armed forward through the MoE layer, returning host stats —
+    the tier-0 evidence reader (masked experts, imbalance, drops)."""
+    from flashmoe_tpu.ops.moe import moe_layer
+    from flashmoe_tpu.ops.stats import stats_to_host
+
+    moe_params = params["layers"][0]["moe"]
+    x = jax.random.normal(jax.random.PRNGKey(key),
+                          (cfg.tokens, cfg.hidden_size), jnp.float32)
+    out = moe_layer(moe_params, x.astype(cfg.dtype), cfg, use_pallas=False)
+    return stats_to_host(out.stats), out
+
+
+def run_drill(fault: str, *, num_steps: int = 6, checkpoint_every: int = 2,
+              workdir: str | None = None, seed: int = 0,
+              batch: int = 2) -> DrillResult:
+    """Run one fault drill end to end; never raises for a failed drill —
+    the result carries the diagnosis instead."""
+    plan = FaultPlan(fault, step=3, seed=seed)
+    if fault == "corrupt_ckpt":
+        # corrupt the NEWEST checkpoint after two exist, so the fallback
+        # restore has an intact older step to land on
+        plan.step = 2 * checkpoint_every + 1
+    clear()
+    arm_plan(plan)
+
+    tmp = workdir or tempfile.mkdtemp(prefix=f"chaos_{fault}_")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    cfg = drill_config()
+    # the drill mesh is a single device: deterministic, CLI-runnable on
+    # any host; the multi-device tiers are covered by tests/test_chaos.py
+    mesh = make_mesh(cfg, dp=1, devices=jax.devices()[:1])
+    guard = GradGuardConfig(warmup_steps=2, spike_factor=10.0)
+    opt = make_optimizer(cfg, total_steps=num_steps)
+    state = init_state(jax.random.PRNGKey(seed), cfg, opt, guard=guard)
+    state = jax.device_put(state, state_shardings(state, cfg, mesh))
+    step_fn = make_train_step(cfg, mesh, opt, guard=guard)
+
+    timeout = None
+    if fault == "slow_step":
+        # calibrate the deadline against a real (compiled) step so the
+        # drill never mistakes compile time for a stall: warm up on a
+        # throwaway state (the jitted step donates its input)
+        warm = init_state(jax.random.PRNGKey(seed + 1), cfg, opt,
+                          guard=guard)
+        warm = jax.device_put(warm, state_shardings(warm, cfg, mesh))
+        warm_batch = next(data_stream(cfg, batch, seed + 7))
+        jax.block_until_ready(step_fn(warm, warm_batch))
+        t0 = time.perf_counter()
+        warm2 = init_state(jax.random.PRNGKey(seed + 2), cfg, opt,
+                           guard=guard)
+        warm2 = jax.device_put(warm2, state_shardings(warm2, cfg, mesh))
+        jax.block_until_ready(step_fn(warm2, warm_batch))
+        warm_s = time.perf_counter() - t0
+        timeout = max(2.0, 20 * warm_s)
+        plan.sleep_s = 2.5 * timeout
+
+    rcfg = ResilienceConfig(checkpoint_dir=ckpt_dir,
+                            checkpoint_every=checkpoint_every,
+                            step_timeout_s=timeout, max_retries=3)
+    metrics = Metrics()
+    injector = make_injector(plan, rcfg)
+    wrapped = wrap_step(step_fn, plan)
+    g0 = len(global_metrics.decisions)
+
+    t0 = time.perf_counter()
+    error = None
+    try:
+        final, history = resilient_train(
+            state, wrapped, data_stream(cfg, batch, seed), num_steps,
+            rcfg=rcfg, metrics=metrics, fail_injector=injector)
+        final_step = int(final.step)
+    except Exception as e:  # noqa: BLE001 — a drill reports, never dies
+        error, final_step, history = f"{type(e).__name__}: {e}", -1, []
+    wall = time.perf_counter() - t0
+
+    decisions = metrics.decisions + global_metrics.decisions[g0:]
+    c = metrics.counters
+    evidence: dict = {
+        "failures": c.get("failures", 0.0),
+        "restores": c.get("restores", 0.0),
+        "grad_skips": c.get("grad_skips", 0.0),
+        "checkpoints": c.get("checkpoints", 0.0),
+        "path_fallbacks": c.get("path_fallbacks", 0.0),
+        "finite_history": bool(history) and all(
+            np.isfinite(h["loss"]) for h in history if "loss" in h),
+        "decision_names": sorted({d["decision"] for d in decisions}),
+    }
+
+    # ---- per-fault verdict: did the INTENDED tier absorb it? ----
+    ok, why = True, []
+
+    def need(cond, msg):
+        nonlocal ok
+        if not cond:
+            ok = False
+            why.append(msg)
+
+    need(error is None, f"aborted: {error}")
+    need(final_step == num_steps, f"ended at step {final_step}")
+    if fault in ("nan_expert", "skewed_routing"):
+        probe_params = (final.params if error is None else
+                        init_state(jax.random.PRNGKey(seed), cfg,
+                                   opt).params)
+        st, _ = _stats_probe(cfg, {"layers": [{"moe": probe_params[
+            "layers"][0]["moe"]}]})
+        evidence["probe"] = st
+        need(evidence["finite_history"], "non-finite loss leaked")
+        need(c.get("failures", 0) == 0,
+             "fault escalated past tier 0 (step failures)")
+        if fault == "nan_expert":
+            need(st["masked_experts"] >= 1, "no masked expert in stats")
+        else:
+            need(st["imbalance"] > cfg.num_experts / 2
+                 or st["dropped_fraction"] > 0,
+                 "no skew visible in stats")
+    elif fault in ("nan_grad", "grad_spike"):
+        need(c.get("grad_skips", 0) >= 1, "no skipped update recorded")
+        need(c.get("failures", 0) == 0,
+             "fault escalated past tier 1 (step failures)")
+        need(c.get("restores", 0) == 0, "needless checkpoint rewind")
+        need(any(d["decision"] == "trainer.grad_skip" for d in decisions),
+             "no trainer.grad_skip decision")
+    elif fault == "slow_step":
+        need(c.get("failures", 0) >= 1, "stall was not detected")
+        need(c.get("restores", 0) >= 1, "no restore after timeout")
+    elif fault == "corrupt_ckpt":
+        need(any(d["decision"] == "checkpoint.fallback"
+                 for d in decisions), "no checkpoint.fallback decision")
+        need(c.get("restores", 0) >= 1, "no restore happened")
+    elif fault == "path_raise":
+        need(c.get("path_fallbacks", 0) >= 1, "PathFailure not handled")
+        need(any(d["decision"] == "planner.fallback" for d in decisions),
+             "no planner.fallback decision")
+
+    steps_rerun = max(0, int(c.get("steps", 0)) - num_steps)
+    # loss-of-work bound: a rewind replays at most the window since the
+    # newest usable checkpoint — one interval, two when the newest was
+    # the corrupted one (fallback lands one checkpoint further back)
+    bound = checkpoint_every * (2 if fault == "corrupt_ckpt" else 1)
+    retries = int(c.get("failures", 0))
+    if fault not in ("nan_expert", "skewed_routing", "nan_grad",
+                     "grad_spike"):
+        need(steps_rerun <= bound * max(1, retries),
+             f"loss of work {steps_rerun} exceeds bound "
+             f"{bound * max(1, retries)}")
+    else:
+        need(steps_rerun == 0, "in-graph tier re-ran steps")
+
+    clear()
+    return DrillResult(
+        fault=fault, expected_tier=EXPECTED_TIER[fault], recovered=ok,
+        reason="; ".join(why), final_step=final_step,
+        steps_rerun=steps_rerun, wall_s=round(wall, 3),
+        evidence=evidence, decisions=decisions)
+
+
+def run_matrix(faults=FAULTS, **kw) -> list[DrillResult]:
+    return [run_drill(f, **kw) for f in faults]

@@ -1,0 +1,128 @@
+"""In-graph fault injection points — the chaos harness's data plane.
+
+A tiny, import-light registry (this module must be importable from the
+hot-path ops without dragging the runtime in).  Injection points are
+*armed* host-side before a computation is traced; the hook sites in
+:mod:`flashmoe_tpu.ops.moe` / :mod:`flashmoe_tpu.ops.gate` /
+:mod:`flashmoe_tpu.parallel.ep` / :mod:`flashmoe_tpu.runtime.trainer`
+check :func:`is_armed` with a plain Python ``if`` — a trace-time check,
+so a disarmed registry adds ZERO ops to any compiled graph, and an armed
+one splices the fault into the jaxpr deterministically.
+
+Because arming is a trace-time decision, computations jitted BEFORE a
+point was armed keep their fault-free trace (jit caches by Python-level
+closure state).  The drill harness (:mod:`flashmoe_tpu.chaos.drill`)
+always arms before building its train step; tests that re-arm must
+rebuild (or re-jit) the computation.
+
+Points:
+
+=================  ==========================================  =========
+point              hook site                                   spec keys
+=================  ==========================================  =========
+``nan_expert``     capacity expert-output buffers [E, C, H]    expert
+                   (ops/moe.py, parallel/ep.py)
+``skewed_routing`` router logits (ops/gate.py router_xla;      expert,
+                   armed drills force the XLA gate)            bias
+``nan_grad``       trainer gradients at one step               step
+``grad_spike``     trainer gradients at one step               step,
+                                                               scale
+=================  ==========================================  =========
+
+Host-level faults (``slow_step``, ``corrupt_ckpt``, ``path_raise``) do
+not live here — they ride :func:`flashmoe_tpu.chaos.make_injector` /
+:func:`flashmoe_tpu.chaos.wrap_step` instead.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_ARMED: dict[str, dict] = {}
+
+POINTS = ("nan_expert", "skewed_routing", "nan_grad", "grad_spike")
+
+
+def arm(point: str, **spec) -> None:
+    """Arm an in-graph injection point.  Idempotent; later arms replace
+    the spec.  Remember to (re)build any jitted computation AFTER arming
+    — jit caches the fault-free trace."""
+    if point not in POINTS:
+        raise ValueError(f"unknown injection point {point!r}; "
+                         f"in-graph points: {POINTS}")
+    _ARMED[point] = dict(spec)
+
+
+def disarm(point: str | None = None) -> None:
+    """Disarm one point, or everything when ``point`` is None."""
+    if point is None:
+        _ARMED.clear()
+    else:
+        _ARMED.pop(point, None)
+
+
+def is_armed(point: str) -> bool:
+    return point in _ARMED
+
+
+def spec(point: str) -> dict:
+    return dict(_ARMED.get(point, {}))
+
+
+# ----------------------------------------------------------------------
+# Appliers — called from the hook sites only when is_armed() (trace time)
+# ----------------------------------------------------------------------
+
+def poison_expert(ybuf):
+    """NaN one expert's slab of a capacity-format output [E, C, H]."""
+    ybuf = jnp.asarray(ybuf)
+    e = int(_ARMED["nan_expert"].get("expert", 0)) % ybuf.shape[0]
+    return ybuf.at[e].set(jnp.asarray(jnp.nan, ybuf.dtype))
+
+
+def poison_logits(logits):
+    """Bias the router logits hard toward one expert: logits [S, E].
+    An additive logit bias is input-independent — every token's top-1
+    collapses onto the target expert (weight-level biasing would scale
+    with ``sum(x)``, whose sign flips per token)."""
+    s = _ARMED["skewed_routing"]
+    logits = jnp.asarray(logits)
+    e = int(s.get("expert", 0)) % logits.shape[-1]
+    bias = float(s.get("bias", 100.0))
+    return logits.at[:, e].add(jnp.asarray(bias, logits.dtype))
+
+
+def poison_grads(grads, step):
+    """Apply armed gradient faults at their target step (in-graph:
+    ``step`` is the traced TrainState.step, compared with jnp.where)."""
+    if "nan_grad" in _ARMED:
+        at = jnp.asarray(int(_ARMED["nan_grad"].get("step", 0)), step.dtype)
+        grads = _tree_where(step == at, jnp.nan, grads)
+    if "grad_spike" in _ARMED:
+        s = _ARMED["grad_spike"]
+        at = jnp.asarray(int(s.get("step", 0)), step.dtype)
+        scale = float(s.get("scale", 1e4))
+        grads = _tree_scale_where(step == at, scale, grads)
+    return grads
+
+
+def _tree_where(cond, bad_value, tree):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda g: jnp.where(cond, jnp.asarray(bad_value, g.dtype), g)
+        if hasattr(g, "dtype") and jnp.issubdtype(g.dtype, jnp.floating)
+        else g,
+        tree,
+    )
+
+
+def _tree_scale_where(cond, scale, tree):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda g: jnp.where(cond, g * jnp.asarray(scale, g.dtype), g)
+        if hasattr(g, "dtype") and jnp.issubdtype(g.dtype, jnp.floating)
+        else g,
+        tree,
+    )

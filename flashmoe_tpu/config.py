@@ -134,6 +134,19 @@ class MoEConfig:
     # collectives (asserted by tests/test_observe.py).
     collect_stats: bool = False
 
+    # Tier-0 fault tolerance (flashmoe_tpu/ops/health.py): when True,
+    # every MoE layer checks its per-expert FFN outputs for non-finite
+    # values *inside the compiled graph*, zeroes a sick expert's
+    # contribution, and renormalizes each token's surviving gate weights
+    # (jnp.where only — jit/vmap-safe, no collectives).  A dead or
+    # NaN-poisoned expert then degrades quality for its tokens instead of
+    # poisoning the whole step.  Masked expert/assignment counts land in
+    # MoEStats (masked_experts / masked_fraction) when collect_stats is
+    # also set, so the flight recorder sees degradation.  Default False:
+    # the hot path is bit-identical to a pre-fault-tolerance build
+    # (asserted by tests/test_chaos.py).
+    degrade_unhealthy_experts: bool = False
+
     # Inference-only: fuse the dispatch gather into the FFN kernel
     # (ops/expert.py:grouped_ffn_tokens — no [E, C, H] HBM buffer).
     # None = auto: follow the FLASHMOE_GATHER_FUSED env var, else stay on

@@ -114,6 +114,32 @@ def drop_report(flight: list[dict]) -> dict:
     }
 
 
+def degradation_report(flight: list[dict]) -> dict:
+    """Tier-0 fault-tolerance timeline (docs/RESILIENCE.md): steps where
+    the expert-health mask fired (``degrade_unhealthy_experts``), with
+    masked-expert counts and masked assignment fractions."""
+    timeline = []
+    for rec in flight:
+        stats = _layer_stats(rec)
+        masked = [float(m["masked_experts"]) for m in stats
+                  if m.get("masked_experts")]
+        frac = [float(m["masked_fraction"]) for m in stats
+                if "masked_fraction" in m]
+        if masked:
+            timeline.append({
+                "step": rec.get("step"),
+                "masked_experts": round(sum(masked), 2),
+                "masked_fraction": round(sum(frac) / len(frac), 6)
+                if frac else None,
+            })
+    return {
+        "steps_with_masking": len(timeline),
+        "max_masked_experts": max((t["masked_experts"] for t in timeline),
+                                  default=0.0),
+        "timeline": timeline,
+    }
+
+
 def phase_report(records: list[dict]) -> dict:
     """Mean of every ``*_ms`` field across records (flight ``step_ms``,
     bench leg timings) plus ``*_ms_p50`` phase timers from metrics
@@ -144,6 +170,7 @@ def summarize(records: list[dict]) -> dict:
         "flight_steps": len(flight),
         "imbalance": imbalance_report(flight),
         "drops": drop_report(flight),
+        "degradation": degradation_report(flight),
         "phases": phase_report(records),
         "drift": drift_report(records),
         "decisions": sorted({r["decision"] for r in records
@@ -180,6 +207,16 @@ def render_text(s: dict) -> str:
             lines.append(f"  step {t['step']}: dropped "
                          f"{t['dropped_fraction']}  capacity util "
                          f"{t['capacity_utilization']}")
+    deg = s.get("degradation", {})
+    if deg.get("steps_with_masking"):
+        lines.append("")
+        lines.append(f"tier-0 degradation: expert-health mask fired on "
+                     f"{deg['steps_with_masking']} steps (max "
+                     f"{deg['max_masked_experts']:g} masked experts)")
+        for t in deg["timeline"][-10:]:
+            lines.append(f"  step {t['step']}: masked "
+                         f"{t['masked_experts']:g} experts, fraction "
+                         f"{t['masked_fraction']}")
     if s["phases"]:
         lines.append("")
         lines.append("phase times (mean):")

@@ -82,6 +82,10 @@ def router_xla(x, gate_w, cfg: MoEConfig) -> RouterOutput:
         gate_w.astype(cfg.accum_dtype),
         preferred_element_type=cfg.accum_dtype,
     )
+    from flashmoe_tpu.chaos import inject as chaos_inject
+
+    if chaos_inject.is_armed("skewed_routing"):  # trace-time check only
+        logits = chaos_inject.poison_logits(logits)
     probs = jax.nn.softmax(logits, axis=-1)
     top_p, top_idx = jax.lax.top_k(probs, cfg.expert_top_k)
     counts = jnp.sum(
@@ -502,6 +506,13 @@ def router(x, gate_w, cfg: MoEConfig, use_pallas: bool = True,
     Differentiable on all paths.  Large-E configs beyond the single-tile
     kernel's VMEM budget (:func:`gate_vmem_bytes`) use the two-pass
     expert-tiled kernel."""
+    from flashmoe_tpu.chaos import inject as chaos_inject
+
+    if chaos_inject.is_armed("skewed_routing") and use_pallas:
+        # the skew fault biases router LOGITS (router_xla hook); the
+        # fused gate kernels compute logits in-kernel, so chaos drills
+        # route through the XLA gate while this point is armed
+        return router_xla(x, gate_w, cfg)
     on_tpu = interpret or jax.default_backend() == "tpu"
     s, h = x.shape
     if not (use_pallas and s % 8 == 0 and on_tpu):

@@ -1,0 +1,142 @@
+"""Tier-0 fault tolerance: in-graph expert-health masking.
+
+The reference has no failure story below the job level — a sick worker's
+NaNs flow straight into the combine's atomicAdd and poison every token it
+touched (SURVEY §5).  The framework-level answer so far
+(:mod:`flashmoe_tpu.runtime.resilient`) aborts the whole step and rewinds
+to a checkpoint, which turns one bad expert into a full-step loss of work.
+
+This module is the cheapest rung of the fault-tolerance ladder: detect a
+non-finite expert output *inside the compiled graph*, zero that expert's
+contribution, and renormalize each affected token's surviving gate
+weights.  A token whose experts are all sick degrades to a zero FFN delta
+(the residual stream carries it through); every other token keeps an
+exact MoE output over its healthy experts.  Everything is ``jnp.where``
+arithmetic — jit/vmap-safe, differentiable, no collectives — and only in
+the graph when ``MoEConfig.degrade_unhealthy_experts`` is set.
+
+Consumers: :mod:`flashmoe_tpu.ops.moe` (capacity + dropless paths),
+:mod:`flashmoe_tpu.parallel.ep`, :mod:`flashmoe_tpu.parallel.fused`, and
+:mod:`flashmoe_tpu.parallel.ragged_ep` apply the mask just before their
+combine; the masked counts thread into :class:`flashmoe_tpu.ops.stats.
+MoEStats` so the flight recorder sees degradation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def expert_health_capacity(ybuf) -> jnp.ndarray:
+    """[E] bool health of a capacity-format expert output [E, C, H].
+
+    An expert is sick iff ANY of its rows carries a non-finite value —
+    the conservative read: one NaN row means the expert's weights or
+    transport are corrupt, and its other rows are not to be trusted.
+    Unoccupied capacity slots are zero-filled by the dispatch, so they
+    can never flag a healthy expert."""
+    return jnp.all(jnp.isfinite(ybuf.astype(jnp.float32)), axis=(-2, -1))
+
+
+def expert_health_tiles(y_rows, tile_gid, num_experts: int,
+                        block_m: int) -> jnp.ndarray:
+    """[E] bool health of a row-grouped buffer [T_pad, H] whose tiles map
+    to experts via ``tile_gid`` [T_pad // block_m] (the ragged/grouped
+    FFN layout).  Tail tiles past the populated segments clamp onto the
+    last expert but hold zeros — finite, so they never flag it."""
+    t = y_rows.shape[0] // block_m
+    tile_ok = jnp.all(
+        jnp.isfinite(y_rows.astype(jnp.float32)).reshape(t, -1), axis=-1
+    )
+    healthy = jnp.ones((num_experts,), jnp.int32)
+    healthy = healthy.at[tile_gid].min(tile_ok.astype(jnp.int32))
+    return healthy.astype(bool)
+
+
+def expert_health_segments(y_rows, counts) -> jnp.ndarray:
+    """[E] bool health of an expert-sorted ragged buffer [N, H] whose
+    per-expert row counts are ``counts`` [E] (rows for expert e occupy
+    the contiguous segment starting at ``cumsum(counts)[e-1]``).  Rows
+    past the populated total are zero padding — finite, harmless even
+    though their segment id clamps onto the last expert."""
+    n = y_rows.shape[0]
+    ends = jnp.cumsum(counts.astype(jnp.int32))
+    row_gid = jnp.searchsorted(
+        ends, jnp.arange(n, dtype=jnp.int32), side="right"
+    ).astype(jnp.int32)
+    row_gid = jnp.clip(row_gid, 0, counts.shape[0] - 1)
+    row_ok = jnp.all(jnp.isfinite(y_rows.astype(jnp.float32)), axis=-1)
+    healthy = jnp.ones((counts.shape[0],), jnp.int32)
+    healthy = healthy.at[row_gid].min(row_ok.astype(jnp.int32))
+    return healthy.astype(bool)
+
+
+def sanitize(y):
+    """Replace non-finite values with 0 — required before any weighted
+    combine of masked outputs, because ``0.0 * nan = nan`` would undo the
+    weight masking."""
+    return jnp.where(jnp.isfinite(y.astype(jnp.float32)), y,
+                     jnp.zeros((), y.dtype))
+
+
+def mask_combine_weights(combine_weights, expert_idx, healthy, *,
+                         renormalize: bool = False):
+    """Zero each (token, k) weight whose expert is sick.
+
+    ``renormalize=True`` additionally rescales each token's surviving
+    weights to unit sum (needed for combines that do not renormalize
+    internally, e.g. :func:`flashmoe_tpu.ops.ragged.ragged_combine`;
+    the capacity :func:`flashmoe_tpu.ops.dispatch.combine` renormalizes
+    over nonzero weights itself).  A token with no healthy expert keeps
+    all-zero weights — its MoE output is exactly zero, never inf/nan.
+    """
+    keep = healthy[expert_idx]  # [S, K] bool
+    w = jnp.where(keep, combine_weights, jnp.zeros((), combine_weights.dtype))
+    if renormalize:
+        # rescale survivors so each token keeps its ORIGINAL total
+        # weight: ratio = sum(w) / sum(kept w).  With every expert
+        # healthy the ratio is x/x = 1.0 exactly (IEEE), so the
+        # all-healthy fast path stays bit-identical to the unmasked one.
+        total = jnp.sum(combine_weights.astype(jnp.float32), axis=-1,
+                        keepdims=True)
+        kept = jnp.sum(w.astype(jnp.float32), axis=-1, keepdims=True)
+        ratio = total / jnp.maximum(kept, 1e-20)
+        w = (w.astype(jnp.float32) * ratio).astype(combine_weights.dtype)
+    return w
+
+
+def degradation_stats(healthy, expert_idx):
+    """(masked_experts, masked_fraction) f32 scalars for MoEStats:
+    the number of sick experts this shard masked, and the fraction of its
+    (token, k) assignments whose contribution was zeroed."""
+    masked_experts = jnp.sum((~healthy).astype(jnp.float32))
+    masked = (~healthy[expert_idx]).astype(jnp.float32)
+    return masked_experts, jnp.mean(masked)
+
+
+def degrade_outputs(ybuf, combine_weights, expert_idx, healthy, *,
+                    renormalize: bool = False):
+    """The one tier-0 masking sequence every layer applies: sanitize the
+    expert outputs, zero the sick experts' combine weights.  Returns
+    (ybuf', combine_weights').  ``renormalize`` as in
+    :func:`mask_combine_weights` — True for combines that do not
+    renormalize internally (the ragged paths)."""
+    return (sanitize(ybuf),
+            mask_combine_weights(combine_weights, expert_idx, healthy,
+                                 renormalize=renormalize))
+
+
+def attach_degradation(stats, healthy, expert_idx, reduce_axes=None):
+    """Fold this shard's degradation counters into a MoEStats tuple.
+    With ``reduce_axes`` (inside a shard_map body) the masked-expert
+    count psums and the assignment fraction pmeans across ranks — the
+    same reduction contract as the rest of the stats."""
+    from flashmoe_tpu.ops.stats import with_degradation
+
+    me, mf = degradation_stats(healthy, expert_idx)
+    if reduce_axes is not None:
+        import jax
+
+        me = jax.lax.psum(me, reduce_axes)
+        mf = jax.lax.pmean(mf, reduce_axes)
+    return with_degradation(stats, me, mf)

@@ -88,6 +88,10 @@ def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
         stats_cap = None if dropless else (
             capacity if capacity is not None else cfg.capacity_for(s))
         stats = moe_stats(r, cfg, stats_cap)
+    degrade = cfg.degrade_unhealthy_experts
+    combine_w = r.combine_weights
+    if degrade:
+        from flashmoe_tpu.ops import health as hlt
     if dropless:
         # dropless: ragged expert-sorted grouping + block-sparse grouped FFN
         # (S*K + E*block rows instead of the capacity path's E*S)
@@ -110,7 +114,14 @@ def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
         else:
             xbuf = rag.ragged_dispatch(x.astype(cfg.dtype), plan, cfg, bm)
             ybuf = exp.grouped_ffn_ad(xbuf, plan.tile_gid, *ffn_tail)
-        out = rag.ragged_combine(ybuf, plan, r.combine_weights, cfg)
+        if degrade:
+            # tier-0 (ops/health.py): ragged_combine does not
+            # renormalize, so the mask renormalizes survivors itself
+            healthy = hlt.expert_health_tiles(ybuf, plan.tile_gid,
+                                              cfg.num_experts, bm)
+            ybuf, combine_w = hlt.degrade_outputs(
+                ybuf, combine_w, r.expert_idx, healthy, renormalize=True)
+        out = rag.ragged_combine(ybuf, plan, combine_w, cfg)
     else:
         # capacity from the ACTUAL token count of this call, not the config's
         # nominal sequence length (callers pass batched shards of any size)
@@ -123,7 +134,6 @@ def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
             ybuf, cap_p = exp.capacity_ffn_gather(
                 x.astype(cfg.dtype), plan, cfg, cap, params,
                 interpret=interpret)
-            out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap_p)
         else:
             xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)
             if use_pallas:
@@ -131,7 +141,20 @@ def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
                                                   interpret=interpret)
             else:
                 ybuf = exp.expert_ffn_dense(xbuf, params, cfg)
-            out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)
+            cap_p = cap
+        from flashmoe_tpu.chaos import inject as chaos_inject
+
+        if chaos_inject.is_armed("nan_expert"):  # trace-time check only
+            ybuf = chaos_inject.poison_expert(ybuf)
+        if degrade:
+            # tier-0 (ops/health.py): dsp.combine renormalizes the
+            # surviving weights itself
+            healthy = hlt.expert_health_capacity(ybuf)
+            ybuf, combine_w = hlt.degrade_outputs(ybuf, combine_w,
+                                                  r.expert_idx, healthy)
+        out = dsp.combine(ybuf, plan, combine_w, cfg, cap_p)
+    if degrade and stats is not None:
+        stats = hlt.attach_degradation(stats, healthy, r.expert_idx)
     if cfg.num_shared_experts:
         out = out + shared_expert_ffn(x.astype(cfg.dtype), params, cfg).astype(
             out.dtype

@@ -44,6 +44,13 @@ class MoEStats(NamedTuple):
                           selection distribution.  ln(E) = uniform.
     topk_confidence:      [] mean normalized weight of each token's
                           top-1 expert (1.0 = the top expert takes all).
+    masked_experts:       [] tier-0 degradation (ops/health.py): sick
+                          (non-finite-output) experts masked this step —
+                          per-rank contributions summed across ep ranks,
+                          0.0 unless ``degrade_unhealthy_experts`` fired.
+    masked_fraction:      [] fraction of (token, k) assignments whose
+                          expert contribution was zeroed by the tier-0
+                          mask (0.0 when every expert is healthy).
     """
 
     expert_load: jnp.ndarray
@@ -52,6 +59,8 @@ class MoEStats(NamedTuple):
     imbalance: jnp.ndarray
     router_entropy: jnp.ndarray
     topk_confidence: jnp.ndarray
+    masked_experts: jnp.ndarray
+    masked_fraction: jnp.ndarray
 
 
 def load_imbalance(expert_load) -> jnp.ndarray:
@@ -112,6 +121,7 @@ def moe_stats(router_out, cfg: MoEConfig, capacity: int | None
     # token's strongest expert, pre-normalized over the k survivors
     conf = jnp.mean(router_out.combine_weights[..., 0].astype(jnp.float32),
                     axis=-1)
+    zero = jnp.zeros(dropped.shape, jnp.float32)
     return MoEStats(
         expert_load=load,
         dropped_fraction=dropped,
@@ -119,6 +129,21 @@ def moe_stats(router_out, cfg: MoEConfig, capacity: int | None
         imbalance=load_imbalance(load),
         router_entropy=router_entropy(router_out.probs_mean, load),
         topk_confidence=conf,
+        # tier-0 degradation counters: filled in by the layer via
+        # with_degradation() after its health check runs (the check needs
+        # the expert OUTPUTS, which do not exist yet at routing time)
+        masked_experts=zero,
+        masked_fraction=zero,
+    )
+
+
+def with_degradation(stats: MoEStats, masked_experts,
+                     masked_fraction) -> MoEStats:
+    """Attach tier-0 degradation counters (ops/health.py) to a stats
+    tuple — a plain _replace, split out so layers read declaratively."""
+    return stats._replace(
+        masked_experts=jnp.asarray(masked_experts, jnp.float32),
+        masked_fraction=jnp.asarray(masked_fraction, jnp.float32),
     )
 
 
@@ -143,6 +168,12 @@ def reduce_stats(local: MoEStats, probs_mean, reduce_axes) -> MoEStats:
         imbalance=load_imbalance(g_load),
         router_entropy=router_entropy(g_probs, g_load),
         topk_confidence=jax.lax.pmean(local.topk_confidence, reduce_axes),
+        # tier-0 degradation counters pass through untouched: they are
+        # zeros unless degrade_unhealthy_experts is on, and the layer
+        # reduces them itself in that case — reducing constants here
+        # would add two collectives to every stats-on graph for nothing
+        masked_experts=local.masked_experts,
+        masked_fraction=local.masked_fraction,
     )
 
 
@@ -163,4 +194,6 @@ def stats_to_host(stats: MoEStats) -> dict:
         "imbalance": float(host.imbalance),
         "router_entropy": float(host.router_entropy),
         "topk_confidence": float(host.topk_confidence),
+        "masked_experts": float(host.masked_experts),
+        "masked_fraction": float(host.masked_fraction),
     }

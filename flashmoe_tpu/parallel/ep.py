@@ -163,8 +163,23 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
             )  # [D, nLx, C, H] — dim 0 indexes expert-owner rank
     ybuf = yback.reshape(e, cap, h)
 
+    from flashmoe_tpu.chaos import inject as chaos_inject
+
+    if chaos_inject.is_armed("nan_expert"):  # trace-time check only
+        ybuf = chaos_inject.poison_expert(ybuf)
+    healthy = None
+    combine_w = r.combine_weights
+    if cfg.degrade_unhealthy_experts:
+        # tier-0 (ops/health.py): ybuf rows are THIS rank's tokens'
+        # results per global expert, so each rank detects and masks its
+        # own exposure to a sick expert locally — no extra collective
+        from flashmoe_tpu.ops import health as hlt
+
+        healthy = hlt.expert_health_capacity(ybuf)
+        ybuf, combine_w = hlt.degrade_outputs(ybuf, combine_w,
+                                              r.expert_idx, healthy)
     with trace_span("moe.combine"):
-        out = dsp.combine(ybuf, plan, r.combine_weights, cfg, cap)
+        out = dsp.combine(ybuf, plan, combine_w, cfg, cap)
         if cfg.num_shared_experts:
             out = out + shared_expert_ffn(
                 x.astype(cfg.dtype), params, cfg
@@ -177,6 +192,11 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
     if cfg.collect_stats:
         local = st.moe_stats(r, cfg, cap)
         stats = st.reduce_stats(local, r.probs_mean, reduce_axes)
+        if healthy is not None:
+            from flashmoe_tpu.ops import health as hlt
+
+            stats = hlt.attach_degradation(stats, healthy, r.expert_idx,
+                                           reduce_axes)
     return MoEOutput(out.astype(cfg.dtype), aux, z, counts, stats)
 
 
@@ -277,19 +297,28 @@ def auto_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
     ragged / fused RDMA) is chosen by :func:`resolve_moe_backend` for
     this (cfg, mesh) instead of being hard-coded by the caller."""
     backend = resolve_moe_backend(cfg, mesh)
-    if backend == "fused":
-        from flashmoe_tpu.parallel.fused import fused_ep_moe_layer
+    try:
+        if backend == "fused":
+            from flashmoe_tpu.parallel.fused import fused_ep_moe_layer
 
-        return fused_ep_moe_layer(params, x, cfg, mesh,
-                                  token_axes=token_axes,
-                                  collective_id=collective_id,
-                                  interpret=interpret)
-    if backend == "ragged":
-        from flashmoe_tpu.parallel.ragged_ep import ragged_ep_moe_layer
+            return fused_ep_moe_layer(params, x, cfg, mesh,
+                                      token_axes=token_axes,
+                                      collective_id=collective_id,
+                                      interpret=interpret)
+        if backend == "ragged":
+            from flashmoe_tpu.parallel.ragged_ep import ragged_ep_moe_layer
 
-        return ragged_ep_moe_layer(params, x, cfg, mesh,
-                                   use_pallas=use_pallas,
-                                   interpret=interpret,
-                                   token_axes=token_axes)
+            return ragged_ep_moe_layer(params, x, cfg, mesh,
+                                       use_pallas=use_pallas,
+                                       interpret=interpret,
+                                       token_axes=token_axes)
+    except Exception as e:  # noqa: BLE001 — tier-2 path fallback
+        # a specialized transport failing at trace time demotes to the
+        # collective baseline (and is remembered, so the next resolution
+        # never retries it) instead of killing the step — the RaMP-style
+        # runtime path polymorphism of docs/RESILIENCE.md
+        from flashmoe_tpu.planner.select import report_path_failure
+
+        report_path_failure(backend, f"{type(e).__name__}: {e}")
     return ep_moe_layer(params, x, cfg, mesh, use_pallas=use_pallas,
                         token_axes=token_axes, interpret=interpret)

@@ -1463,7 +1463,12 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
              if cfg.gated_ffn else None),
         )
         i_dim = params["w_down"].shape[1]
-        if _fuse_combine_enabled(cfg, s_loc, h, i_dim, cap_pad, d):
+        # tier-0 degradation needs the per-expert outputs BEFORE the
+        # weighted combine, so the in-kernel (fused) combine is
+        # incompatible with it — degrade forces the XLA combine branch
+        # (same math, explicit ybuf)
+        if (_fuse_combine_enabled(cfg, s_loc, h, i_dim, cap_pad, d)
+                and not cfg.degrade_unhealthy_experts):
             kk = cfg.expert_top_k
             cu = _combine_chunk_rows(kk)
             rows_pad = -(-(s_loc * kk) // (cu * kk)) * (cu * kk)
@@ -1493,8 +1498,17 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
                 )
             with trace_span("moe.combine"):
                 ybuf = y_recv.reshape(cfg.num_experts, cap_pad, h)
-                out = dsp.combine(ybuf, plan, r.combine_weights, cfg,
-                                  cap_pad)
+                combine_w = r.combine_weights
+                if cfg.degrade_unhealthy_experts:
+                    # tier-0 (ops/health.py): same per-rank masking as the
+                    # collective layer — ybuf rows are this rank's tokens'
+                    # results per global expert
+                    from flashmoe_tpu.ops import health as hlt
+
+                    healthy = hlt.expert_health_capacity(ybuf)
+                    ybuf, combine_w = hlt.degrade_outputs(
+                        ybuf, combine_w, r.expert_idx, healthy)
+                out = dsp.combine(ybuf, plan, combine_w, cfg, cap_pad)
         if cfg.num_shared_experts:
             out = out + shared_expert_ffn(
                 x.astype(cfg.dtype), params, cfg
@@ -1510,6 +1524,11 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
             # applies verbatim
             local = st.moe_stats(r, cfg, cap)
             stats = st.reduce_stats(local, r.probs_mean, token_axes)
+            if cfg.degrade_unhealthy_experts:
+                from flashmoe_tpu.ops import health as hlt
+
+                stats = hlt.attach_degradation(stats, healthy,
+                                               r.expert_idx, token_axes)
         return MoEOutput(out.astype(cfg.dtype), aux, z, counts, stats)
 
     pspecs = {k: P("ep") if k != "gate_w" and not k.startswith("shared")

@@ -246,7 +246,19 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
         ys = jax.lax.fori_loop(0, d, compact_y, ys)
 
     # ---- combine in the original expert-sorted layout ----
-    out = rag.ragged_combine(ys, plan, r.combine_weights, cfg)
+    healthy = None
+    combine_w = r.combine_weights
+    if cfg.degrade_unhealthy_experts:
+        # tier-0 (ops/health.py): ys is expert-sorted by GLOBAL expert
+        # with per-expert row counts in plan.counts (block-1 layout:
+        # padded == exact), so segment health maps rows -> experts; the
+        # ragged combine does not renormalize, so the mask does
+        from flashmoe_tpu.ops import health as hlt
+
+        healthy = hlt.expert_health_segments(ys, plan.counts)
+        ys, combine_w = hlt.degrade_outputs(
+            ys, combine_w, r.expert_idx, healthy, renormalize=True)
+    out = rag.ragged_combine(ys, plan, combine_w, cfg)
 
     aux = jax.lax.pmean(r.aux_loss, reduce_axes) * cfg.aux_loss_coef
     z = jax.lax.pmean(r.z_loss, reduce_axes)
@@ -256,6 +268,11 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
         # dropless: capacity=None reports zero drops / full utilization
         local = st.moe_stats(r, cfg, None)
         stats = st.reduce_stats(local, r.probs_mean, reduce_axes)
+        if healthy is not None:
+            from flashmoe_tpu.ops import health as hlt
+
+            stats = hlt.attach_degradation(stats, healthy, r.expert_idx,
+                                           reduce_axes)
     return MoEOutput(out.astype(cfg.dtype), aux, z, cnts, stats)
 
 

@@ -37,6 +37,51 @@ from flashmoe_tpu.planner.model import PathPrediction, predict_paths
 from flashmoe_tpu.utils.telemetry import metrics
 
 
+class PathFailure(RuntimeError):
+    """A selected execution path failed at trace/compile/run time.
+
+    Carries the backend so recovery layers (``auto_ep_moe_layer``,
+    :func:`flashmoe_tpu.runtime.resilient.resilient_train`) can report
+    it via :func:`report_path_failure` and re-resolve onto the next-best
+    path instead of dying on a path the planner merely *predicted* would
+    work."""
+
+    def __init__(self, backend: str, reason: str = ""):
+        super().__init__(reason or f"execution path {backend!r} failed")
+        self.backend = backend
+        self.reason = reason
+
+
+# Backends observed failing this process — consulted (and demoted away
+# from) by every subsequent 'auto' resolution.  'collective' is never
+# blacklisted: it is the robust baseline every config can run.
+_FAILED_BACKENDS: set[str] = set()
+
+
+def failed_backends() -> frozenset[str]:
+    return frozenset(_FAILED_BACKENDS)
+
+
+def report_path_failure(backend: str, reason: str = "") -> None:
+    """Record a path failure and demote the backend for the rest of the
+    process: future ``moe_backend='auto'`` resolutions skip it (runtime
+    path polymorphism, docs/RESILIENCE.md — demote to a healthy path,
+    don't die).  Logged as a ``planner.fallback`` decision so
+    postmortems see WHY the path changed mid-run."""
+    metrics.decision("planner.fallback", failed=backend,
+                     reason=reason or None, phase="report")
+    if backend not in ("collective", "local", None):
+        _FAILED_BACKENDS.add(backend)
+        _cached_backend.cache_clear()
+
+
+def reset_path_failures() -> None:
+    """Forget reported failures (tests / chaos drills)."""
+    if _FAILED_BACKENDS:
+        _FAILED_BACKENDS.clear()
+        _cached_backend.cache_clear()
+
+
 @dataclasses.dataclass(frozen=True)
 class Selection:
     """The planner's verdict for one (cfg, d, gen) point."""
@@ -170,6 +215,20 @@ def _cached_backend(cfg: MoEConfig, d: int, gen: str, slices: int) -> str:
         return "collective"
     sel = select_path(cfg, d, gen, slices=slices)
     backend = sel.backend
+    if backend in _FAILED_BACKENDS:
+        # path fallback: the predicted winner already failed in this
+        # process; demote to the fastest feasible prediction on a
+        # still-healthy backend, bottoming out at the collective layer
+        ranked = sorted((p for p in sel.predictions if p.feasible),
+                        key=lambda p: p.total_ms)
+        alt = next((p for p in ranked
+                    if p.backend not in _FAILED_BACKENDS), None)
+        new_backend = alt.backend if alt is not None else "collective"
+        metrics.decision(
+            "planner.fallback", failed=backend, backend=new_backend,
+            winner=(alt.path if alt is not None else "collective"),
+            phase="resolve", d=d, gen=gen)
+        backend = new_backend
     if backend == "ragged" and cfg.num_shared_experts:
         # the ragged layer cannot host shared experts; the demotion is
         # its own telemetry record so the path_select breakdown never

@@ -1,69 +1,310 @@
-"""Checkpoint / resume.
+"""Checkpoint / resume with integrity verification.
 
 The reference has none (SURVEY §5: weights are caller-provided tensors, no
 optimizer, nothing to save).  A training framework needs it, so this module
 provides orbax-backed save/restore of the :class:`TrainState` (params +
 optimizer moments + step), preserving shardings on restore — multi-host
 safe (orbax coordinates the write across processes).
+
+Tier-2 fault tolerance (docs/RESILIENCE.md) hardens the job-level rung:
+
+  * one :class:`ocp.CheckpointManager` is cached per directory and reused
+    across save/latest_step/restore — constructing (and closing) a fresh
+    manager per call put manager setup latency in the training hot loop;
+  * every save writes a ``manifest-<step>.json`` next to the step dir:
+    per-file sizes + CRC32 content checksums;
+  * :func:`verify` recomputes the checksums; :func:`restore` verifies
+    BEFORE handing bytes to orbax and, on corruption, falls back to the
+    newest *intact* older step (recorded as a ``checkpoint.fallback``
+    telemetry decision) instead of resuming from garbage;
+  * :func:`emergency_save` best-effort persists the last good state when
+    a run aborts, never raising into the abort path.
 """
 
 from __future__ import annotations
 
+import glob
+import json
 import os
+import zlib
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
 
 from flashmoe_tpu.runtime.trainer import TrainState
+from flashmoe_tpu.utils.telemetry import metrics as _telemetry
 
 
-def _manager(directory: str, max_to_keep: int = 3) -> ocp.CheckpointManager:
-    return ocp.CheckpointManager(
-        os.path.abspath(directory),
-        options=ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep, create=True,
-        ),
-    )
+class CheckpointCorruptionError(RuntimeError):
+    """No intact checkpoint could be restored from the directory."""
 
+
+# ----------------------------------------------------------------------
+# Manager cache
+# ----------------------------------------------------------------------
+
+_MANAGERS: dict[str, ocp.CheckpointManager] = {}
+
+# retained checkpoints per directory; a module constant rather than a
+# _manager() parameter because the manager is cached per directory — a
+# per-call value would silently bind only the FIRST caller's choice
+MAX_TO_KEEP = 3
+
+
+def _manager(directory: str) -> ocp.CheckpointManager:
+    """The directory's cached manager (one per abspath, reused across
+    every save/query/restore — satellite fix: the old per-call
+    construct-then-close put manager setup in the hot loop)."""
+    key = os.path.abspath(directory)
+    mgr = _MANAGERS.get(key)
+    if mgr is None:
+        mgr = _MANAGERS[key] = ocp.CheckpointManager(
+            key,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=MAX_TO_KEEP, create=True,
+            ),
+        )
+    return mgr
+
+
+def _payload(state: TrainState) -> dict:
+    """The orbax save/restore dict for a state.  A ``None`` guard (the
+    tier-1 feature is off) is OMITTED: guard-free states keep the
+    pre-guard 3-key on-disk layout, so checkpoints written before the
+    guard existed stay restorable and vice versa."""
+    d = state._asdict()
+    if d.get("guard") is None:
+        d.pop("guard", None)
+    return d
+
+
+def close_manager(directory: str) -> None:
+    """Close and drop the directory's cached manager (tests / shutdown)."""
+    mgr = _MANAGERS.pop(os.path.abspath(directory), None)
+    if mgr is not None:
+        mgr.close()
+
+
+def close_all_managers() -> None:
+    for key in list(_MANAGERS):
+        close_manager(key)
+
+
+# ----------------------------------------------------------------------
+# Integrity manifests
+# ----------------------------------------------------------------------
+
+def step_dir(directory: str, step: int) -> str:
+    """The orbax step directory holding one checkpoint's payload."""
+    return os.path.join(os.path.abspath(directory), str(step))
+
+
+def _manifest_path(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory),
+                        f"manifest-{step}.json")
+
+
+def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return crc
+            crc = zlib.crc32(b, crc)
+
+
+def _walk_payload(root: str) -> dict[str, dict]:
+    out: dict[str, dict] = {}
+    for base, _dirs, files in os.walk(root):
+        for name in files:
+            p = os.path.join(base, name)
+            rel = os.path.relpath(p, root)
+            out[rel] = {"size": os.path.getsize(p),
+                        "crc32": _file_crc32(p)}
+    return out
+
+
+def write_manifest(directory: str, step: int) -> str:
+    """Checksum every file under the step dir into manifest-<step>.json.
+    Called by :func:`save` after the write lands; returns the path."""
+    root = step_dir(directory, step)
+    manifest = {"step": step, "files": _walk_payload(root)}
+    path = _manifest_path(directory, step)
+    # per-process tmp name + atomic replace: even if two writers race
+    # (they should not — save() gates on process 0), no reader ever sees
+    # a torn manifest, and torn == corrupt would trigger a false fallback
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, path)
+    return path
+
+
+def verify(directory: str, step: int) -> bool:
+    """Recompute the step's content checksums against its manifest.
+
+    False on any missing/resized/bit-flipped file or an unreadable
+    manifest.  A checkpoint WITHOUT a manifest (written by an older
+    build) verifies True — unverifiable is not the same as corrupt, and
+    rejecting legacy checkpoints would turn an upgrade into data loss.
+    """
+    root = step_dir(directory, step)
+    if not os.path.isdir(root):
+        return False
+    mpath = _manifest_path(directory, step)
+    if not os.path.exists(mpath):
+        return True  # legacy checkpoint: no integrity claim to check
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return False
+    want = manifest.get("files", {})
+    have = _walk_payload(root)
+    if set(want) != set(have):
+        return False
+    return all(have[rel] == meta for rel, meta in want.items())
+
+
+def _prune_stale_manifests(directory: str) -> None:
+    """Drop manifests for steps the manager's max_to_keep GC removed."""
+    keep = {str(s) for s in _manager(directory).all_steps()}
+    for path in glob.glob(os.path.join(os.path.abspath(directory),
+                                       "manifest-*.json")):
+        step = os.path.basename(path)[len("manifest-"):-len(".json")]
+        if step not in keep:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
+# ----------------------------------------------------------------------
+# Save / restore
+# ----------------------------------------------------------------------
 
 def save(directory: str, state: TrainState, step: int | None = None,
          wait: bool = True) -> int:
     """Save a checkpoint; returns the step it was saved under."""
     step = int(state.step) if step is None else step
     mgr = _manager(directory)
-    mgr.save(step, args=ocp.args.StandardSave(state._asdict()))
+    mgr.save(step, args=ocp.args.StandardSave(_payload(state)))
     if wait:
         mgr.wait_until_finished()
-    mgr.close()
+        # manifest bookkeeping is single-writer: orbax coordinates the
+        # array write across hosts, but the manifest is plain JSON on a
+        # shared directory — every process writing it would race
+        if jax.process_index() == 0:
+            write_manifest(directory, step)
+            _prune_stale_manifests(directory)
     return step
 
 
 def latest_step(directory: str) -> int | None:
     if not os.path.isdir(directory):
         return None
-    mgr = _manager(directory)
-    step = mgr.latest_step()
-    mgr.close()
-    return step
+    return _manager(directory).latest_step()
+
+
+def intact_steps(directory: str) -> list[int]:
+    """All steps whose payload verifies, newest last."""
+    if not os.path.isdir(directory):
+        return []
+    return [s for s in sorted(_manager(directory).all_steps())
+            if verify(directory, s)]
 
 
 def restore(directory: str, template: TrainState,
-            step: int | None = None) -> TrainState:
+            step: int | None = None, *, check_integrity: bool = True,
+            fallback: bool = True) -> TrainState:
     """Restore into the template's structure/shardings.
 
     ``template`` is a TrainState of the right pytree structure (e.g. from
     ``init_state`` + ``device_put`` with shardings); restored arrays land
     with the template's shardings.
+
+    With ``check_integrity`` the requested step is checksum-verified
+    first; on corruption, ``fallback`` retries the newest older INTACT
+    step (a ``checkpoint.fallback`` telemetry decision records the
+    demotion) and :class:`CheckpointCorruptionError` is raised only when
+    nothing intact remains.
     """
     mgr = _manager(directory)
-    step = step if step is not None else mgr.latest_step()
-    if step is None:
+    want = step if step is not None else mgr.latest_step()
+    if want is None:
         raise FileNotFoundError(f"no checkpoint in {directory}")
 
-    restored = mgr.restore(
-        step,
-        args=ocp.args.StandardRestore(template._asdict()),
-    )
-    mgr.close()
+    chosen = want
+    if check_integrity and not verify(directory, want):
+        # only older steps are candidates — and only they get (re)hashed;
+        # re-verifying ``want`` via intact_steps would checksum the known-
+        # corrupt payload a second time on the recovery hot path
+        older = [s for s in sorted(mgr.all_steps())
+                 if s < want and verify(directory, s)]
+        if not fallback or not older:
+            raise CheckpointCorruptionError(
+                f"checkpoint step {want} in {directory} failed integrity "
+                f"verification and no intact older step exists")
+        chosen = older[-1]
+        _telemetry.decision(
+            "checkpoint.fallback", directory=os.path.abspath(directory),
+            corrupt_step=want, restored_step=chosen,
+            lost_steps=want - chosen)
+
+    tmpl = _payload(template)
+    try:
+        restored = mgr.restore(chosen, args=ocp.args.StandardRestore(tmpl))
+    except Exception:
+        if "guard" not in tmpl:
+            raise
+        # guard-carrying template, pre-guard checkpoint (no 'guard'
+        # subtree on disk): restore the 3-key payload and seed a FRESH
+        # GuardState — the EMA re-warms, nothing else is lost
+        tmpl = {k: v for k, v in tmpl.items() if k != "guard"}
+        restored = mgr.restore(chosen, args=ocp.args.StandardRestore(tmpl))
+        restored = dict(restored, guard=_fresh_guard(template.guard))
+    # a guard-free payload has no 'guard' key; the field defaults to None
     return TrainState(**restored)
+
+
+def _fresh_guard(template_guard):
+    """A newly initialized GuardState placed onto the template's
+    shardings (when it carries any)."""
+    from flashmoe_tpu.runtime.trainer import init_guard_state
+
+    fresh = init_guard_state()
+    try:
+        return jax.tree_util.tree_map(
+            lambda f, t: (jax.device_put(f, t.sharding)
+                          if getattr(t, "sharding", None) is not None
+                          else f),
+            fresh, template_guard)
+    except Exception:  # abstract/mismatched template: plain host arrays
+        return fresh
+
+
+def emergency_save(directory: str, state: TrainState) -> int | None:
+    """Best-effort save for abort paths: persists ``state`` unless its
+    step is already on disk; swallows every error (the caller is already
+    crashing — the emergency copy must never mask the original fault).
+    Returns the saved step, or None."""
+    try:
+        # refuse donated/deleted buffers UP FRONT: the jitted step donates
+        # its input state, so an abort right after a dispatched failure
+        # can hand us dead arrays — starting an orbax save with them
+        # would leave a half-written step dir, worse than saving nothing
+        for leaf in jax.tree_util.tree_leaves(state):
+            if getattr(leaf, "is_deleted", None) and leaf.is_deleted():
+                return None
+        step = int(state.step)
+        if latest_step(directory) == step:
+            return None
+        saved = save(directory, state, step=step)
+        _telemetry.decision("checkpoint.emergency_save",
+                            directory=os.path.abspath(directory),
+                            step=saved)
+        return saved
+    except Exception:  # noqa: BLE001 — abort path, never re-raise
+        return None

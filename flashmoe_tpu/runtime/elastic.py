@@ -49,13 +49,19 @@ def fold_parallelism(cfg: MoEConfig, n_devices: int) -> MoEConfig:
 
 
 def elastic_resume(cfg: MoEConfig, checkpoint_dir: str, *,
-                   devices=None, optimizer=None, total_steps: int = 10000):
+                   devices=None, optimizer=None, total_steps: int = 10000,
+                   guard=None):
     """Rebuild mesh + shardings for the current device set and restore the
     latest checkpoint into them.
 
     Returns (state, mesh, cfg', optimizer).  The restored arrays land
     resharded over the NEW mesh regardless of the world size that wrote
     the checkpoint.
+
+    ``guard``: pass the job's :class:`flashmoe_tpu.runtime.trainer.
+    GradGuardConfig` when the checkpoint was written by a tier-1 guarded
+    step — the restore template must carry the matching GuardState
+    subtree (docs/RESILIENCE.md).
     """
     devices = list(devices if devices is not None else jax.devices())
     cfg = fold_parallelism(cfg, len(devices))
@@ -67,7 +73,8 @@ def elastic_resume(cfg: MoEConfig, checkpoint_dir: str, *,
         raise FileNotFoundError(f"no checkpoint in {checkpoint_dir}")
     # abstract template only — never materialize a second copy of the model
     template = jax.eval_shape(
-        lambda: init_state(jax.random.PRNGKey(0), cfg, optimizer)
+        lambda: init_state(jax.random.PRNGKey(0), cfg, optimizer,
+                           guard=guard)
     )
     shardings = state_shardings(template, cfg, mesh)
     abstract = jax.tree_util.tree_map(

@@ -8,14 +8,24 @@ This module provides the framework-level equivalent capability and more:
   * **detection** — every step is bounded by a wall-clock deadline and its
     loss is checked finite; a hung collective, a device error (XLA raises),
     or a NaN/inf step all count as failures;
-  * **recovery** — state restores from the latest orbax checkpoint and
+  * **recovery** — state restores from the latest *intact* orbax
+    checkpoint (integrity-verified, tier 2 of docs/RESILIENCE.md) and
     training resumes; transient failures are retried up to a budget,
-    repeated failures at the same step abort with a diagnosis;
+    repeated failures at the same step abort with a diagnosis (after a
+    best-effort emergency save of the last good state);
+  * **exact replay** — batches consumed since the last checkpoint are
+    buffered, so a retried step re-trains on the SAME data the failed
+    attempt saw (rewinding only the model, not the data stream, silently
+    diverged the replayed run before this);
+  * **path fallback** — a :class:`flashmoe_tpu.planner.select.PathFailure`
+    escaping a step demotes the failed execution path for the rest of the
+    process (``planner.fallback`` decision) before the retry;
   * **periodic checkpointing** — bounded loss-of-work window.
 
-Single-process recovery is fully testable (failures injected in tests);
-multi-host recovery composes with the cluster scheduler restarting dead
-processes and every process restoring from the shared checkpoint directory.
+Single-process recovery is fully testable (failures injected in tests and
+by :mod:`flashmoe_tpu.chaos`); multi-host recovery composes with the
+cluster scheduler restarting dead processes and every process restoring
+from the shared checkpoint directory.
 """
 
 from __future__ import annotations
@@ -43,6 +53,10 @@ class ResilienceConfig:
     checkpoint_every: int = 50
     step_timeout_s: float | None = None  # None = no deadline
     max_retries: int = 3
+    # tier-2 hardening knobs (docs/RESILIENCE.md); defaults preserve the
+    # strongest behavior — flip off only to reproduce legacy semantics
+    verify_checkpoints: bool = True   # checksum-verify before restore
+    emergency_save: bool = True       # persist last good state on abort
 
 
 def _run_step(step_fn, state, batch, timeout_s):
@@ -69,6 +83,64 @@ def _run_step(step_fn, state, batch, timeout_s):
         ex.shutdown(wait=False)
 
 
+def scalar_metrics(m: dict) -> dict:
+    """History-safe view of a step's metrics: scalars to floats,
+    non-scalars (e.g. per-expert MoEStats arrays when collect_stats is
+    on) skipped — ``float(v)`` on an [E]-shaped array raised mid-recovery
+    before this guard existed."""
+    out = {}
+    for k, v in m.items():
+        try:
+            if np.asarray(v).size == 1:
+                out[k] = float(np.asarray(v).reshape(()))
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def _step_loss(m: dict) -> float | None:
+    """The step's scalar loss, or None when absent/non-scalar — a custom
+    step_fn without a 'loss' key must not KeyError the recovery loop."""
+    v = m.get("loss")
+    if v is None:
+        return None
+    try:
+        a = np.asarray(v)
+        return float(a.reshape(())) if a.size == 1 else None
+    except (TypeError, ValueError):
+        return None
+
+
+class _ReplayBuffer:
+    """Batches consumed since the last durable checkpoint, keyed by step.
+
+    On rewind, steps re-execute against the EXACT batch the failed
+    attempt consumed instead of silently pulling fresh data (the replay-
+    divergence bug: retried steps trained on different batches than the
+    history claimed).  Memory is bounded by ``2 * checkpoint_every``
+    batches: pruning lags one checkpoint so a corruption-fallback
+    restore to the PREVIOUS intact checkpoint still replays bit-exact.
+    """
+
+    def __init__(self, data_iter: Iterator):
+        self._it = data_iter
+        self._buf: dict[int, object] = {}
+
+    def batch_for(self, step: int):
+        b = self._buf.get(step)
+        if b is None:
+            b = next(self._it)
+            self._buf[step] = b
+        return b
+
+    def prune_before(self, step: int):
+        for s in [s for s in self._buf if s < step]:
+            del self._buf[s]
+
+    def __len__(self):
+        return len(self._buf)
+
+
 def resilient_train(state: TrainState, step_fn: Callable,
                     data_iter: Iterator, num_steps: int,
                     rcfg: ResilienceConfig | None = None,
@@ -78,10 +150,12 @@ def resilient_train(state: TrainState, step_fn: Callable,
 
     ``step_fn(state, batch) -> (state, metrics_dict)`` — e.g. from
     :func:`flashmoe_tpu.runtime.trainer.make_train_step`.
-    ``fail_injector(step_idx)`` may raise, for tests/chaos drills.
+    ``fail_injector(step_idx)`` may raise, for tests/chaos drills
+    (:func:`flashmoe_tpu.chaos.make_injector`).
 
     Returns (state, history).  Raises :class:`StepFailure` after
-    ``max_retries`` consecutive failures on one step.
+    ``max_retries`` consecutive failures on one step (after a best-effort
+    emergency checkpoint of the last good state).
     """
     rcfg = rcfg or ResilienceConfig()
     metrics = metrics or Metrics()
@@ -90,7 +164,8 @@ def resilient_train(state: TrainState, step_fn: Callable,
     # resume if a checkpoint exists
     start = ckpt.latest_step(rcfg.checkpoint_dir)
     if start is not None and start > int(state.step):
-        state = ckpt.restore(rcfg.checkpoint_dir, state)
+        state = ckpt.restore(rcfg.checkpoint_dir, state,
+                             check_integrity=rcfg.verify_checkpoints)
         metrics.count("resumes")
 
     i = int(state.step)
@@ -116,24 +191,53 @@ def resilient_train(state: TrainState, step_fn: Callable,
         state, shardings,
     )
     safe_state = jax.device_get(state)
+    replay = _ReplayBuffer(data_iter)
+    prev_ckpt_step = None  # pruning lags one checkpoint (see below)
     while i < num_steps:
-        batch = next(data_iter)
+        # replay-exact data: a rewound step gets the batch its failed
+        # attempt consumed, not the iterator's next fresh one
+        batch = replay.batch_for(i)
         try:
             if fail_injector is not None:
                 fail_injector(i)
             t0 = time.perf_counter()
             new_state, m = _run_step(step_fn, state, batch,
                                      rcfg.step_timeout_s)
-            loss = float(m["loss"])
-            if not np.isfinite(loss):
+            loss = _step_loss(m)
+            if loss is not None and not np.isfinite(loss):
                 raise StepFailure(f"non-finite loss at step {i}: {loss}")
         except Exception as e:  # timeout, NaN, device error, injected fault
             metrics.count("failures")
+            from flashmoe_tpu.planner.select import (
+                PathFailure, report_path_failure,
+            )
+
+            if isinstance(e, PathFailure):
+                # tier-2 path fallback: demote the failed execution path
+                # BEFORE retrying, so the retry re-resolves onto a
+                # healthy one instead of re-tracing the same failure
+                report_path_failure(e.backend, str(e))
+                metrics.count("path_fallbacks")
             if i == last_fail_step:
                 retries += 1
             else:
                 retries, last_fail_step = 1, i
             if retries > rcfg.max_retries:
+                if rcfg.emergency_save:
+                    # persist the last good state.  ``state`` may hold
+                    # DONATED buffers (a dispatched attempt consumed them
+                    # before failing) — emergency_save refuses those, and
+                    # we then fall back to the undonated host mirror.
+                    # Once a periodic checkpoint exists the mirror is
+                    # gone, but so is the need: the disk copy IS the
+                    # recovery point.
+                    saved = ckpt.emergency_save(rcfg.checkpoint_dir, state)
+                    if saved is None and safe_state is not None:
+                        saved = ckpt.emergency_save(
+                            rcfg.checkpoint_dir,
+                            jax.device_put(safe_state, shardings))
+                    if saved is not None:
+                        metrics.count("emergency_saves")
                 raise StepFailure(
                     f"step {i} failed {retries} times; last error: {e}"
                 ) from e
@@ -141,7 +245,24 @@ def resilient_train(state: TrainState, step_fn: Callable,
             if last is not None:
                 template = (jax.device_put(safe_state, shardings)
                             if safe_state is not None else abstract)
-                state = ckpt.restore(rcfg.checkpoint_dir, template)
+                try:
+                    state = ckpt.restore(
+                        rcfg.checkpoint_dir, template,
+                        check_integrity=rcfg.verify_checkpoints)
+                except ckpt.CheckpointCorruptionError as ce:
+                    # NOTHING intact on disk.  The in-memory mirror (if
+                    # it still exists) is the recovery point of last
+                    # resort; otherwise this run is unrecoverable — keep
+                    # the documented StepFailure contract rather than
+                    # leaking the corruption error past the retry logic
+                    if safe_state is not None:
+                        state = jax.device_put(safe_state, shardings)
+                    else:
+                        if rcfg.emergency_save:
+                            ckpt.emergency_save(rcfg.checkpoint_dir, state)
+                        raise StepFailure(
+                            f"step {i} failed and no intact checkpoint "
+                            f"remains: {ce}") from ce
             else:
                 state = jax.device_put(safe_state, shardings)
             i = int(state.step)
@@ -153,10 +274,25 @@ def resilient_train(state: TrainState, step_fn: Callable,
         state = new_state
         metrics.count("steps")
         metrics.times["step"].append(time.perf_counter() - t0)
-        history.append({k: float(v) for k, v in m.items()})
+        rec = scalar_metrics(m)
+        if rec.get("grad_ok", 1.0) == 0.0:
+            # tier-1 guard fired inside the step: the update was skipped
+            # in-graph; surface it as a decision, not a failure
+            metrics.count("grad_skips")
+            metrics.decision("trainer.grad_skip", step=i,
+                             grad_norm=rec.get("grad_norm"),
+                             grad_norm_ema=rec.get("grad_norm_ema"))
+        history.append(rec)
         i += 1
         if i % rcfg.checkpoint_every == 0 or i == num_steps:
             ckpt.save(rcfg.checkpoint_dir, state, step=i)
             safe_state = None  # durable copy exists; free the host mirror
+            # prune the replay buffer one checkpoint BEHIND: a corrupted
+            # newest checkpoint falls back to the previous intact one,
+            # whose replay window must still be replayable bit-exact.
+            # Bound: <= 2 * checkpoint_every buffered batches.
+            if prev_ckpt_step is not None:
+                replay.prune_before(prev_ckpt_step)
+            prev_ckpt_step = i
             metrics.count("checkpoints")
     return state, history

@@ -27,9 +27,12 @@ from flashmoe_tpu.config import MoEConfig
 from flashmoe_tpu.models.presets import PRESETS
 from flashmoe_tpu.runtime import bootstrap
 from flashmoe_tpu.runtime.data import TokenLoader
-from flashmoe_tpu.runtime.resilient import ResilienceConfig, resilient_train
+from flashmoe_tpu.runtime.resilient import (
+    ResilienceConfig, resilient_train, scalar_metrics,
+)
 from flashmoe_tpu.runtime.trainer import (
-    init_state, make_optimizer, make_train_step, state_shardings,
+    GradGuardConfig, init_state, make_optimizer, make_train_step,
+    state_shardings,
 )
 from flashmoe_tpu.utils.telemetry import Metrics
 
@@ -56,6 +59,10 @@ def main(argv=None) -> int:
     ap.add_argument("--checkpoint-every", type=int, default=100)
     ap.add_argument("--log-every", type=int, default=10)
     ap.add_argument("--metrics-jsonl", default=None)
+    ap.add_argument("--grad-guard", action="store_true",
+                    help="tier-1 gradient anomaly guard: skip non-finite/"
+                         "spiking updates in-graph (docs/RESILIENCE.md)")
+    ap.add_argument("--grad-spike-factor", type=float, default=10.0)
     ap.add_argument("--num-layers", type=int, default=None,
                     help="override (e.g. shrink a preset for a smoke run)")
     ap.add_argument("--set", action="append", default=[],
@@ -98,9 +105,11 @@ def main(argv=None) -> int:
         data = _synthetic_batches(cfg, args.batch)
 
     optimizer = make_optimizer(cfg, lr=args.lr, total_steps=args.steps)
-    state = init_state(jax.random.PRNGKey(0), cfg, optimizer)
+    guard = (GradGuardConfig(spike_factor=args.grad_spike_factor)
+             if args.grad_guard else None)
+    state = init_state(jax.random.PRNGKey(0), cfg, optimizer, guard=guard)
     state = jax.device_put(state, state_shardings(state, cfg, mesh))
-    step = make_train_step(cfg, mesh, optimizer)
+    step = make_train_step(cfg, mesh, optimizer, guard=guard)
 
     metrics = Metrics()
     if args.checkpoint_dir:
@@ -117,12 +126,14 @@ def main(argv=None) -> int:
             with metrics.timer("step"):
                 state, m = step(state, next(data))
             if i % args.log_every == 0 or i == args.steps - 1:
-                rec = {k: float(v) for k, v in m.items()}
+                # scalar-safe: array-valued metrics (per-expert stats
+                # when collect_stats is on) must not crash the logger
+                rec = scalar_metrics(m)
                 history.append(rec)
                 print(json.dumps({"step": i, **rec}), file=sys.stderr)
 
     summary = dict(metrics.summary(),
-                   final_loss=history[-1]["loss"] if history else None,
+                   final_loss=history[-1].get("loss") if history else None,
                    steps=args.steps)
     if args.metrics_jsonl:
         metrics.dump_jsonl(args.metrics_jsonl, steps=args.steps)

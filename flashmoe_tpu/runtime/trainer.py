@@ -10,6 +10,7 @@ sharding layout (params replicated over dp -> psum of grads over dp).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, Callable, NamedTuple
 
@@ -27,6 +28,44 @@ class TrainState(NamedTuple):
     params: Any
     opt_state: Any
     step: jax.Array
+    # tier-1 gradient-guard EMA state (GuardState) when the step was
+    # built with a GradGuardConfig; None otherwise — a None leaf is an
+    # empty pytree node, so guard-free states keep the pre-guard tree
+    # structure (checkpoints, shardings, donation all unchanged)
+    guard: Any = None
+
+
+class GuardState(NamedTuple):
+    """Running statistics for the tier-1 gradient anomaly guard."""
+
+    norm_ema: jax.Array  # EMA of the (finite, accepted) grad norms
+    seen: jax.Array      # accepted steps feeding the EMA (warmup gate)
+
+
+@dataclasses.dataclass(frozen=True)
+class GradGuardConfig:
+    """Tier-1 fault tolerance: per-step gradient anomaly guard.
+
+    A non-finite gradient or a grad-norm spike costs ONE skipped
+    optimizer update (params/opt-state/EMA carried through a
+    ``jnp.where`` select inside the compiled step) instead of a
+    checkpoint rewind — the middle rung between tier-0 expert masking
+    and tier-2 restore-and-retry (docs/RESILIENCE.md).
+
+    ``spike_factor``: skip when grad_norm > spike_factor * EMA (only
+    once ``warmup_steps`` accepted norms have seeded the EMA).
+    ``ema_decay``: EMA decay per accepted step; skipped steps do not
+    contaminate the EMA.
+    """
+
+    skip_nonfinite: bool = True
+    spike_factor: float = 10.0
+    ema_decay: float = 0.99
+    warmup_steps: int = 10
+
+
+def init_guard_state() -> GuardState:
+    return GuardState(jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32))
 
 
 def make_optimizer(cfg: MoEConfig, lr: float = 3e-4,
@@ -42,9 +81,12 @@ def make_optimizer(cfg: MoEConfig, lr: float = 3e-4,
     )
 
 
-def init_state(key, cfg: MoEConfig, optimizer) -> TrainState:
+def init_state(key, cfg: MoEConfig, optimizer,
+               guard: GradGuardConfig | None = None) -> TrainState:
     params = transformer.init_params(key, cfg)
-    return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+    return TrainState(params, optimizer.init(params),
+                      jnp.zeros((), jnp.int32),
+                      init_guard_state() if guard is not None else None)
 
 
 def state_shardings(state: TrainState, cfg: MoEConfig, mesh: Mesh):
@@ -84,16 +126,25 @@ def state_shardings(state: TrainState, cfg: MoEConfig, mesh: Mesh):
         return NamedSharding(mesh, P())
 
     opt_sh = jax.tree_util.tree_map_with_path(match, state.opt_state)
-    return TrainState(param_sh, opt_sh, NamedSharding(mesh, P()))
+    guard_sh = jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, P()), state.guard)
+    return TrainState(param_sh, opt_sh, NamedSharding(mesh, P()), guard_sh)
 
 
 def make_train_step(cfg: MoEConfig, mesh: Mesh, optimizer,
-                    use_pallas: bool | None = None) -> Callable:
+                    use_pallas: bool | None = None,
+                    guard: GradGuardConfig | None = None) -> Callable:
     """Build the jitted, mesh-sharded train step.
 
     Returns step(state, batch) -> (state, metrics).  Batch tokens shard
     over dp; XLA inserts the dp gradient allreduce from the sharding
     layout.
+
+    ``guard`` arms the tier-1 gradient anomaly guard: the state must
+    then carry a :class:`GuardState` (``init_state(..., guard=guard)``),
+    and the metrics gain ``grad_ok`` (1.0 = update applied, 0.0 = update
+    skipped in-graph) plus ``grad_norm_ema``.  ``guard=None`` builds the
+    exact pre-guard step — bit-identical training.
     """
     # Training entry point implies is_training: without this, a hand-built
     # config silently differentiates through the inference-selected FFN path
@@ -106,13 +157,59 @@ def make_train_step(cfg: MoEConfig, mesh: Mesh, optimizer,
         (loss, metrics), grads = jax.value_and_grad(
             transformer.loss_fn, has_aux=True
         )(state.params, batch, cfg, mesh, use_pallas)
-        updates, opt_state = optimizer.update(
-            grads, state.opt_state, state.params
+        from flashmoe_tpu.chaos import inject as chaos_inject
+
+        if (chaos_inject.is_armed("nan_grad")
+                or chaos_inject.is_armed("grad_spike")):
+            grads = chaos_inject.poison_grads(grads, state.step)
+        gnorm = optax.global_norm(grads)
+        if guard is None:
+            updates, opt_state = optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            params = optax.apply_updates(state.params, updates)
+            metrics = dict(metrics, loss=loss, grad_norm=gnorm)
+            return TrainState(params, opt_state, state.step + 1,
+                              state.guard), metrics
+
+        # ---- tier-1 guard: decide, then select — all in-graph ----
+        gs: GuardState = state.guard
+        finite = jnp.isfinite(gnorm)
+        warm = gs.seen >= guard.warmup_steps
+        spike = warm & (gnorm > guard.spike_factor
+                        * jnp.maximum(gs.norm_ema, 1e-30))
+        ok = (finite if guard.skip_nonfinite else jnp.bool_(True)) & ~spike
+        # a non-finite gradient must never flow into the optimizer even
+        # when its update is discarded: moment EMAs computed from NaN
+        # grads would be selected away here, but XLA may still fuse the
+        # NaN into reused subexpressions; feed zeros on skipped steps
+        safe_grads = jax.tree_util.tree_map(
+            lambda g: jnp.where(ok, g, jnp.zeros((), g.dtype))
+            if jnp.issubdtype(jnp.asarray(g).dtype, jnp.inexact) else g,
+            grads,
         )
-        params = optax.apply_updates(state.params, updates)
-        metrics = dict(metrics, loss=loss,
-                       grad_norm=optax.global_norm(grads))
-        return TrainState(params, opt_state, state.step + 1), metrics
+        updates, new_opt = optimizer.update(
+            safe_grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        sel = functools.partial(
+            jax.tree_util.tree_map, lambda n, o: jnp.where(ok, n, o))
+        params = sel(new_params, state.params)
+        opt_state = sel(new_opt, state.opt_state)
+        decay = jnp.float32(guard.ema_decay)
+        seeded = gs.seen > 0
+        ema_next = jnp.where(seeded,
+                             decay * gs.norm_ema + (1 - decay) * gnorm,
+                             gnorm.astype(jnp.float32))
+        new_guard = GuardState(
+            jnp.where(ok, ema_next, gs.norm_ema),
+            gs.seen + ok.astype(gs.seen.dtype),
+        )
+        metrics = dict(metrics, loss=loss, grad_norm=gnorm,
+                       grad_ok=ok.astype(jnp.float32),
+                       grad_norm_ema=new_guard.norm_ema)
+        return TrainState(params, opt_state, state.step + 1,
+                          new_guard), metrics
 
     batch_sharding = {"tokens": NamedSharding(mesh, P("dp", None))}
     return jax.jit(
@@ -152,7 +249,8 @@ def train(cfg: MoEConfig, mesh: Mesh, data_iter, num_steps: int,
           key=None, log_every: int = 10, state: TrainState | None = None,
           use_pallas: bool | None = None,
           recorder: "FlightRecorder | None" = None,
-          flight_path: str | None = None):
+          flight_path: str | None = None,
+          guard: GradGuardConfig | None = None):
     """Simple host training loop (see runtime.worker for the CLI).
 
     ``recorder``: a :class:`flashmoe_tpu.utils.telemetry.FlightRecorder`
@@ -169,10 +267,11 @@ def train(cfg: MoEConfig, mesh: Mesh, data_iter, num_steps: int,
     key = key if key is not None else jax.random.PRNGKey(0)
     optimizer = make_optimizer(cfg, total_steps=num_steps)
     if state is None:
-        state = init_state(key, cfg, optimizer)
+        state = init_state(key, cfg, optimizer, guard=guard)
         sh = state_shardings(state, cfg, mesh)
         state = jax.device_put(state, sh)
-    step = make_train_step(cfg, mesh, optimizer, use_pallas=use_pallas)
+    step = make_train_step(cfg, mesh, optimizer, use_pallas=use_pallas,
+                           guard=guard)
     if flight_path is not None and recorder is None:
         recorder = FlightRecorder()
     history = []
@@ -192,6 +291,13 @@ def train(cfg: MoEConfig, mesh: Mesh, data_iter, num_steps: int,
             rec["step_ms"] = step_ms
             # bounded: the histogram aggregates, no per-step list grows
             tm.histogram("trainer.step_ms", step_ms)
+            if rec.get("grad_ok", 1.0) == 0.0:
+                # tier-1 guard fired: the skipped update is a structured
+                # decision so a postmortem can answer "which steps were
+                # dropped and why" without replaying the run
+                tm.decision("trainer.grad_skip", step=i,
+                            grad_norm=rec.get("grad_norm"),
+                            grad_norm_ema=rec.get("grad_norm_ema"))
             if recorder is not None:
                 recorder.record(step=i, **rec)
             if log_step:

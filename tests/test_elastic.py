@@ -1,0 +1,100 @@
+"""Elastic resume folding: edge cases of fold_parallelism.
+
+The happy path (ep=4 -> ep=2 on half the devices) is covered by
+tests/test_resilient.py; these pin the awkward corners — prime device
+counts, expert counts no candidate ep divides, and the loud warning when
+pp/tp/sp axes are dropped."""
+
+import warnings
+
+import jax.numpy as jnp
+import pytest
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.runtime.elastic import fold_parallelism
+
+F32 = dict(dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def _cfg(**kw):
+    base = dict(num_experts=4, expert_top_k=2, hidden_size=64,
+                intermediate_size=128, sequence_len=32, num_layers=1,
+                vocab_size=256, num_heads=2, is_training=True, **F32)
+    base.update(kw)
+    return MoEConfig(**base)
+
+
+def _check_valid(cfg: MoEConfig, n: int):
+    """The folded config must satisfy its own invariants and tile the
+    device count exactly (dp * ep == n, experts divide over ep)."""
+    assert cfg.ep * cfg.dp == n
+    assert cfg.pp == cfg.tp == cfg.sp == 1
+    if cfg.num_experts > 1:
+        assert cfg.num_experts % cfg.ep == 0
+    # replace() re-runs __post_init__ validation on the folded values
+    cfg.replace()
+
+
+def test_prime_device_count_folds_to_dp():
+    """7 devices: no ep > 1 divides both 7 and num_experts=4, so the job
+    resumes pure-dp."""
+    folded = fold_parallelism(_cfg(ep=4), 7)
+    assert folded.ep == 1 and folded.dp == 7
+    _check_valid(folded, 7)
+
+
+def test_prime_expert_count_folds_to_dp():
+    """num_experts=7 (prime) on 4 devices: ep can only be 1."""
+    folded = fold_parallelism(_cfg(num_experts=7, expert_top_k=2, ep=1), 4)
+    assert folded.ep == 1 and folded.dp == 4
+    _check_valid(folded, 4)
+
+
+def test_experts_indivisible_by_full_world():
+    """num_experts=6, ep=6 job lands on 4 devices: candidate ep=4 fails
+    (6 % 4), ep=3 fails (4 % 3), ep=2 divides both — the largest ep
+    that satisfies BOTH divisibility constraints wins."""
+    folded = fold_parallelism(_cfg(num_experts=6, ep=6), 4)
+    assert folded.ep == 2 and folded.dp == 2
+    _check_valid(folded, 4)
+
+
+def test_single_device_always_valid():
+    folded = fold_parallelism(_cfg(ep=4), 1)
+    assert folded.ep == 1 and folded.dp == 1
+    _check_valid(folded, 1)
+
+
+def test_ep_grows_to_world_when_unpinned():
+    """ep=1 configs let the fold claim every device for ep when the
+    expert count allows it (ep = min(n_devices, ...))."""
+    folded = fold_parallelism(_cfg(num_experts=8, ep=1), 4)
+    assert folded.ep == 4 and folded.dp == 1
+    _check_valid(folded, 4)
+
+
+@pytest.mark.parametrize("axis", ["pp", "tp", "sp"])
+def test_dropped_axis_warns(axis):
+    cfg = _cfg(ep=2, **{axis: 2})
+    with pytest.warns(UserWarning, match=f"dropping {axis}=2"):
+        folded = fold_parallelism(cfg, 4)
+    _check_valid(folded, 4)
+
+
+def test_multiple_dropped_axes_warn_once_with_all_names():
+    cfg = _cfg(ep=2, pp=2, tp=2)
+    with pytest.warns(UserWarning) as rec:
+        folded = fold_parallelism(cfg, 8)
+    msgs = [str(w.message) for w in rec
+            if "folds parallelism" in str(w.message)]
+    assert len(msgs) == 1
+    assert "pp=2" in msgs[0] and "tp=2" in msgs[0]
+    _check_valid(folded, 8)
+
+
+def test_clean_dp_ep_config_folds_silently():
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        folded = fold_parallelism(_cfg(ep=2), 6)
+    assert folded.ep == 2 and folded.dp == 3
+    _check_valid(folded, 6)
