@@ -265,6 +265,69 @@ def _flush_observability(rec: dict):
               f"{str(e)[:120]}", file=sys.stderr, flush=True)
 
 
+def _bench_checkpoint(trials: int):
+    """Step-loop checkpoint overhead: blocking time of a sync save
+    (serialize+fsync+rename on the loop) vs an async save (host snapshot
+    only; the writer thread pays the rest).  One JSON record whose
+    ``vs_baseline`` is the sync/async blocking-time ratio — the
+    speedup the drain-safe async path buys the step loop
+    (docs/RESILIENCE.md, preemption section)."""
+    import shutil
+    import tempfile
+
+    from flashmoe_tpu.runtime import checkpoint as ckpt
+    from flashmoe_tpu.runtime.trainer import TrainState
+
+    state = TrainState(
+        params={"w": jnp.zeros((512, 512), jnp.float32),
+                "b": jnp.zeros((512,), jnp.float32)},
+        opt_state={"m": jnp.zeros((512, 512), jnp.float32),
+                   "v": jnp.zeros((512, 512), jnp.float32)},
+        step=jnp.zeros((), jnp.int32))
+    tmp = tempfile.mkdtemp(prefix="flashmoe_ckpt_bench_")
+    sync_s, async_s = [], []
+    try:
+        d_sync = os.path.join(tmp, "sync")
+        d_async = os.path.join(tmp, "async")
+        # one throwaway save per directory: manager construction and
+        # tracemetadata warmup must not be billed to either side
+        ckpt.save(d_sync, state, step=0)
+        ckpt.save(d_async, state, step=0)
+        step = 0
+        for _ in range(trials):
+            step += 1
+            t0 = time.perf_counter()
+            ckpt.save(d_sync, state, step=step)
+            sync_s.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            ckpt.save(d_async, state, step=step, blocking=False)
+            async_s.append(time.perf_counter() - t0)
+            ckpt.wait_for_saves()  # drain between points: measure the
+            # enqueue cost, not queue-full newest-wins replacement
+        errors = ckpt.wait_for_saves()
+        sync_ms = sorted(sync_s)[len(sync_s) // 2] * 1e3
+        async_ms = sorted(async_s)[len(async_s) // 2] * 1e3
+        rec = {
+            "metric": f"ckpt_step_block_ms[async,trials={trials}]",
+            "value": round(async_ms, 3),
+            "unit": "ms",
+            "vs_baseline": round(sync_ms / async_ms, 3) if async_ms
+            else None,
+            "sync_block_ms": round(sync_ms, 3),
+            "async_verified": all(
+                ckpt.verify(d_async, s) for s in range(1, step + 1)
+                if os.path.isdir(ckpt.step_dir(d_async, s))),
+            "async_errors": len(errors),
+            "backend": jax.default_backend(),
+        }
+        print(json.dumps(rec), flush=True)
+        _flush_observability(rec)
+    finally:
+        ckpt.close_manager(os.path.join(tmp, "sync"))
+        ckpt.close_manager(os.path.join(tmp, "async"))
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _bench_overlap(ep: int, trials: int):
     """Overlap efficiency on an ep-way mesh (BASELINE.json metric 3).
 
@@ -479,6 +542,10 @@ def main():
     ap.add_argument("--overlap", type=int, default=0, metavar="EP",
                     help="measure overlap efficiency on an EP-way mesh "
                          "instead of the latency bench")
+    ap.add_argument("--ckpt", action="store_true",
+                    help="measure step-loop checkpoint blocking time, "
+                         "sync vs async save, instead of the latency "
+                         "bench (host-side; no backend probe)")
     ap.add_argument("--deadline", type=int, default=720,
                     help="wall-clock watchdog (s) for the measurement "
                          "itself, armed AFTER the backend probe succeeds; "
@@ -529,6 +596,11 @@ def main():
     if args.deadline > 0:
         signal.signal(signal.SIGALRM, on_deadline)
 
+    if args.ckpt:
+        if args.deadline > 0:
+            signal.alarm(args.deadline)  # host-side path: no probe leg
+        _bench_checkpoint(args.trials)
+        return
     if args.overlap:
         if args.deadline > 0:
             signal.alarm(args.deadline)  # virtual-mesh path: no probe leg

@@ -30,7 +30,8 @@ from flashmoe_tpu.chaos import inject
 
 #: the drill matrix: every fault class the ladder claims to survive
 FAULTS = ("nan_expert", "nan_grad", "grad_spike", "slow_step",
-          "corrupt_ckpt", "skewed_routing", "path_raise")
+          "corrupt_ckpt", "skewed_routing", "path_raise", "preempt",
+          "device_loss")
 
 #: which recovery tier is expected to absorb each fault
 EXPECTED_TIER = {
@@ -41,6 +42,8 @@ EXPECTED_TIER = {
     "slow_step": "tier2:timeout_retry",
     "corrupt_ckpt": "tier2:fallback_restore",
     "path_raise": "tier2:planner_fallback",
+    "preempt": "tier3:drain_resume",
+    "device_loss": "tier3:elastic_refold",
 }
 
 
@@ -122,14 +125,32 @@ def _corrupt_latest_checkpoint(directory: str) -> str | None:
     return victim
 
 
-def make_injector(plan: FaultPlan, rcfg=None):
+def make_injector(plan: FaultPlan, rcfg=None, preempt=None):
     """A ``fail_injector(step)`` callable for ``resilient_train`` that
-    fires the plan's HOST-level fault (corrupt_ckpt / path_raise).
-    In-graph and wrapper faults return a no-op injector so one code path
-    installs any plan."""
+    fires the plan's HOST-level fault (corrupt_ckpt / path_raise /
+    preempt / device_loss).  In-graph and wrapper faults return a no-op
+    injector so one code path installs any plan.
+
+    ``preempt``: the run's :class:`flashmoe_tpu.runtime.preempt.
+    PreemptionListener` — the ``preempt`` fault notifies it
+    programmatically (a deterministic SIGTERM stand-in).
+    ``device_loss`` keeps raising at ``plan.step`` until the in-job
+    retry budget is spent, modelling a device that stays gone until the
+    process is restarted on the survivors."""
     fired = {"n": 0}
 
     def injector(i: int):
+        if plan.fault == "device_loss":
+            # persistent until the retry budget forces a process-level
+            # restart: ``once`` semantics would let restore-and-retry
+            # absorb it in-job, which a lost device never allows
+            budget = getattr(rcfg, "max_retries", 3) + 1
+            if i == plan.step and fired["n"] < budget:
+                fired["n"] += 1
+                raise RuntimeError(
+                    f"chaos: injected device loss at step {i} "
+                    f"({fired['n']}/{budget})")
+            return
         if i != plan.step or (plan.once and fired["n"]):
             return
         if plan.fault == "corrupt_ckpt":
@@ -146,6 +167,12 @@ def make_injector(plan: FaultPlan, rcfg=None):
 
             raise PathFailure(
                 "fused", f"chaos: injected path failure at step {i}")
+        if plan.fault == "preempt":
+            fired["n"] += 1
+            if preempt is not None:
+                # the step loop finishes THIS step, then drains: the
+                # notice lands mid-step exactly like a real SIGTERM
+                preempt.notify(source="chaos")
 
     return injector
 

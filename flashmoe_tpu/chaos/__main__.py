@@ -20,7 +20,21 @@ import os
 import sys
 
 
+def _ensure_virtual_devices(n: int = 2) -> None:
+    """The ``device_loss`` drill shrinks the world across a restart,
+    which needs at least two devices.  On a plain CPU host, ask XLA for
+    virtual ones.  jax is already imported by the package ``__init__``
+    at this point, but XLA only reads the flag when a BACKEND first
+    initializes — so setting the env here still works as long as
+    nothing has called into jax yet (harmlessly ignored otherwise)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}".strip())
+
+
 def main(argv=None) -> int:
+    _ensure_virtual_devices()
     p = argparse.ArgumentParser(
         prog="python -m flashmoe_tpu.chaos",
         description="drill the fault-tolerance ladder (docs/RESILIENCE.md)")

@@ -95,11 +95,165 @@ def _stats_probe(cfg: MoEConfig, params, key=11):
     return stats_to_host(out.stats), out
 
 
+def _token_file(tmp: str, cfg: MoEConfig, seed: int,
+                windows: int = 24) -> str:
+    """A deterministic token shard for the supervised drills: a REAL
+    TokenLoader (not a synthetic generator) is what makes the
+    data-exactness claim end to end — its cursor rides the checkpoint
+    manifest and must replay the identical stream after restart."""
+    from flashmoe_tpu.runtime.data import write_token_file
+
+    path = os.path.join(tmp, "tokens.bin")
+    rng = np.random.default_rng(seed)
+    write_token_file(path, rng.integers(
+        0, cfg.vocab_size, size=windows * (cfg.sequence_len + 1),
+        dtype=np.int32))
+    return path
+
+
+def _run_supervised_drill(fault: str, *, num_steps: int,
+                          checkpoint_every: int, workdir: str | None,
+                          seed: int, batch: int) -> DrillResult:
+    """Drill the job-level (tier-3) faults through the supervisor:
+    ``preempt`` (graceful drain + resume) and ``device_loss`` (restart
+    re-folds parallelism onto the surviving devices)."""
+    from flashmoe_tpu.runtime import checkpoint as ckpt_mod
+    from flashmoe_tpu.runtime.data import TokenLoader
+    from flashmoe_tpu.runtime.preempt import PreemptionListener
+    from flashmoe_tpu.runtime.resilient import supervise
+
+    plan = FaultPlan(fault, step=3, seed=seed)
+    clear()
+    tmp = workdir or tempfile.mkdtemp(prefix=f"chaos_{fault}_")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    cfg = drill_config()
+    token_path = _token_file(tmp, cfg, seed)
+
+    world0 = 2 if (fault == "device_loss" and len(jax.devices()) >= 2) \
+        else 1
+    injector_box: dict = {}
+
+    def devices_fn():
+        # device_loss: the first incarnation's world shrinks once the
+        # fault has killed the process — the restart sees the survivors
+        if fault == "device_loss" and injector_box.get("exhausted"):
+            return jax.devices()[:1]
+        return jax.devices()[:world0]
+
+    rcfg = ResilienceConfig(checkpoint_dir=ckpt_dir,
+                            checkpoint_every=checkpoint_every,
+                            max_retries=3,
+                            async_save=(fault == "preempt"))
+    guard = GradGuardConfig(warmup_steps=2, spike_factor=10.0)
+    preempt = PreemptionListener(grace_s=30.0)
+    metrics = Metrics()
+    base_injector = make_injector(plan, rcfg, preempt=preempt)
+
+    def injector(i):
+        try:
+            base_injector(i)
+        except Exception:
+            # retry budget is max_retries; the (max_retries+1)-th raise
+            # is the one that escalates to a process death
+            if i == plan.step:
+                injector_box["raises"] = injector_box.get("raises", 0) + 1
+                if injector_box["raises"] > rcfg.max_retries:
+                    injector_box["exhausted"] = True
+            raise
+
+    def data_factory(fcfg):
+        return TokenLoader(token_path, batch, fcfg.sequence_len,
+                           seed=seed, shuffle=True, native=False)
+
+    g0 = len(global_metrics.decisions)
+    t0 = time.perf_counter()
+    error = None
+    try:
+        final, history = supervise(
+            cfg, data_factory, num_steps, rcfg, guard=guard,
+            metrics=metrics, preempt=preempt, devices_fn=devices_fn,
+            fail_injector=injector, seed=seed)
+        final_step = int(final.step)
+    except Exception as e:  # noqa: BLE001 — a drill reports, never dies
+        error, final_step, history = f"{type(e).__name__}: {e}", -1, []
+    wall = time.perf_counter() - t0
+
+    decisions = metrics.decisions + global_metrics.decisions[g0:]
+    c = metrics.counters
+    names = sorted({d["decision"] for d in decisions})
+    evidence: dict = {
+        "failures": c.get("failures", 0.0),
+        "restores": c.get("restores", 0.0),
+        "checkpoints": c.get("checkpoints", 0.0),
+        "preempt_drains": c.get("preempt_drains", 0.0),
+        "loader_restores": c.get("loader_restores", 0.0),
+        "supervisor_restarts": c.get("supervisor_restarts", 0.0),
+        "finite_history": bool(history) and all(
+            np.isfinite(h["loss"]) for h in history if "loss" in h),
+        "decision_names": names,
+        "world0": world0,
+        "worlds": [d.get("world") for d in decisions
+                   if d["decision"] == "supervisor.resume"],
+    }
+    last = ckpt_mod.latest_step(ckpt_dir)
+    evidence["final_ckpt_step"] = last
+    evidence["loader_state_present"] = (
+        last is not None
+        and ckpt_mod.load_loader_state(ckpt_dir, last) is not None)
+
+    ok, why = True, []
+
+    def need(cond, msg):
+        nonlocal ok
+        if not cond:
+            ok = False
+            why.append(msg)
+
+    need(error is None, f"aborted: {error}")
+    need(final_step == num_steps, f"ended at step {final_step}")
+    need(evidence["finite_history"], "non-finite loss leaked")
+    need("supervisor.resume" in names, "no supervisor.resume decision")
+    need(evidence["loader_state_present"],
+         "no loader state in the final manifest")
+    steps_rerun = max(0, int(c.get("steps", 0)) - num_steps)
+    if fault == "preempt":
+        need(c.get("preempt_drains", 0) >= 1, "no graceful drain")
+        need("preempt.drain" in names, "no preempt.drain decision")
+        # zero lost steps: the drain checkpoints the exact step reached
+        need(steps_rerun == 0,
+             f"drain lost work: {steps_rerun} steps re-run")
+        need(c.get("failures", 0) == 0, "drain path counted failures")
+    else:  # device_loss
+        need(c.get("supervisor_restarts", 0) >= 1,
+             "process death did not reach the supervisor")
+        need(c.get("restores", 0) >= 1, "no checkpoint restore")
+        if world0 >= 2:
+            worlds = [w for w in evidence["worlds"] if w]
+            need(worlds and min(worlds) < world0,
+                 f"world never shrank below {world0} ({worlds})")
+        # loss-of-work bound: every in-job retry replays at most one
+        # checkpoint window, the restart replays at most one more
+        bound = checkpoint_every * (rcfg.max_retries + 1)
+        need(steps_rerun <= bound,
+             f"loss of work {steps_rerun} exceeds bound {bound}")
+
+    clear()
+    return DrillResult(
+        fault=fault, expected_tier=EXPECTED_TIER[fault], recovered=ok,
+        reason="; ".join(why), final_step=final_step,
+        steps_rerun=steps_rerun, wall_s=round(wall, 3),
+        evidence=evidence, decisions=decisions)
+
+
 def run_drill(fault: str, *, num_steps: int = 6, checkpoint_every: int = 2,
               workdir: str | None = None, seed: int = 0,
               batch: int = 2) -> DrillResult:
     """Run one fault drill end to end; never raises for a failed drill —
     the result carries the diagnosis instead."""
+    if fault in ("preempt", "device_loss"):
+        return _run_supervised_drill(
+            fault, num_steps=num_steps, checkpoint_every=checkpoint_every,
+            workdir=workdir, seed=seed, batch=batch)
     plan = FaultPlan(fault, step=3, seed=seed)
     if fault == "corrupt_ckpt":
         # corrupt the NEWEST checkpoint after two exist, so the fallback

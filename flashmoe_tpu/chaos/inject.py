@@ -29,8 +29,9 @@ point              hook site                                   spec keys
                                                                scale
 =================  ==========================================  =========
 
-Host-level faults (``slow_step``, ``corrupt_ckpt``, ``path_raise``) do
-not live here — they ride :func:`flashmoe_tpu.chaos.make_injector` /
+Host-level faults (``slow_step``, ``corrupt_ckpt``, ``path_raise``,
+``preempt``, ``device_loss``) do not live here — they ride
+:func:`flashmoe_tpu.chaos.make_injector` /
 :func:`flashmoe_tpu.chaos.wrap_step` instead.
 """
 

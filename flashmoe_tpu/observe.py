@@ -140,6 +140,45 @@ def degradation_report(flight: list[dict]) -> dict:
     }
 
 
+def resilience_report(records: list[dict]) -> dict:
+    """Fault-tolerance narrative from the decision stream
+    (docs/RESILIENCE.md): how often each recovery rung fired, every
+    drain with its remaining grace, and every supervised resume with
+    the world it landed on — the loss-of-work story of the run."""
+    by_name: dict[str, int] = {}
+    drains = []
+    resumes = []
+    for rec in records:
+        name = rec.get("decision")
+        if not isinstance(name, str):
+            continue
+        by_name[name] = by_name.get(name, 0) + 1
+        if name == "preempt.drain":
+            drains.append({
+                "step": rec.get("step"),
+                "source": rec.get("source"),
+                "remaining_grace_s": rec.get("remaining_grace_s"),
+            })
+        elif name == "supervisor.resume":
+            resumes.append({
+                "incarnation": rec.get("incarnation"),
+                "step": rec.get("step"),
+                "world": rec.get("world"),
+                "ep": rec.get("ep"), "dp": rec.get("dp"),
+            })
+    interesting = ("trainer.grad_skip", "checkpoint.fallback",
+                   "checkpoint.emergency_save", "checkpoint.async_error",
+                   "planner.fallback", "preempt.notice", "preempt.drain",
+                   "supervisor.resume")
+    return {
+        "events": {k: by_name[k] for k in interesting if k in by_name},
+        "drains": drains,
+        "resumes": resumes,
+        "worlds": sorted({r["world"] for r in resumes
+                          if r.get("world") is not None}),
+    }
+
+
 def phase_report(records: list[dict]) -> dict:
     """Mean of every ``*_ms`` field across records (flight ``step_ms``,
     bench leg timings) plus ``*_ms_p50`` phase timers from metrics
@@ -171,6 +210,7 @@ def summarize(records: list[dict]) -> dict:
         "imbalance": imbalance_report(flight),
         "drops": drop_report(flight),
         "degradation": degradation_report(flight),
+        "resilience": resilience_report(records),
         "phases": phase_report(records),
         "drift": drift_report(records),
         "decisions": sorted({r["decision"] for r in records
@@ -217,6 +257,21 @@ def render_text(s: dict) -> str:
             lines.append(f"  step {t['step']}: masked "
                          f"{t['masked_experts']:g} experts, fraction "
                          f"{t['masked_fraction']}")
+    res = s.get("resilience", {})
+    if res.get("events"):
+        lines.append("")
+        lines.append("resilience events: " + ", ".join(
+            f"{k}={v}" for k, v in res["events"].items()))
+        for dr in res["drains"][-5:]:
+            lines.append(
+                f"  drain at step {dr['step']} ({dr['source']}), "
+                f"{dr['remaining_grace_s']:.1f}s grace left"
+                if isinstance(dr.get("remaining_grace_s"), float)
+                else f"  drain at step {dr['step']} ({dr['source']})")
+        for r in res["resumes"][-5:]:
+            lines.append(f"  resume #{r['incarnation']} at step "
+                         f"{r['step']}: world={r['world']} "
+                         f"(ep={r['ep']} x dp={r['dp']})")
     if s["phases"]:
         lines.append("")
         lines.append("phase times (mean):")

@@ -19,6 +19,16 @@ Tier-2 fault tolerance (docs/RESILIENCE.md) hardens the job-level rung:
     telemetry decision) instead of resuming from garbage;
   * :func:`emergency_save` best-effort persists the last good state when
     a run aborts, never raising into the abort path.
+
+Preemption-safe async saves (``save(..., blocking=False)``) snapshot the
+state to host and hand serialize+fsync+atomic-rename to ONE background
+writer thread with a depth-1 newest-wins queue per checkpoint
+directory; :func:`wait_for_saves` is the drain/emergency barrier.  Durability ordering is preserved: the
+manifest is written only after the payload commit (orbax renames the
+step dir atomically), so a kill between the two leaves the previous
+step — and :func:`verify` semantics — intact.  The manifest additionally
+carries the data-loader cursor (``loader_state=``) so a resumed run can
+continue the exact token stream (:mod:`flashmoe_tpu.runtime.data`).
 """
 
 from __future__ import annotations
@@ -26,6 +36,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import threading
 import zlib
 from typing import Any
 
@@ -45,6 +56,7 @@ class CheckpointCorruptionError(RuntimeError):
 # ----------------------------------------------------------------------
 
 _MANAGERS: dict[str, ocp.CheckpointManager] = {}
+_MANAGERS_LOCK = threading.Lock()
 
 # retained checkpoints per directory; a module constant rather than a
 # _manager() parameter because the manager is cached per directory — a
@@ -55,16 +67,19 @@ MAX_TO_KEEP = 3
 def _manager(directory: str) -> ocp.CheckpointManager:
     """The directory's cached manager (one per abspath, reused across
     every save/query/restore — satellite fix: the old per-call
-    construct-then-close put manager setup in the hot loop)."""
+    construct-then-close put manager setup in the hot loop).  Lock-
+    guarded: the async writer thread and the step loop both resolve
+    managers."""
     key = os.path.abspath(directory)
-    mgr = _MANAGERS.get(key)
-    if mgr is None:
-        mgr = _MANAGERS[key] = ocp.CheckpointManager(
-            key,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=MAX_TO_KEEP, create=True,
-            ),
-        )
+    with _MANAGERS_LOCK:
+        mgr = _MANAGERS.get(key)
+        if mgr is None:
+            mgr = _MANAGERS[key] = ocp.CheckpointManager(
+                key,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=MAX_TO_KEEP, create=True,
+                ),
+            )
     return mgr
 
 
@@ -81,7 +96,8 @@ def _payload(state: TrainState) -> dict:
 
 def close_manager(directory: str) -> None:
     """Close and drop the directory's cached manager (tests / shutdown)."""
-    mgr = _MANAGERS.pop(os.path.abspath(directory), None)
+    with _MANAGERS_LOCK:
+        mgr = _MANAGERS.pop(os.path.abspath(directory), None)
     if mgr is not None:
         mgr.close()
 
@@ -126,11 +142,21 @@ def _walk_payload(root: str) -> dict[str, dict]:
     return out
 
 
-def write_manifest(directory: str, step: int) -> str:
+def write_manifest(directory: str, step: int,
+                   loader_state: dict | None = None) -> str:
     """Checksum every file under the step dir into manifest-<step>.json.
-    Called by :func:`save` after the write lands; returns the path."""
+    Called by :func:`save` after the write lands; returns the path.
+
+    ``loader_state``: the data-loader cursor captured with the state
+    snapshot (``TokenLoader.state_dict()``) — stored in the manifest so
+    a resumed run consumes the exact token stream the dead run would
+    have (:func:`load_loader_state`).  Written AFTER the payload is
+    durable: a kill between the two leaves a legacy-style manifest-less
+    checkpoint, never a manifest pointing at missing bytes."""
     root = step_dir(directory, step)
     manifest = {"step": step, "files": _walk_payload(root)}
+    if loader_state is not None:
+        manifest["loader"] = dict(loader_state)
     path = _manifest_path(directory, step)
     # per-process tmp name + atomic replace: even if two writers race
     # (they should not — save() gates on process 0), no reader ever sees
@@ -168,6 +194,53 @@ def verify(directory: str, step: int) -> bool:
     return all(have[rel] == meta for rel, meta in want.items())
 
 
+def load_loader_state(directory: str, step: int) -> dict | None:
+    """The data-loader cursor stored with the step's manifest, or None
+    (legacy checkpoint, no loader attached, unreadable manifest)."""
+    try:
+        with open(_manifest_path(directory, step)) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return None
+    loader = manifest.get("loader")
+    return dict(loader) if isinstance(loader, dict) else None
+
+
+def restore_loader_state(directory: str, step: int, loader) -> bool:
+    """Reposition ``loader`` from the step's manifest cursor (the ONE
+    implementation behind resilient_train resume, elastic_resume and
+    the supervisor).  False when the loader is stateless/None or the
+    manifest carries no cursor; True after a successful restore."""
+    if loader is None or not hasattr(loader, "load_state_dict"):
+        return False
+    ls = load_loader_state(directory, step)
+    if ls is None:
+        return False
+    loader.load_state_dict(ls)
+    return True
+
+
+def has_guard(directory: str, step: int) -> bool | None:
+    """Whether the step's on-disk payload carries the tier-1 ``guard``
+    subtree — None when it cannot be determined (missing/opaque
+    metadata).  Used for the clear guard-mismatch error in
+    :func:`flashmoe_tpu.runtime.elastic.elastic_resume`."""
+    try:
+        meta = _manager(directory).item_metadata(step)
+        keys = list(meta.keys()) if hasattr(meta, "keys") else None
+        if keys is not None:
+            return "guard" in keys
+    except Exception:  # noqa: BLE001 — probe only, never fail the caller
+        pass
+    try:  # fallback: the orbax tree metadata JSON names every key path
+        mpath = os.path.join(step_dir(directory, step), "default",
+                             "_METADATA")
+        with open(mpath) as f:
+            return '"guard"' in f.read()
+    except OSError:
+        return None
+
+
 def _prune_stale_manifests(directory: str) -> None:
     """Drop manifests for steps the manager's max_to_keep GC removed."""
     keep = {str(s) for s in _manager(directory).all_steps()}
@@ -182,22 +255,148 @@ def _prune_stale_manifests(directory: str) -> None:
 
 
 # ----------------------------------------------------------------------
+# Async writer: ONE background thread, depth-1 newest-wins queue
+# ----------------------------------------------------------------------
+
+class _AsyncWriter:
+    """Serializes async checkpoint jobs off the step loop.
+
+    Depth-1 **per directory**, newest-wins: a still-queued older
+    snapshot is replaced by a newer one for the SAME checkpoint dir
+    (the job of a checkpoint is to minimize loss-of-work — an old
+    snapshot nobody would restore is not worth a disk write), while
+    jobs for different directories queue side by side (two runs in one
+    process must not cancel each other's checkpoints) and the IN-FLIGHT
+    job always completes (its payload may already be half-committed).
+    Errors are collected, surfaced as ``checkpoint.async_error``
+    decisions, and returned by :func:`wait_for_saves` — an async save
+    failure must not be silent, but it also must not crash the training
+    step that outran it.
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        # abspath -> job; dict order is FIFO across directories,
+        # replacement (newest-wins) keeps the original slot
+        self._pending: dict[str, tuple] = {}
+        self._in_flight = False
+        self._thread: threading.Thread | None = None
+        self._errors: list[Exception] = []
+        self.dropped = 0
+        self.completed = 0
+
+    def submit(self, job: tuple) -> None:
+        with self._cond:
+            key = os.path.abspath(job[0])
+            if key in self._pending:
+                self.dropped += 1  # newest wins, per directory
+            self._pending[key] = job
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="flashmoe-ckpt-writer",
+                    daemon=True)
+                self._thread.start()
+            self._cond.notify_all()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending:
+                    self._cond.wait()
+                job = self._pending.pop(next(iter(self._pending)))
+                self._in_flight = True
+            directory, host_state, step, loader_state = job
+            try:
+                _write_sync(directory, host_state, step, loader_state)
+                with self._cond:
+                    self.completed += 1
+            except Exception as e:  # noqa: BLE001 — surfaced via barrier
+                with self._cond:
+                    self._errors.append(e)
+                try:
+                    _telemetry.decision(
+                        "checkpoint.async_error",
+                        directory=os.path.abspath(directory), step=step,
+                        error=f"{type(e).__name__}: {str(e)[:200]}")
+                except Exception:  # noqa: BLE001
+                    pass
+            finally:
+                with self._cond:
+                    self._in_flight = False
+                    self._cond.notify_all()
+
+    def wait(self, timeout: float | None = None) -> list[Exception]:
+        """Block until the queue is empty and nothing is in flight
+        (every directory — the barrier is process-wide); returns (and
+        clears) the errors collected since the last call."""
+        with self._cond:
+            self._cond.wait_for(
+                lambda: not self._pending and not self._in_flight,
+                timeout=timeout)
+            errors, self._errors = self._errors, []
+            return errors
+
+
+_WRITER = _AsyncWriter()
+
+
+def wait_for_saves(timeout: float | None = None) -> list[Exception]:
+    """Barrier for in-flight async saves (drain / emergency paths): block
+    until the writer is idle, returning any errors it hit since the last
+    barrier.  A no-op (empty list) when nothing was ever enqueued."""
+    return _WRITER.wait(timeout)
+
+
+def async_save_stats() -> dict:
+    """Writer counters for telemetry/tests: completed, dropped
+    (newest-wins replacements), pending errors."""
+    return {"completed": _WRITER.completed, "dropped": _WRITER.dropped}
+
+
+# ----------------------------------------------------------------------
 # Save / restore
 # ----------------------------------------------------------------------
 
+def _write_sync(directory: str, state: TrainState, step: int,
+                loader_state: dict | None) -> None:
+    """The durable write: orbax payload (atomic step-dir commit), THEN
+    the CRC manifest.  The ordering is the async-crash guarantee — a
+    kill mid-payload leaves only an uncommitted tmp dir (invisible to
+    the manager), a kill between payload and manifest leaves a complete
+    legacy-style checkpoint; the previous step is intact either way."""
+    mgr = _manager(directory)
+    mgr.save(step, args=ocp.args.StandardSave(_payload(state)))
+    mgr.wait_until_finished()
+    # manifest bookkeeping is single-writer: orbax coordinates the
+    # array write across hosts, but the manifest is plain JSON on a
+    # shared directory — every process writing it would race
+    if jax.process_index() == 0:
+        write_manifest(directory, step, loader_state=loader_state)
+        _prune_stale_manifests(directory)
+
+
 def save(directory: str, state: TrainState, step: int | None = None,
-         wait: bool = True) -> int:
-    """Save a checkpoint; returns the step it was saved under."""
+         wait: bool = True, *, blocking: bool = True,
+         loader_state: dict | None = None) -> int:
+    """Save a checkpoint; returns the step it was saved under.
+
+    ``blocking=False`` snapshots the state to host (``jax.device_get`` —
+    the only cost left on the step loop) and hands serialize + fsync +
+    atomic-rename to the background writer; call :func:`wait_for_saves`
+    before exiting (drain/emergency paths do).  ``loader_state`` is the
+    data-loader cursor to persist in the step's manifest.
+    """
     step = int(state.step) if step is None else step
+    if not blocking:
+        host_state = jax.device_get(state)
+        _WRITER.submit((directory, host_state, step, loader_state))
+        return step
     mgr = _manager(directory)
     mgr.save(step, args=ocp.args.StandardSave(_payload(state)))
     if wait:
         mgr.wait_until_finished()
-        # manifest bookkeeping is single-writer: orbax coordinates the
-        # array write across hosts, but the manifest is plain JSON on a
-        # shared directory — every process writing it would race
         if jax.process_index() == 0:
-            write_manifest(directory, step)
+            write_manifest(directory, step, loader_state=loader_state)
             _prune_stale_manifests(directory)
     return step
 
@@ -285,7 +484,8 @@ def _fresh_guard(template_guard):
         return fresh
 
 
-def emergency_save(directory: str, state: TrainState) -> int | None:
+def emergency_save(directory: str, state: TrainState,
+                   loader_state: dict | None = None) -> int | None:
     """Best-effort save for abort paths: persists ``state`` unless its
     step is already on disk; swallows every error (the caller is already
     crashing — the emergency copy must never mask the original fault).
@@ -298,10 +498,15 @@ def emergency_save(directory: str, state: TrainState) -> int | None:
         for leaf in jax.tree_util.tree_leaves(state):
             if getattr(leaf, "is_deleted", None) and leaf.is_deleted():
                 return None
+        # an in-flight async save must land before the emergency copy:
+        # the writer and this path share the manager, and the freshest
+        # durable step decides whether this save is even needed
+        wait_for_saves()
         step = int(state.step)
         if latest_step(directory) == step:
             return None
-        saved = save(directory, state, step=step)
+        saved = save(directory, state, step=step,
+                     loader_state=loader_state)
         _telemetry.decision("checkpoint.emergency_save",
                             directory=os.path.abspath(directory),
                             step=saved)

@@ -52,6 +52,11 @@ class TokenLoader:
         self.seed, self.shuffle = seed, shuffle
         self._handle = None
         self._lib = None
+        self._closed = False
+        # rows handed out by the NATIVE loader (the C API exposes no
+        # cursor, but both paths consume windows in the identical
+        # xorshift order, so a host-side row count IS the cursor)
+        self._native_rows = 0
         if native != False:  # noqa: E712
             lib = _native.load()
             if lib is not None:
@@ -114,6 +119,11 @@ class TokenLoader:
         return self
 
     def __next__(self):
+        if self._closed:
+            # a closed NATIVE loader used to fall through to the NumPy
+            # branch and crash with AttributeError: _windows — say what
+            # actually happened instead
+            raise RuntimeError("loader is closed")
         w = self.seq_len + 1
         if self._handle is not None:
             out = np.empty((self.batch, w), np.int32)
@@ -122,6 +132,7 @@ class TokenLoader:
             )
             if rc != 0:
                 raise StopIteration
+            self._native_rows += self.batch
             return {"tokens": jnp.asarray(out)}
         rows = []
         for _ in range(self.batch):
@@ -136,10 +147,85 @@ class TokenLoader:
             self._cursor += 1
         return {"tokens": jnp.asarray(np.stack(rows))}
 
+    # ------------------------------------------------------------------
+    # Resumable state (preemption-safe training, docs/RESILIENCE.md)
+    # ------------------------------------------------------------------
+
+    def _consumed_rows(self) -> int:
+        """Windows handed out since epoch 0 — the canonical cursor."""
+        if self._handle is not None:
+            return self._native_rows
+        return self._epoch * len(self._windows) + self._cursor
+
+    def state_dict(self) -> dict:
+        """The loader's exact position, identical on both paths.
+
+        (epoch, cursor) are normalized to ``cursor < num_windows`` (the
+        NumPy path wraps its epoch lazily, the native path eagerly — the
+        canonical form makes native/fallback state dicts compare equal
+        and restore interchangeably)."""
+        if self._closed:
+            raise RuntimeError("loader is closed")
+        n = self.num_windows
+        consumed = self._consumed_rows()
+        return {"epoch": consumed // n, "cursor": consumed % n,
+                "seed": self.seed, "shuffle": bool(self.shuffle)}
+
+    def load_state_dict(self, state: dict) -> None:
+        """Reposition so the next batch is the exact batch a loader with
+        this state would produce next.  ``seed``/``shuffle`` are restored
+        from the state (the shuffle order is a function of both — the
+        construction-time values are layout hints, the checkpoint is the
+        truth)."""
+        if self._closed:
+            raise RuntimeError("loader is closed")
+        n = self.num_windows
+        epoch, cursor = int(state["epoch"]), int(state["cursor"])
+        if not 0 <= cursor < max(n, 1):
+            raise ValueError(
+                f"loader state cursor {cursor} out of range for "
+                f"{n} windows in {self.path}")
+        self.seed = int(state.get("seed", self.seed))
+        self.shuffle = bool(state.get("shuffle", self.shuffle))
+        consumed = epoch * n + cursor
+        if self._handle is not None:
+            # the C API exposes no seek: reopen at epoch 0 and fast-
+            # forward whole batches (both paths share the window order,
+            # so discarding k batches lands on the identical position)
+            if consumed % self.batch:
+                raise ValueError(
+                    f"native loader can only resume on a batch boundary: "
+                    f"{consumed} rows consumed, batch={self.batch}; "
+                    f"reopen with native=False to resume mid-batch")
+            self._lib.flashmoe_loader_close(self._handle)
+            self._handle = self._lib.flashmoe_loader_open(
+                self.path.encode(), self.seq_len, self.batch,
+                self.seed, int(self.shuffle))
+            if not self._handle:
+                raise RuntimeError(
+                    f"native loader failed to reopen {self.path}")
+            self._native_rows = 0
+            scratch = np.empty(self.batch * (self.seq_len + 1), np.int32)
+            for _ in range(consumed // self.batch):
+                if self._lib.flashmoe_loader_next(self._handle, scratch):
+                    raise RuntimeError(
+                        f"native loader ended while fast-forwarding to "
+                        f"row {consumed} of {self.path}")
+                self._native_rows += self.batch
+            return
+        self._epoch, self._cursor = epoch, cursor
+        self._order = (
+            _xorshift_order(n, self.seed, epoch) if self.shuffle
+            else np.arange(n, dtype=np.int64)
+        )
+
     def close(self):
+        """Release the native handle; idempotent on both paths.  A closed
+        loader refuses iteration with a clear RuntimeError."""
         if self._handle is not None:
             self._lib.flashmoe_loader_close(self._handle)
             self._handle = None
+        self._closed = True
 
     def __del__(self):
         try:

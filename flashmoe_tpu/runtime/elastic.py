@@ -50,7 +50,7 @@ def fold_parallelism(cfg: MoEConfig, n_devices: int) -> MoEConfig:
 
 def elastic_resume(cfg: MoEConfig, checkpoint_dir: str, *,
                    devices=None, optimizer=None, total_steps: int = 10000,
-                   guard=None):
+                   guard=None, loader=None):
     """Rebuild mesh + shardings for the current device set and restore the
     latest checkpoint into them.
 
@@ -61,7 +61,12 @@ def elastic_resume(cfg: MoEConfig, checkpoint_dir: str, *,
     ``guard``: pass the job's :class:`flashmoe_tpu.runtime.trainer.
     GradGuardConfig` when the checkpoint was written by a tier-1 guarded
     step — the restore template must carry the matching GuardState
-    subtree (docs/RESILIENCE.md).
+    subtree (docs/RESILIENCE.md).  A guarded checkpoint restored without
+    it raises a clear ValueError (not the opaque orbax tree error).
+
+    ``loader``: a stateful data loader (``load_state_dict``) to
+    reposition from the checkpoint's manifest cursor, so the resumed run
+    continues the exact token stream (docs/RESILIENCE.md, preemption).
     """
     devices = list(devices if devices is not None else jax.devices())
     cfg = fold_parallelism(cfg, len(devices))
@@ -82,5 +87,21 @@ def elastic_resume(cfg: MoEConfig, checkpoint_dir: str, *,
         if hasattr(x, "shape") else x,
         template, shardings,
     )
-    state = ckpt.restore(checkpoint_dir, abstract, step=step)
+    try:
+        state = ckpt.restore(checkpoint_dir, abstract, step=step)
+    except Exception as e:
+        # a guard-layout mismatch used to surface as an opaque orbax
+        # tree-structure error; diagnose it from the on-disk metadata.
+        # (The inverse — guard-carrying template over a pre-guard
+        # checkpoint — is healed inside ckpt.restore with a fresh
+        # GuardState, so only this direction can land here.)
+        if guard is None and ckpt.has_guard(checkpoint_dir, step):
+            raise ValueError(
+                f"checkpoint step {step} in {checkpoint_dir} carries a "
+                f"tier-1 GuardState subtree but elastic_resume was "
+                f"called without guard=; pass the job's GradGuardConfig "
+                f"(docs/RESILIENCE.md) so the restore template matches "
+                f"the on-disk layout") from e
+        raise
+    ckpt.restore_loader_state(checkpoint_dir, int(state.step), loader)
     return state, mesh, cfg, optimizer

@@ -20,7 +20,25 @@ This module provides the framework-level equivalent capability and more:
   * **path fallback** — a :class:`flashmoe_tpu.planner.select.PathFailure`
     escaping a step demotes the failed execution path for the rest of the
     process (``planner.fallback`` decision) before the retry;
-  * **periodic checkpointing** — bounded loss-of-work window.
+  * **periodic checkpointing** — bounded loss-of-work window, optionally
+    async (``ResilienceConfig.async_save``): the step loop pays only the
+    host snapshot, the background writer pays serialize+fsync+rename;
+  * **graceful drain** — a :class:`flashmoe_tpu.runtime.preempt.
+    PreemptionListener` notice (SIGTERM on a preemptible pod) finishes
+    the in-flight step, writes a final checkpoint + data-loader cursor,
+    logs a ``preempt.drain`` decision, and returns cleanly instead of
+    dying mid-write;
+  * **deterministic data resume** — when ``data_iter`` is a stateful
+    loader (``state_dict``/``load_state_dict``, e.g.
+    :class:`flashmoe_tpu.runtime.data.TokenLoader`), its cursor is
+    persisted in every checkpoint manifest and restored on resume, so
+    the continued run consumes the exact token stream the dead run
+    would have — no replayed and no skipped batch.
+
+:func:`supervise` is the job-level outer loop (the in-process analogue
+of the cluster scheduler): it restarts after drains and crashes,
+re-folding parallelism to the surviving device count via
+:func:`flashmoe_tpu.runtime.elastic.elastic_resume`.
 
 Single-process recovery is fully testable (failures injected in tests and
 by :mod:`flashmoe_tpu.chaos`); multi-host recovery composes with the
@@ -44,7 +62,22 @@ from flashmoe_tpu.utils.telemetry import Metrics
 
 
 class StepFailure(RuntimeError):
-    pass
+    """Unrecoverable (in-job) training failure.  Instances raised by
+    :func:`resilient_train` carry ``partial_history`` — the per-step
+    metric records executed before the abort — so callers (the
+    supervisor, postmortems) keep the dead run's loss curve instead of
+    losing it with the raise.  (Set per instance at raise time; read
+    with ``getattr(e, "partial_history", [])``.)"""
+
+    partial_history: list
+
+
+def _make_deadline_executor() -> _fut.ThreadPoolExecutor:
+    """The single-worker executor backing the step deadline; a named
+    seam so tests can count constructions (exactly one per run, plus
+    one per abandoned timeout)."""
+    return _fut.ThreadPoolExecutor(
+        max_workers=1, thread_name_prefix="flashmoe-deadline")
 
 
 @dataclasses.dataclass
@@ -57,30 +90,43 @@ class ResilienceConfig:
     # strongest behavior — flip off only to reproduce legacy semantics
     verify_checkpoints: bool = True   # checksum-verify before restore
     emergency_save: bool = True       # persist last good state on abort
+    # periodic saves off the step loop: the loop pays only the host
+    # snapshot; drain/failure paths barrier on ckpt.wait_for_saves()
+    async_save: bool = False
 
 
-def _run_step(step_fn, state, batch, timeout_s):
+def _run_step(step_fn, state, batch, timeout_s, ex_box=None):
     """Execute one step, optionally under a wall-clock deadline.
 
     The deadline wraps the *blocking* result fetch — a hung device shows up
     as a timeout rather than an eternal stall (the failure detector the
     reference's collectives lack).
+
+    ``ex_box`` is a one-slot list holding the caller's reusable
+    ThreadPoolExecutor: one executor serves the whole run (the old
+    executor-per-step spawned thousands of threads over a long healthy
+    run) and is abandoned/replaced only after a timeout — its worker may
+    be stuck in the very hang the deadline detected, so it can never be
+    joined or reused.
     """
     if timeout_s is None:
         out = step_fn(state, batch)
         jax.block_until_ready(out)
         return out
-    ex = _fut.ThreadPoolExecutor(max_workers=1)
-    f = ex.submit(lambda: jax.block_until_ready(step_fn(state, batch)))
+    if ex_box is None:
+        ex_box = [None]
+    if ex_box[0] is None:
+        ex_box[0] = _make_deadline_executor()
+    f = ex_box[0].submit(lambda: jax.block_until_ready(step_fn(state, batch)))
     try:
         return f.result(timeout=timeout_s)
     except _fut.TimeoutError as e:
-        raise StepFailure(f"step exceeded {timeout_s}s deadline") from e
-    finally:
         # wait=False: a worker genuinely stuck in a hung collective must be
         # abandoned, not joined — shutdown(wait=True) would re-stall the
         # caller on the very hang the deadline just detected.
+        ex, ex_box[0] = ex_box[0], None
         ex.shutdown(wait=False)
+        raise StepFailure(f"step exceeded {timeout_s}s deadline") from e
 
 
 def scalar_metrics(m: dict) -> dict:
@@ -120,22 +166,48 @@ class _ReplayBuffer:
     history claimed).  Memory is bounded by ``2 * checkpoint_every``
     batches: pruning lags one checkpoint so a corruption-fallback
     restore to the PREVIOUS intact checkpoint still replays bit-exact.
+
+    When the iterator is a stateful loader, the loader's cursor is
+    snapshotted BEFORE each fresh pull: ``loader_state_for(k)`` is then
+    the exact position a new process needs to resume at step ``k`` —
+    the loop may have pulled batches past a rewound checkpoint step, so
+    the loader's *current* cursor is not generally the right answer.
     """
 
     def __init__(self, data_iter: Iterator):
         self._it = data_iter
+        self._stateful = (hasattr(data_iter, "state_dict")
+                          and hasattr(data_iter, "load_state_dict"))
         self._buf: dict[int, object] = {}
+        self._states: dict[int, dict] = {}
+
+    @property
+    def stateful(self) -> bool:
+        return self._stateful
 
     def batch_for(self, step: int):
         b = self._buf.get(step)
         if b is None:
+            if self._stateful and step not in self._states:
+                self._states[step] = self._it.state_dict()
             b = next(self._it)
             self._buf[step] = b
         return b
 
+    def loader_state_for(self, step: int) -> dict | None:
+        """The loader cursor positioned so the next pull is batch
+        ``step``: the pre-pull snapshot when that batch was consumed,
+        else the live cursor (batch ``step`` not pulled yet — the
+        checkpoint-boundary case, where pulls == step exactly)."""
+        if not self._stateful:
+            return None
+        st = self._states.get(step)
+        return dict(st) if st is not None else self._it.state_dict()
+
     def prune_before(self, step: int):
         for s in [s for s in self._buf if s < step]:
             del self._buf[s]
+            self._states.pop(s, None)
 
     def __len__(self):
         return len(self._buf)
@@ -145,13 +217,24 @@ def resilient_train(state: TrainState, step_fn: Callable,
                     data_iter: Iterator, num_steps: int,
                     rcfg: ResilienceConfig | None = None,
                     metrics: Metrics | None = None,
-                    fail_injector: Callable | None = None):
+                    fail_injector: Callable | None = None,
+                    preempt=None):
     """Run ``num_steps`` with detection + restore-and-retry recovery.
 
     ``step_fn(state, batch) -> (state, metrics_dict)`` — e.g. from
     :func:`flashmoe_tpu.runtime.trainer.make_train_step`.
     ``fail_injector(step_idx)`` may raise, for tests/chaos drills
     (:func:`flashmoe_tpu.chaos.make_injector`).
+    ``preempt``: a :class:`flashmoe_tpu.runtime.preempt.
+    PreemptionListener`; its flag is polled once per step, and a notice
+    drains gracefully — final checkpoint + loader cursor, then a clean
+    return with ``state.step < num_steps`` (the supervisor/scheduler
+    resumes from exactly there).
+
+    When ``data_iter`` carries ``state_dict``/``load_state_dict`` (a
+    :class:`flashmoe_tpu.runtime.data.TokenLoader`), its cursor rides
+    every checkpoint manifest and is restored on resume — the continued
+    run consumes the exact token stream of an uninterrupted one.
 
     Returns (state, history).  Raises :class:`StepFailure` after
     ``max_retries`` consecutive failures on one step (after a best-effort
@@ -167,6 +250,11 @@ def resilient_train(state: TrainState, step_fn: Callable,
         state = ckpt.restore(rcfg.checkpoint_dir, state,
                              check_integrity=rcfg.verify_checkpoints)
         metrics.count("resumes")
+        # the restore may have FALLEN BACK to an older intact step:
+        # position the loader for the step actually restored
+        if ckpt.restore_loader_state(rcfg.checkpoint_dir,
+                                     int(state.step), data_iter):
+            metrics.count("loader_restores")
 
     i = int(state.step)
     retries = 0
@@ -192,107 +280,280 @@ def resilient_train(state: TrainState, step_fn: Callable,
     )
     safe_state = jax.device_get(state)
     replay = _ReplayBuffer(data_iter)
-    prev_ckpt_step = None  # pruning lags one checkpoint (see below)
-    while i < num_steps:
-        # replay-exact data: a rewound step gets the batch its failed
-        # attempt consumed, not the iterator's next fresh one
-        batch = replay.batch_for(i)
-        try:
-            if fail_injector is not None:
-                fail_injector(i)
-            t0 = time.perf_counter()
-            new_state, m = _run_step(step_fn, state, batch,
-                                     rcfg.step_timeout_s)
-            loss = _step_loss(m)
-            if loss is not None and not np.isfinite(loss):
-                raise StepFailure(f"non-finite loss at step {i}: {loss}")
-        except Exception as e:  # timeout, NaN, device error, injected fault
-            metrics.count("failures")
-            from flashmoe_tpu.planner.select import (
-                PathFailure, report_path_failure,
-            )
+    # checkpoint boundary steps saved so far, ascending; pruning is
+    # gated on the DURABLE frontier, not on enqueue (see below)
+    ckpt_boundaries: list[int] = []
+    # one deadline executor per run, replaced only after a timeout
+    # (satellite fix: the old executor-per-step leaked a thread per step)
+    ex_box: list = [None]
+    try:
+        while i < num_steps:
+            if preempt is not None and preempt.requested:
+                # graceful drain: the in-flight step already finished
+                # (the flag is polled between steps); make everything
+                # durable and hand control back before the hard kill
+                ckpt.wait_for_saves()
+                if ckpt.latest_step(rcfg.checkpoint_dir) != i:
+                    ckpt.save(rcfg.checkpoint_dir, state, step=i,
+                              loader_state=replay.loader_state_for(i))
+                    metrics.count("checkpoints")
+                metrics.count("preempt_drains")
+                metrics.decision(
+                    "preempt.drain", step=i, source=preempt.source,
+                    remaining_grace_s=preempt.remaining_grace_s())
+                return state, history
+            # replay-exact data: a rewound step gets the batch its failed
+            # attempt consumed, not the iterator's next fresh one
+            batch = replay.batch_for(i)
+            try:
+                if fail_injector is not None:
+                    fail_injector(i)
+                t0 = time.perf_counter()
+                new_state, m = _run_step(step_fn, state, batch,
+                                         rcfg.step_timeout_s, ex_box)
+                loss = _step_loss(m)
+                if loss is not None and not np.isfinite(loss):
+                    raise StepFailure(
+                        f"non-finite loss at step {i}: {loss}")
+            except Exception as e:  # timeout, NaN, device error, injected
+                metrics.count("failures")
+                from flashmoe_tpu.planner.select import (
+                    PathFailure, report_path_failure,
+                )
 
-            if isinstance(e, PathFailure):
-                # tier-2 path fallback: demote the failed execution path
-                # BEFORE retrying, so the retry re-resolves onto a
-                # healthy one instead of re-tracing the same failure
-                report_path_failure(e.backend, str(e))
-                metrics.count("path_fallbacks")
-            if i == last_fail_step:
-                retries += 1
-            else:
-                retries, last_fail_step = 1, i
-            if retries > rcfg.max_retries:
-                if rcfg.emergency_save:
-                    # persist the last good state.  ``state`` may hold
-                    # DONATED buffers (a dispatched attempt consumed them
-                    # before failing) — emergency_save refuses those, and
-                    # we then fall back to the undonated host mirror.
-                    # Once a periodic checkpoint exists the mirror is
-                    # gone, but so is the need: the disk copy IS the
-                    # recovery point.
-                    saved = ckpt.emergency_save(rcfg.checkpoint_dir, state)
-                    if saved is None and safe_state is not None:
+                if isinstance(e, PathFailure):
+                    # tier-2 path fallback: demote the failed execution
+                    # path BEFORE retrying, so the retry re-resolves onto
+                    # a healthy one instead of re-tracing the failure
+                    report_path_failure(e.backend, str(e))
+                    metrics.count("path_fallbacks")
+                # an async save may still be in flight: it must land
+                # before latest_step decides where recovery restores from
+                ckpt.wait_for_saves()
+                if i == last_fail_step:
+                    retries += 1
+                else:
+                    retries, last_fail_step = 1, i
+                if retries > rcfg.max_retries:
+                    if rcfg.emergency_save:
+                        # persist the last good state.  ``state`` may
+                        # hold DONATED buffers (a dispatched attempt
+                        # consumed them before failing) — emergency_save
+                        # refuses those, and we then fall back to the
+                        # undonated host mirror.  Once a periodic
+                        # checkpoint exists the mirror is gone, but so is
+                        # the need: the disk copy IS the recovery point.
+                        lstate = replay.loader_state_for(i)
                         saved = ckpt.emergency_save(
-                            rcfg.checkpoint_dir,
-                            jax.device_put(safe_state, shardings))
-                    if saved is not None:
-                        metrics.count("emergency_saves")
-                raise StepFailure(
-                    f"step {i} failed {retries} times; last error: {e}"
-                ) from e
-            last = ckpt.latest_step(rcfg.checkpoint_dir)
-            if last is not None:
-                template = (jax.device_put(safe_state, shardings)
-                            if safe_state is not None else abstract)
-                try:
-                    state = ckpt.restore(
-                        rcfg.checkpoint_dir, template,
-                        check_integrity=rcfg.verify_checkpoints)
-                except ckpt.CheckpointCorruptionError as ce:
-                    # NOTHING intact on disk.  The in-memory mirror (if
-                    # it still exists) is the recovery point of last
-                    # resort; otherwise this run is unrecoverable — keep
-                    # the documented StepFailure contract rather than
-                    # leaking the corruption error past the retry logic
-                    if safe_state is not None:
-                        state = jax.device_put(safe_state, shardings)
-                    else:
-                        if rcfg.emergency_save:
-                            ckpt.emergency_save(rcfg.checkpoint_dir, state)
-                        raise StepFailure(
-                            f"step {i} failed and no intact checkpoint "
-                            f"remains: {ce}") from ce
-            else:
-                state = jax.device_put(safe_state, shardings)
-            i = int(state.step)
-            metrics.count("restores")
-            continue
+                            rcfg.checkpoint_dir, state,
+                            loader_state=lstate)
+                        if saved is None and safe_state is not None:
+                            saved = ckpt.emergency_save(
+                                rcfg.checkpoint_dir,
+                                jax.device_put(safe_state, shardings),
+                                loader_state=lstate)
+                        if saved is not None:
+                            metrics.count("emergency_saves")
+                    raise StepFailure(
+                        f"step {i} failed {retries} times; "
+                        f"last error: {e}"
+                    ) from e
+                last = ckpt.latest_step(rcfg.checkpoint_dir)
+                if last is not None:
+                    template = (jax.device_put(safe_state, shardings)
+                                if safe_state is not None else abstract)
+                    try:
+                        state = ckpt.restore(
+                            rcfg.checkpoint_dir, template,
+                            check_integrity=rcfg.verify_checkpoints)
+                    except ckpt.CheckpointCorruptionError as ce:
+                        # NOTHING intact on disk.  The in-memory mirror
+                        # (if it still exists) is the recovery point of
+                        # last resort; otherwise this run is
+                        # unrecoverable — keep the documented StepFailure
+                        # contract rather than leaking the corruption
+                        # error past the retry logic
+                        if safe_state is not None:
+                            state = jax.device_put(safe_state, shardings)
+                        else:
+                            if rcfg.emergency_save:
+                                ckpt.emergency_save(
+                                    rcfg.checkpoint_dir, state,
+                                    loader_state=replay.loader_state_for(i))
+                            raise StepFailure(
+                                f"step {i} failed and no intact "
+                                f"checkpoint remains: {ce}") from ce
+                else:
+                    state = jax.device_put(safe_state, shardings)
+                i = int(state.step)
+                metrics.count("restores")
+                continue
 
-        if i > last_fail_step:
-            retries = 0
-        state = new_state
-        metrics.count("steps")
-        metrics.times["step"].append(time.perf_counter() - t0)
-        rec = scalar_metrics(m)
-        if rec.get("grad_ok", 1.0) == 0.0:
-            # tier-1 guard fired inside the step: the update was skipped
-            # in-graph; surface it as a decision, not a failure
-            metrics.count("grad_skips")
-            metrics.decision("trainer.grad_skip", step=i,
-                             grad_norm=rec.get("grad_norm"),
-                             grad_norm_ema=rec.get("grad_norm_ema"))
-        history.append(rec)
-        i += 1
-        if i % rcfg.checkpoint_every == 0 or i == num_steps:
-            ckpt.save(rcfg.checkpoint_dir, state, step=i)
-            safe_state = None  # durable copy exists; free the host mirror
-            # prune the replay buffer one checkpoint BEHIND: a corrupted
-            # newest checkpoint falls back to the previous intact one,
-            # whose replay window must still be replayable bit-exact.
-            # Bound: <= 2 * checkpoint_every buffered batches.
-            if prev_ckpt_step is not None:
-                replay.prune_before(prev_ckpt_step)
-            prev_ckpt_step = i
-            metrics.count("checkpoints")
-    return state, history
+            if i > last_fail_step:
+                retries = 0
+            state = new_state
+            metrics.count("steps")
+            metrics.times["step"].append(time.perf_counter() - t0)
+            rec = scalar_metrics(m)
+            if rec.get("grad_ok", 1.0) == 0.0:
+                # tier-1 guard fired inside the step: the update was
+                # skipped in-graph; surface it as a decision, not a
+                # failure
+                metrics.count("grad_skips")
+                metrics.decision("trainer.grad_skip", step=i,
+                                 grad_norm=rec.get("grad_norm"),
+                                 grad_norm_ema=rec.get("grad_norm_ema"))
+            history.append(rec)
+            i += 1
+            if i % rcfg.checkpoint_every == 0 or i == num_steps:
+                ckpt.save(rcfg.checkpoint_dir, state, step=i,
+                          blocking=not rcfg.async_save,
+                          loader_state=replay.loader_state_for(i))
+                ckpt_boundaries.append(i)
+                durable = ckpt.latest_step(rcfg.checkpoint_dir)
+                # free the host mirror only once a checkpoint is DURABLE
+                # — an enqueued async save is a promise, not a recovery
+                # point (the writer may still fail on it)
+                if safe_state is not None and durable is not None:
+                    safe_state = None
+                # prune the replay buffer one checkpoint BEHIND the
+                # newest DURABLE boundary: a corrupted newest checkpoint
+                # falls back to the previous intact one, whose replay
+                # window must still be replayable bit-exact — and an
+                # ASYNC save is not durable at enqueue (the writer may
+                # drop it newest-wins or fail on it), so pruning keyed
+                # on enqueue could strand a restore behind the buffer.
+                # Bound: <= 2 * checkpoint_every batches once writes
+                # land (sync saves land immediately, keeping the old
+                # behavior exactly).
+                confirmed = [b for b in ckpt_boundaries
+                             if durable is not None and b <= durable]
+                if len(confirmed) >= 2:
+                    replay.prune_before(confirmed[-2])
+                    ckpt_boundaries = [b for b in ckpt_boundaries
+                                       if b >= confirmed[-2]]
+                metrics.count("checkpoints")
+        if rcfg.async_save:
+            # the run is over: the final enqueued save must LAND before
+            # the caller reads latest_step or tears the process down
+            ckpt.wait_for_saves()
+        return state, history
+    except StepFailure as e:
+        # the steps executed before the abort are real training history
+        # (their losses/grad norms are the postmortem); hand them to the
+        # caller on the exception instead of dropping them
+        e.partial_history = list(history)
+        raise
+    finally:
+        if ex_box[0] is not None:
+            ex_box[0].shutdown(wait=False)
+
+
+def supervise(cfg, data_factory: Callable, num_steps: int,
+              rcfg: ResilienceConfig | None = None, *,
+              guard=None, metrics: Metrics | None = None,
+              preempt=None, devices_fn: Callable | None = None,
+              max_restarts: int = 3, fail_injector: Callable | None = None,
+              step_wrapper: Callable | None = None, seed: int = 0,
+              use_pallas: bool | None = None):
+    """Job-level restart loop: run to ``num_steps`` across preemptions,
+    crashes, and world-size changes.
+
+    The in-process analogue of the cluster scheduler: each *incarnation*
+    sizes itself to the CURRENT device set (``devices_fn()`` or
+    ``jax.devices()``), restores the newest checkpoint resharded onto the
+    surviving devices (:func:`flashmoe_tpu.runtime.elastic.
+    elastic_resume` — parallelism re-folds, a ``supervisor.resume``
+    decision records the new world), repositions a fresh data loader
+    from the manifest cursor, and continues under
+    :func:`resilient_train`.
+
+    A graceful preemption drain ends an incarnation cleanly (the notice
+    is cleared — "the scheduler restarted us"); a :class:`StepFailure`
+    (in-job recovery exhausted — "the process died") consumes one of
+    ``max_restarts`` restarts.  ``data_factory(cfg) -> iterator`` builds
+    each incarnation's loader; make it a stateful
+    :class:`flashmoe_tpu.runtime.data.TokenLoader` for deterministic
+    data resume.  ``step_wrapper`` wraps the jitted step (chaos stalls).
+
+    Returns (state, history) with history concatenated over
+    incarnations (re-run steps appear once per execution, like
+    :func:`resilient_train`).
+    """
+    import jax.random as _random
+
+    from flashmoe_tpu.parallel.mesh import make_mesh
+    from flashmoe_tpu.runtime.elastic import elastic_resume, fold_parallelism
+    from flashmoe_tpu.runtime.trainer import (
+        init_state, make_optimizer, make_train_step, state_shardings,
+    )
+
+    rcfg = rcfg or ResilienceConfig()
+    metrics = metrics or Metrics()
+    history: list = []
+    restarts = 0
+    incarnation = 0
+    # drains don't consume the restart budget, but a notice source stuck
+    # on "always preempted" must not loop forever either
+    max_incarnations = max(8, 4 * (max_restarts + 1))
+    while True:
+        if incarnation >= max_incarnations:
+            raise StepFailure(
+                f"supervisor exceeded {max_incarnations} incarnations "
+                f"without reaching step {num_steps}")
+        devices = list(devices_fn() if devices_fn is not None
+                       else jax.devices())
+        if ckpt.latest_step(rcfg.checkpoint_dir) is not None:
+            state, mesh, fcfg, opt = elastic_resume(
+                cfg, rcfg.checkpoint_dir, devices=devices, guard=guard,
+                total_steps=num_steps)
+            metrics.decision(
+                "supervisor.resume", incarnation=incarnation,
+                step=int(state.step), world=len(devices),
+                ep=fcfg.ep, dp=fcfg.dp)
+        else:
+            fcfg = fold_parallelism(cfg, len(devices))
+            mesh = make_mesh(fcfg, devices=devices)
+            opt = make_optimizer(fcfg, total_steps=num_steps)
+            state = init_state(_random.PRNGKey(seed), fcfg, opt,
+                               guard=guard)
+            state = jax.device_put(state,
+                                   state_shardings(state, fcfg, mesh))
+        data = data_factory(fcfg)
+        if ckpt.restore_loader_state(rcfg.checkpoint_dir,
+                                     int(state.step), data):
+            metrics.count("loader_restores")
+        step_fn = make_train_step(fcfg, mesh, opt, use_pallas=use_pallas,
+                                  guard=guard)
+        if step_wrapper is not None:
+            step_fn = step_wrapper(step_fn)
+        incarnation += 1
+        try:
+            state, hist = resilient_train(
+                state, step_fn, data, num_steps, rcfg=rcfg,
+                metrics=metrics, fail_injector=fail_injector,
+                preempt=preempt)
+            history.extend(hist)
+        except StepFailure as e:
+            # in-job recovery exhausted: the real process would be dead.
+            # The scheduler restarts it — here, the next loop iteration —
+            # against whatever checkpoint the drain/emergency paths left.
+            # The dead incarnation's executed steps stay in the history.
+            history.extend(getattr(e, "partial_history", []))
+            restarts += 1
+            metrics.count("supervisor_restarts")
+            if restarts > max_restarts:
+                e.partial_history = list(history)
+                raise
+            continue
+        if int(state.step) >= num_steps:
+            return state, history
+        if preempt is not None and preempt.requested:
+            # drained on a preemption notice: this incarnation is over;
+            # clear the latch and "restart" with the current device set
+            preempt.clear()
+            metrics.count("preempt_restarts")
+            continue
+        raise StepFailure(
+            f"incarnation ended at step {int(state.step)} of {num_steps} "
+            f"with no drain and no failure — refusing to spin")

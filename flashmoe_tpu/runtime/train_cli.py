@@ -57,6 +57,14 @@ def main(argv=None) -> int:
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--checkpoint-every", type=int, default=100)
+    ap.add_argument("--async-save", action="store_true",
+                    help="hand checkpoint serialization to the background "
+                         "writer; the step loop pays only the host "
+                         "snapshot (docs/RESILIENCE.md)")
+    ap.add_argument("--grace-s", type=float, default=30.0,
+                    help="preemption grace window: SIGTERM/SIGUSR1 drain "
+                         "a final checkpoint + data-loader cursor inside "
+                         "this budget instead of dying mid-write")
     ap.add_argument("--log-every", type=int, default=10)
     ap.add_argument("--metrics-jsonl", default=None)
     ap.add_argument("--grad-guard", action="store_true",
@@ -113,13 +121,29 @@ def main(argv=None) -> int:
 
     metrics = Metrics()
     if args.checkpoint_dir:
+        from flashmoe_tpu.runtime.preempt import PreemptionListener
+
         rcfg = ResilienceConfig(
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
+            async_save=args.async_save,
         )
-        state, history = resilient_train(
-            state, step, data, args.steps, rcfg=rcfg, metrics=metrics,
-        )
+        # a TokenLoader's cursor rides every checkpoint manifest via
+        # resilient_train (state_dict/load_state_dict), so a restarted
+        # CLI run continues the exact token stream
+        preempt = PreemptionListener(grace_s=args.grace_s).install()
+        try:
+            state, history = resilient_train(
+                state, step, data, args.steps, rcfg=rcfg,
+                metrics=metrics, preempt=preempt,
+            )
+        finally:
+            preempt.uninstall()
+        if preempt.requested:
+            print(f"preempted: drained at step {int(state.step)} "
+                  f"(checkpoint + loader state in "
+                  f"{args.checkpoint_dir}); re-run to resume",
+                  file=sys.stderr)
     else:
         history = []
         for i in range(args.steps):

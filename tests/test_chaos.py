@@ -644,6 +644,32 @@ def test_drill_matrix():
 
 
 @pytest.mark.slow
+def test_drill_preempt_drains_with_zero_lost_steps():
+    from flashmoe_tpu.chaos.drill import run_drill
+
+    r = run_drill("preempt")
+    assert r.recovered, r.reason
+    assert r.expected_tier == "tier3:drain_resume"
+    assert r.steps_rerun == 0  # the drain checkpoints the exact step
+    assert r.evidence["loader_state_present"]
+    names = r.evidence["decision_names"]
+    assert "preempt.drain" in names and "supervisor.resume" in names
+
+
+@pytest.mark.slow
+def test_drill_device_loss_refolds_world():
+    from flashmoe_tpu.chaos.drill import run_drill
+
+    r = run_drill("device_loss")
+    assert r.recovered, r.reason
+    assert r.expected_tier == "tier3:elastic_refold"
+    assert r.evidence["supervisor_restarts"] >= 1
+    # the restart landed on fewer devices (8 virtual devices available)
+    worlds = [w for w in r.evidence["worlds"] if w]
+    assert worlds and min(worlds) == 1
+
+
+@pytest.mark.slow
 def test_drill_cli_exports_obs_artifacts(tmp_path):
     from flashmoe_tpu.chaos.__main__ import main
 

@@ -49,6 +49,85 @@ def test_native_matches_fallback(token_file):
     nat.close()
 
 
+def test_closed_loader_raises_clear_error(token_file):
+    """Satellite: a closed native loader used to fall through to the
+    NumPy branch and die with AttributeError: _windows."""
+    for native in (False, "auto"):
+        ld = TokenLoader(token_file, batch=4, seq_len=32, native=native)
+        next(ld)
+        ld.close()
+        ld.close()  # idempotent on both paths
+        with pytest.raises(RuntimeError, match="loader is closed"):
+            next(ld)
+        with pytest.raises(RuntimeError, match="loader is closed"):
+            ld.state_dict()
+
+
+def test_state_dict_roundtrip_mid_epoch(token_file):
+    a = TokenLoader(token_file, batch=4, seq_len=32, seed=7, native=False)
+    for _ in range(3):
+        next(a)
+    b = TokenLoader(token_file, batch=4, seq_len=32, seed=7, native=False)
+    b.load_state_dict(a.state_dict())
+    for _ in range(12):  # runs past the epoch boundary too
+        np.testing.assert_array_equal(
+            np.asarray(next(a)["tokens"]), np.asarray(next(b)["tokens"]))
+
+
+def test_state_dict_roundtrip_across_epoch_boundary(token_file):
+    """Resume from the exact epoch boundary: the lazy NumPy wrap and the
+    canonical (epoch+1, 0) state must produce the same continuation."""
+    a = TokenLoader(token_file, batch=4, seq_len=32, seed=7, native=False)
+    for _ in range(10):  # exactly one epoch of 40 windows
+        next(a)
+    st = a.state_dict()
+    assert st == {"epoch": 1, "cursor": 0, "seed": 7, "shuffle": True}
+    b = TokenLoader(token_file, batch=4, seq_len=32, seed=7, native=False)
+    b.load_state_dict(st)
+    for _ in range(6):
+        np.testing.assert_array_equal(
+            np.asarray(next(a)["tokens"]), np.asarray(next(b)["tokens"]))
+
+
+def test_state_dict_native_matches_fallback(token_file):
+    """The canonical state is path-independent: equal dicts after equal
+    consumption, and a native loader restores a fallback's state (and
+    vice versa) onto the identical stream."""
+    if _native.load() is None:
+        pytest.skip("native library unavailable")
+    nat = TokenLoader(token_file, batch=4, seq_len=32, seed=7)
+    fb = TokenLoader(token_file, batch=4, seq_len=32, seed=7,
+                     native=False)
+    assert nat.is_native
+    for _ in range(5):
+        next(nat)
+        next(fb)
+    assert nat.state_dict() == fb.state_dict()
+
+    # cross-restore: native <- fallback state (fast-forward reopen)
+    nat2 = TokenLoader(token_file, batch=4, seq_len=32, seed=7)
+    nat2.load_state_dict(fb.state_dict())
+    # fallback <- native state
+    fb2 = TokenLoader(token_file, batch=4, seq_len=32, seed=7,
+                      native=False)
+    fb2.load_state_dict(nat.state_dict())
+    for _ in range(8):
+        want = np.asarray(next(fb)["tokens"])
+        np.testing.assert_array_equal(np.asarray(next(nat2)["tokens"]),
+                                      want)
+        np.testing.assert_array_equal(np.asarray(next(fb2)["tokens"]),
+                                      want)
+    nat.close()
+    nat2.close()
+
+
+def test_load_state_dict_validates_cursor(token_file):
+    ld = TokenLoader(token_file, batch=4, seq_len=32, native=False)
+    with pytest.raises(ValueError, match="out of range"):
+        ld.load_state_dict({"epoch": 0, "cursor": 40, "seed": 0,
+                            "shuffle": False})
+
+
 def test_feeds_trainer(token_file, devices):
     import jax
     import jax.numpy as jnp

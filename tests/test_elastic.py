@@ -98,3 +98,36 @@ def test_clean_dp_ep_config_folds_silently():
         folded = fold_parallelism(_cfg(ep=2), 6)
     assert folded.ep == 2 and folded.dp == 3
     _check_valid(folded, 6)
+
+
+def test_guarded_checkpoint_without_guard_arg_raises_clearly(devices,
+                                                             tmp_path):
+    """Satellite: restoring a guard-carrying checkpoint without guard=
+    used to die inside orbax with an opaque tree-structure error; it
+    must name the mismatch and the fix instead."""
+    import jax
+    import pytest as _pytest
+
+    from flashmoe_tpu.parallel.mesh import make_mesh
+    from flashmoe_tpu.runtime import checkpoint as ckpt
+    from flashmoe_tpu.runtime.elastic import elastic_resume
+    from flashmoe_tpu.runtime.trainer import (
+        GradGuardConfig, init_state, make_optimizer, state_shardings,
+    )
+
+    cfg = _cfg(ep=1, moe_frequency=1, num_heads=2)
+    guard = GradGuardConfig(warmup_steps=2)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:1])
+    opt = make_optimizer(cfg, total_steps=4)
+    state = init_state(jax.random.PRNGKey(0), cfg, opt, guard=guard)
+    state = jax.device_put(state, state_shardings(state, cfg, mesh))
+    d = str(tmp_path / "ck_guarded")
+    ckpt.save(d, state, step=1)
+
+    with _pytest.raises(ValueError, match="GuardState.*guard="):
+        elastic_resume(cfg, d, devices=devices[:1])
+
+    # the matching call restores fine
+    restored, _mesh, _cfg2, _opt = elastic_resume(
+        cfg, d, devices=devices[:1], guard=guard)
+    assert restored.guard is not None

@@ -355,6 +355,47 @@ def test_observe_cli_rejects_empty(tmp_path, capsys):
     assert observe.main([p]) == 2
 
 
+def test_observe_resilience_report(tmp_path, capsys):
+    """The decision stream of a preempted-then-resumed run summarizes
+    into the resilience narrative (docs/RESILIENCE.md decisions)."""
+    from flashmoe_tpu import observe
+
+    decisions = [
+        {"decision": "preempt.notice", "source": "SIGTERM",
+         "grace_s": 30.0},
+        {"decision": "preempt.drain", "step": 4, "source": "SIGTERM",
+         "remaining_grace_s": 28.5},
+        {"decision": "supervisor.resume", "incarnation": 1, "step": 4,
+         "world": 4, "ep": 2, "dp": 2},
+        {"decision": "supervisor.resume", "incarnation": 2, "step": 6,
+         "world": 2, "ep": 2, "dp": 1},
+        {"decision": "trainer.grad_skip", "step": 5, "grad_norm": 1e9},
+        {"decision": "checkpoint.fallback", "corrupt_step": 8,
+         "restored_step": 6, "lost_steps": 2},
+    ]
+    p = str(tmp_path / "decisions.jsonl")
+    with open(p, "w") as f:
+        for d in decisions:
+            f.write(json.dumps(d) + "\n")
+    assert observe.main([p, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    res = doc["resilience"]
+    assert res["events"] == {"trainer.grad_skip": 1,
+                             "checkpoint.fallback": 1,
+                             "preempt.notice": 1, "preempt.drain": 1,
+                             "supervisor.resume": 2}
+    assert res["drains"] == [{"step": 4, "source": "SIGTERM",
+                              "remaining_grace_s": 28.5}]
+    assert res["worlds"] == [2, 4]  # the elastic re-fold is visible
+    assert res["resumes"][1]["ep"] == 2 and res["resumes"][1]["dp"] == 1
+
+    assert observe.main([p]) == 0
+    out = capsys.readouterr().out
+    assert "resilience events:" in out
+    assert "drain at step 4 (SIGTERM), 28.5s grace left" in out
+    assert "resume #2 at step 6: world=2 (ep=2 x dp=1)" in out
+
+
 # ----------------------------------------------------------------------
 # End to end: trainer flight recorder -> observe summary
 # ----------------------------------------------------------------------

@@ -147,6 +147,57 @@ def test_resumes_from_existing_checkpoint(devices, tmp_path):
     assert len(hist) == 2  # only steps 4 and 5 ran
 
 
+def test_deadline_executor_reused_across_steps(devices, tmp_path,
+                                               monkeypatch):
+    """Satellite: one deadline executor serves the whole run — the old
+    executor-per-step spawned (and leaked) a thread per step.  A new
+    executor appears only after a timeout abandons the stuck one."""
+    import flashmoe_tpu.runtime.resilient as res
+
+    created = {"n": 0}
+    real = res._make_deadline_executor
+
+    def counting_executor():
+        created["n"] += 1
+        return real()
+
+    monkeypatch.setattr(res, "_make_deadline_executor", counting_executor)
+    state, step, data = _fixture(devices)
+    rcfg = ResilienceConfig(checkpoint_dir=str(tmp_path / "ck"),
+                            checkpoint_every=100, step_timeout_s=60.0)
+    final, hist = resilient_train(state, step, data, num_steps=6,
+                                  rcfg=rcfg)
+    assert int(final.step) == 6
+    assert created["n"] == 1  # six steps, ONE executor
+
+    # a timeout abandons the stuck executor and the next step gets a
+    # fresh one — stalls must not poison the deadline machinery.
+    # Warm the compile OUTSIDE the deadline so it only races the stall.
+    import time as _time
+    state2, _step2, data2 = _fixture(devices)
+    mesh = make_mesh(CFG)
+    opt = make_optimizer(CFG, total_steps=8)
+    warm = init_state(jax.random.PRNGKey(5), CFG, opt)
+    warm = jax.device_put(warm, state_shardings(warm, CFG, mesh))
+    jax.block_until_ready(step(warm, next(data2)))
+    stall = {"left": 1}
+
+    def stalling_step(s, b):
+        if stall["left"]:
+            stall["left"] -= 1
+            _time.sleep(2.5)
+        return step(s, b)
+
+    created["n"] = 0
+    rcfg2 = ResilienceConfig(checkpoint_dir=str(tmp_path / "ck2"),
+                             checkpoint_every=100, step_timeout_s=1.0,
+                             max_retries=3)
+    final2, _ = resilient_train(state2, stalling_step, data2, num_steps=3,
+                                rcfg=rcfg2)
+    assert int(final2.step) == 3
+    assert created["n"] == 2  # one for the run + one after the timeout
+
+
 def test_fold_parallelism_warns_on_dropped_axes():
     """Folding a pipelined/tensor-parallel config to dp x ep changes the
     execution strategy; it must say so instead of silently reshaping the
