@@ -179,6 +179,31 @@ def _planner_fields(cfg, t_fused, t_xla) -> dict:
     return out
 
 
+def _wire_fields(cfg: MoEConfig) -> dict:
+    """Wire-dtype identity + modeled bytes saved for one bench record.
+
+    ``wire_modeled_comm_mb`` is the byte model's EP-exchange traffic at
+    this config's nominal ep width (0 at ep=1 — the single-chip headline
+    has no a2a); ``wire_modeled_comm_saved_mb`` is the drop vs the same
+    config with the wire off."""
+    from flashmoe_tpu.analysis import path_costs
+    from flashmoe_tpu.ops import wire as wr
+
+    out = {"wire_dtype": wr.canonical_name(cfg.wire_dtype),
+           "wire_dtype_combine": wr.canonical_name(cfg.wire_dtype_combine)}
+    if cfg.wire_dtype is None and cfg.wire_dtype_combine is None:
+        return out
+    d = max(cfg.ep, 1)
+    path = "ragged" if cfg.moe_backend == "ragged" else "explicit"
+    comm = path_costs(cfg, path, d_world=d).comm_bytes
+    raw = path_costs(
+        cfg.replace(wire_dtype=None, wire_dtype_combine=None),
+        path, d_world=d).comm_bytes
+    out["wire_modeled_comm_mb"] = round(comm / 2**20, 3)
+    out["wire_modeled_comm_saved_mb"] = round((raw - comm) / 2**20, 3)
+    return out
+
+
 def _emit(cfg, name, t_fused, t_xla, note: str | None = None):
     """One JSON record.  ``t_xla=None`` marks a partial measurement (the
     xla leg never completed): vs_baseline is ``null`` — not a number a
@@ -210,6 +235,15 @@ def _emit(cfg, name, t_fused, t_xla, note: str | None = None):
     rec["path"] = ("gather" if _PARTIAL.get("fused_variant") == "gather"
                    else "explicit")
     rec["d"] = 1
+    # wire-dtype knobs are part of the measurement identity (a
+    # compressed timing never overrides an uncompressed selection), and
+    # the modeled EP comm bytes at the config's nominal ep width show
+    # what the wire saves — drift monitoring then covers the
+    # compressed paths with their own keys
+    try:
+        rec.update(_wire_fields(cfg))
+    except Exception as e:  # noqa: BLE001 — never lose the record
+        rec["wire_error"] = f"{type(e).__name__}: {str(e)[:120]}"
     try:
         rec.update(_planner_fields(cfg, t_fused, t_xla))
     except Exception as e:  # noqa: BLE001 — never lose the record
@@ -435,11 +469,14 @@ def _skew_metrics(cfg: MoEConfig, ep: int, m: dict) -> dict:
     }
 
 
-def _sweep_ep(trials: int):
+def _sweep_ep(trials: int, wire_dtype: str | None = None,
+              wire_combine: str | None = None):
     """Weak-scaling sweep over the ep axis: per-rank tokens held constant
     while the mesh grows (the reference's ``scaling_gpus_8`` axis).
     Virtual CPU mesh when multi-chip hardware is absent; identical
-    procedure on real chips (FLASHMOE_OVERLAP_TPU=1)."""
+    procedure on real chips (FLASHMOE_OVERLAP_TPU=1).  ``wire_dtype`` /
+    ``wire_combine`` compress the EP exchange payload (ops/wire.py) —
+    the workload the knob exists for, so the sweep honors it."""
     import os
 
     from flashmoe_tpu.parallel.mesh import make_mesh
@@ -463,6 +500,7 @@ def _sweep_ep(trials: int):
             intermediate_size=512, sequence_len=256 * ep,
             capacity_factor=1.0, drop_tokens=True, ep=ep,
             dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+            wire_dtype=wire_dtype, wire_dtype_combine=wire_combine,
         )
         mesh = make_mesh(cfg, dp=1, devices=devs[:ep])
         params = init_moe_params(jax.random.PRNGKey(0), cfg)
@@ -474,41 +512,55 @@ def _sweep_ep(trials: int):
                                     use_pallas=on_tpu).out
         t = _time_chained(fn, x, trials=trials, chain=8)
         base_t = base_t or t
-        print(json.dumps({
+        rec = {
             "metric": f"weak_scaling_ms[collective,ep={ep},"
                       f"tokens_per_rank=256,"
                       f"{'tpu' if on_tpu else 'virtual_cpu'}]",
             "value": round(t * 1e3, 3),
             "unit": "ms",
             "vs_baseline": round(base_t / t, 3),  # weak-scaling efficiency
-        }), flush=True)
+        }
+        rec.update(_wire_fields(cfg))
+        print(json.dumps(rec), flush=True)
 
 
 def _probe_backend(timeout_s: int):
     """Run one trivial op on the default backend in a subprocess with a hard
     timeout.  The tunneled TPU backend can wedge so that even ``jax.devices()``
     hangs forever in-process; an expendable child process turns that into a
-    fast, bounded diagnostic instead of eating the whole bench deadline."""
+    fast, bounded diagnostic instead of eating the whole bench deadline.
+
+    Returns ``(ok, info, hung)`` — ``hung`` distinguishes a probe that
+    never answered (timeout: the skip case) from one that answered with
+    an error (dead backend: the error case)."""
     code = ("import jax, jax.numpy as jnp;"
             "print(jax.default_backend(), float(jnp.ones(8).sum()))")
     try:
         r = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
                            capture_output=True, text=True)
     except subprocess.TimeoutExpired:
-        return False, f"backend probe hung >{timeout_s}s (tunnel wedged?)"
+        return (False,
+                f"backend probe hung >{timeout_s}s (tunnel wedged?)", True)
     if r.returncode != 0:
         return False, (f"backend probe rc={r.returncode}: "
-                       f"{(r.stderr or '').strip()[-300:]}")
-    return True, r.stdout.strip()
+                       f"{(r.stderr or '').strip()[-300:]}"), False
+    return True, r.stdout.strip(), False
 
 
-def _probe_backend_retry(budget_s: int, each_s: int = 90):
-    """Retry the backend probe until it succeeds or the budget runs out.
+def _probe_backend_retry(budget_s: int, each_s: int = 90,
+                         max_attempts: int = 0):
+    """Retry the backend probe until it succeeds, the budget runs out,
+    or ``max_attempts`` probes all failed (0 = budget-bounded only).
 
     The tunnel wedges transiently; failing the whole bench on one bad probe
-    cost two rounds of driver-captured numbers (BENCH_r01/r02 value: -1).
-    A wedged probe subprocess already consumed ``each_s``; on fast failures
-    sleep a bit so a flapping relay has time to come back."""
+    cost two rounds of driver-captured numbers (BENCH_r01/r02 value: -1) —
+    but retrying a WEDGED tunnel for the full budget burned 309 s before
+    exiting rc=2 (BENCH_r05), so ``FLASHMOE_PROBE_ATTEMPTS`` /
+    ``FLASHMOE_PROBE_TIMEOUT`` bound the loop for drivers that prefer a
+    fast, well-formed skip.  A wedged probe subprocess already consumed
+    ``each_s``; on fast failures sleep a bit so a flapping relay has time
+    to come back.  Returns ``(ok, info, hung)``; ``hung`` is True when
+    the final failure was a probe that never answered."""
     start = time.monotonic()
     attempt = 0
     while True:
@@ -516,12 +568,14 @@ def _probe_backend_retry(budget_s: int, each_s: int = 90):
         t0 = time.monotonic()
         remaining = budget_s - (time.monotonic() - start)
         # clamp so the final attempt cannot overrun the budget by each_s
-        ok, info = _probe_backend(max(10, min(each_s, int(remaining))))
+        ok, info, hung = _probe_backend(max(10, min(each_s, int(remaining))))
         if ok:
-            return True, f"{info} (probe attempt {attempt})"
+            return True, f"{info} (probe attempt {attempt})", False
         elapsed = time.monotonic() - start
-        if elapsed >= budget_s:
-            return False, f"{info} after {attempt} attempts / {elapsed:.0f}s"
+        if elapsed >= budget_s or (max_attempts and attempt >= max_attempts):
+            return (False,
+                    f"{info} after {attempt} attempts / {elapsed:.0f}s",
+                    hung)
         print(f"# probe attempt {attempt} failed ({info}); retrying",
               file=sys.stderr, flush=True)
         if time.monotonic() - t0 < 15:
@@ -557,6 +611,23 @@ def main():
                     default=int(os.environ.get("FLASHMOE_PROBE_BUDGET", 300)),
                     help="how long to keep retrying the backend probe (s) "
                          "before giving up")
+    ap.add_argument("--probe-attempts", type=int,
+                    default=int(os.environ.get("FLASHMOE_PROBE_ATTEMPTS",
+                                               0)),
+                    help="max probe attempts before giving up "
+                         "(0 = bounded by --probe-budget alone); a probe "
+                         "that never answers then yields a well-formed "
+                         "skipped:true record with rc 0")
+    ap.add_argument("--probe-timeout", type=int,
+                    default=int(os.environ.get("FLASHMOE_PROBE_TIMEOUT",
+                                               90)),
+                    help="per-attempt probe timeout (s)")
+    ap.add_argument("--wire-dtype", default=None,
+                    help="EP payload wire dtype for the dispatch leg "
+                         "(bf16 / e4m3 / e5m2; default off) — recorded "
+                         "on every emitted measurement")
+    ap.add_argument("--wire-combine", default=None,
+                    help="EP payload wire dtype for the combine leg")
     ap.add_argument("--obs-dir",
                     default=os.environ.get("FLASHMOE_OBS_DIR"),
                     help="directory for observability artifacts "
@@ -596,6 +667,12 @@ def main():
     if args.deadline > 0:
         signal.signal(signal.SIGALRM, on_deadline)
 
+    if (args.wire_dtype or args.wire_combine) and (args.ckpt
+                                                   or args.overlap):
+        # refuse rather than silently measure uncompressed: these modes
+        # build their own configs and do not exchange wire payloads
+        ap.error("--wire-dtype/--wire-combine apply to the latency "
+                 "bench and --sweep runs, not --ckpt/--overlap")
     if args.ckpt:
         if args.deadline > 0:
             signal.alarm(args.deadline)  # host-side path: no probe leg
@@ -609,11 +686,26 @@ def main():
     if args.sweep == "ep":
         if args.deadline > 0:
             signal.alarm(args.deadline)
-        _sweep_ep(args.trials)
+        _sweep_ep(args.trials, wire_dtype=args.wire_dtype,
+                  wire_combine=args.wire_combine)
         return
 
-    ok, info = _probe_backend_retry(args.probe_budget)
+    ok, info, hung = _probe_backend_retry(args.probe_budget,
+                                          each_s=max(args.probe_timeout, 10),
+                                          max_attempts=args.probe_attempts)
     if not ok:
+        if hung:
+            # the backend never answered: a wedged tunnel is an
+            # environment condition, not a measurement failure — emit a
+            # well-formed skip (rc 0) the driver can file as "no data"
+            # instead of an error record (BENCH_r05: 309 s of retries
+            # for an rc=2 the driver could not distinguish from a bug)
+            print(json.dumps({
+                "metric": f"moe_layer_fwd_ms[{args.config}]",
+                "value": None, "unit": "ms", "vs_baseline": None,
+                "skipped": True, "reason": info,
+            }), flush=True)
+            sys.exit(0)
         emit_error(info)
     print(f"# backend up: {info}", file=sys.stderr, flush=True)
 
@@ -625,6 +717,9 @@ def main():
     cfg = BENCH_CONFIGS[args.config]
     if cfg.ep > 1 and len(jax.devices()) < cfg.ep:
         cfg = cfg.replace(ep=1)
+    if args.wire_dtype or args.wire_combine:
+        cfg = cfg.replace(wire_dtype=args.wire_dtype,
+                          wire_dtype_combine=args.wire_combine)
 
     try:
         if args.sweep == "tokens":

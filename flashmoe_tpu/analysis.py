@@ -54,6 +54,27 @@ def xla_cost(fn, *abstract_args) -> dict:
     }
 
 
+def wire_row_bytes(cfg: MoEConfig, leg: str = "dispatch") -> float:
+    """Bytes ONE token row occupies on the EP all-to-all wire for
+    ``leg`` ('dispatch' | 'combine'): ``H x wire itemsize`` plus the
+    4-byte f32 per-row scale sidecar for fp8 wires
+    (:mod:`flashmoe_tpu.ops.wire`), or ``H x compute itemsize`` when the
+    leg's wire is off.  Every comm term below — and the planner's slab
+    serialization (:mod:`flashmoe_tpu.planner.model`) — prices the
+    exchange through this one function, so the byte model can never
+    disagree with the codec about what actually crosses the wire."""
+    from flashmoe_tpu.ops import wire as wr
+
+    if leg not in ("dispatch", "combine"):
+        raise ValueError(f"unknown wire leg {leg!r}")
+    name = cfg.wire_dtype if leg == "dispatch" else cfg.wire_dtype_combine
+    wd = wr.resolve(name)
+    h = cfg.hidden_size
+    if wd is None:
+        return float(h * jnp.dtype(cfg.dtype).itemsize)
+    return float(h * jnp.dtype(wd).itemsize + wr.scale_bytes(wd))
+
+
 def layer_flops(cfg: MoEConfig, tokens: int | None = None) -> float:
     """Model FLOPs of one MoE-layer forward: gate GEMM + routed expert
     FFN (2 GEMMs, or 3 with the gated/SwiGLU branch), matching the
@@ -162,6 +183,14 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1,
     nlx = e // d_world
     rows = s * k                       # routed rows on this chip's tokens
     slots = d_world * nlx * cap        # slab slots touching this chip
+    # EP exchange traffic of the XLA transports (d_world > 1): each a2a
+    # leg reads the send buffer and writes the receive buffer — counted
+    # once each per the module's remote-DMA convention, at the WIRE
+    # row size (= compute row size when wire_dtype is off), so turning
+    # compression on shrinks this term by the wire/compute itemsize
+    # ratio (plus the fp8 scale sidecar).
+    a2a_row = (wire_row_bytes(cfg, "dispatch")
+               + wire_row_bytes(cfg, "combine")) if d_world > 1 else 0.0
     w_mult = 3 if g["gated"] else 2    # matrices per expert (gate/up/down)
     # weight bytes of the experts THIS chip computes, once per stream
     w_once = nlx * w_mult * h * i * dt
@@ -201,9 +230,11 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1,
     if path == "explicit":
         dispatch = s * h * dt + slots * h * dt
         combine = rows * h * dt + s * h * 4
+        # both a2a legs move full capacity slabs (ep._ep_moe_shard)
+        comm = 2 * slots * a2a_row
         return PathCost(path, w_once,
                         gate_bytes + slots * h * dt + slots * h * dt,
-                        dispatch, 0.0, combine, combine, flops)
+                        dispatch, comm, combine, combine, flops)
     if path == "gather":
         # no dispatch buffer: the kernel's per-row DMAs read exactly the
         # routed rows (ops/expert.py:grouped_ffn_tokens)
@@ -221,9 +252,11 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1,
         # rows per token.
         dispatch = s * h * dt + rows * h * dt
         combine = rows * h * dt + s * h * 4
+        # both ragged a2a legs move exactly the routed rows
+        comm = 2 * rows * a2a_row
         return PathCost(path, w_once,
                         gate_bytes + rows * h * dt + rows * h * dt,
-                        dispatch, 0.0, combine, combine, flops)
+                        dispatch, comm, combine, combine, flops)
     if path in ("fused", "fused_combine"):
         # dispatch builds x_send; phase-1 RDMAs read x_send and write
         # x_recv on the peers (slots bytes each side); the FFN streams

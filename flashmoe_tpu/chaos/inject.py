@@ -81,6 +81,25 @@ def poison_expert(ybuf):
     return ybuf.at[e].set(jnp.asarray(jnp.nan, ybuf.dtype))
 
 
+def poison_local_expert(yloc, axis: str, num_experts: int):
+    """NaN the armed GLOBAL expert's rows of a pre-exchange expert-
+    parallel buffer ``[nLx, rows, H]`` inside a shard_map body over
+    ``axis``: only the expert's owner rank poisons, at local row
+    ``expert % nLx`` — the same global-expert-id semantics as
+    :func:`poison_expert`'s ``[E, C, H]`` site, but applied where the
+    fault physically originates (the owner, BEFORE the return
+    exchange), so the NaN crosses the transport — wire compression
+    included — before any health mask sees it."""
+    import jax
+
+    yloc = jnp.asarray(yloc)
+    nlx = yloc.shape[0]
+    e = int(_ARMED["nan_expert"].get("expert", 0)) % num_experts
+    mine = jax.lax.axis_index(axis) == e // nlx
+    poisoned = yloc.at[e % nlx].set(jnp.asarray(jnp.nan, yloc.dtype))
+    return jnp.where(mine, poisoned, yloc)
+
+
 def poison_logits(logits):
     """Bias the router logits hard toward one expert: logits [S, E].
     An additive logit bias is input-independent — every token's top-1

@@ -124,6 +124,23 @@ class MoEConfig:
     # tuning-table / bench measurements cover the shape
     moe_backend: str = "collective"
 
+    # Wire-dtype compression of the EP all-to-all payload
+    # (flashmoe_tpu/ops/wire.py): tokens are quantized immediately
+    # before each exchange and dequantized immediately after, so only
+    # the wire sees the narrow dtype — every compute stage stays at
+    # `dtype`.  `wire_dtype` covers the dispatch leg (tokens -> expert
+    # owners), `wire_dtype_combine` the return leg (expert outputs back
+    # to token owners — independent because it carries gate-weighted
+    # results that often want to stay high-precision).  Values: "bf16"
+    # (plain cast), "e4m3"/"e5m2" (per-token-row scaled fp8, f32 scales
+    # ride as a sidecar).  Default None: OFF, the hot path is
+    # bit-identical to a compression-free build (the collect_stats /
+    # degrade_unhealthy_experts convention; asserted by
+    # tests/test_wire.py).  XLA transports only — the fused RDMA kernel
+    # moves raw slabs, so `moe_backend='fused'` rejects these knobs.
+    wire_dtype: str | None = None
+    wire_dtype_combine: str | None = None
+
     # In-graph MoE observability (flashmoe_tpu/ops/stats.py): when True,
     # every MoE layer additionally returns a MoEStats tuple (per-expert
     # load histogram, dropped-token fraction, capacity utilization,
@@ -186,6 +203,31 @@ class MoEConfig:
             raise ValueError(
                 "moe_backend='ragged' does not support shared experts; "
                 "use 'collective' or 'fused'"
+            )
+        # wire-dtype knobs: reject unsupported combinations at config
+        # time (unknown name, fp8 on a jax build without float8, wire
+        # wider than the compute dtype, fused backend) instead of
+        # failing inside shard_map
+        from flashmoe_tpu.ops import wire as _wire
+
+        for knob, val in (("wire_dtype", self.wire_dtype),
+                          ("wire_dtype_combine", self.wire_dtype_combine)):
+            if val is None:
+                continue
+            wd = _wire.resolve(val)  # ValueError on unknown/unsupported
+            if jnp.dtype(wd).itemsize > jnp.dtype(self.dtype).itemsize:
+                raise ValueError(
+                    f"{knob}={val!r} ({jnp.dtype(wd).itemsize} B) is wider "
+                    f"than the compute dtype "
+                    f"{jnp.dtype(self.dtype).name} "
+                    f"({jnp.dtype(self.dtype).itemsize} B); a wire must "
+                    f"compress, not inflate")
+        if ((self.wire_dtype or self.wire_dtype_combine)
+                and self.moe_backend == "fused"):
+            raise ValueError(
+                "wire-dtype compression rides the XLA transports; "
+                "moe_backend='fused' RDMAs raw slabs in-kernel — use "
+                "'collective', 'ragged', or 'auto'"
             )
 
     # ------------------------------------------------------------------

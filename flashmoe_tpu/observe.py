@@ -140,6 +140,26 @@ def degradation_report(flight: list[dict]) -> dict:
     }
 
 
+def wire_report(flight: list[dict]) -> dict:
+    """Wire-compression health (ops/wire.py): the round-trip
+    quantization-error proxy the EP layers attach to MoEStats when a
+    ``wire_dtype`` is on.  Steps where the wire was active (error > 0),
+    mean/max error — a rising error flags payload distributions the fp8
+    wire no longer represents well."""
+    errs = []
+    for rec in flight:
+        for m in _layer_stats(rec):
+            e = m.get("wire_rtq_error")
+            if isinstance(e, (int, float)) and e > 0:
+                errs.append(float(e))
+    return {
+        "steps_with_wire": len(errs),
+        "mean_rtq_error": round(sum(errs) / len(errs), 6) if errs
+        else None,
+        "max_rtq_error": round(max(errs), 6) if errs else None,
+    }
+
+
 def resilience_report(records: list[dict]) -> dict:
     """Fault-tolerance narrative from the decision stream
     (docs/RESILIENCE.md): how often each recovery rung fired, every
@@ -210,6 +230,7 @@ def summarize(records: list[dict]) -> dict:
         "imbalance": imbalance_report(flight),
         "drops": drop_report(flight),
         "degradation": degradation_report(flight),
+        "wire": wire_report(flight),
         "resilience": resilience_report(records),
         "phases": phase_report(records),
         "drift": drift_report(records),
@@ -257,6 +278,13 @@ def render_text(s: dict) -> str:
             lines.append(f"  step {t['step']}: masked "
                          f"{t['masked_experts']:g} experts, fraction "
                          f"{t['masked_fraction']}")
+    wire = s.get("wire", {})
+    if wire.get("steps_with_wire"):
+        lines.append("")
+        lines.append(f"wire compression: active on "
+                     f"{wire['steps_with_wire']} layer-steps, round-trip "
+                     f"quantization error mean {wire['mean_rtq_error']} "
+                     f"max {wire['max_rtq_error']}")
     res = s.get("resilience", {})
     if res.get("events"):
         lines.append("")

@@ -51,6 +51,12 @@ class MoEStats(NamedTuple):
     masked_fraction:      [] fraction of (token, k) assignments whose
                           expert contribution was zeroed by the tier-0
                           mask (0.0 when every expert is healthy).
+    wire_rtq_error:       [] round-trip quantization error of the EP
+                          wire-dtype compression (ops/wire.py): mean
+                          relative L1 error of encode+decode over the
+                          dispatched payload, pmeaned across ranks.
+                          0.0 when ``wire_dtype`` is off (or the layer
+                          has no exchange).
     """
 
     expert_load: jnp.ndarray
@@ -61,6 +67,7 @@ class MoEStats(NamedTuple):
     topk_confidence: jnp.ndarray
     masked_experts: jnp.ndarray
     masked_fraction: jnp.ndarray
+    wire_rtq_error: jnp.ndarray
 
 
 def load_imbalance(expert_load) -> jnp.ndarray:
@@ -134,6 +141,9 @@ def moe_stats(router_out, cfg: MoEConfig, capacity: int | None
         # the expert OUTPUTS, which do not exist yet at routing time)
         masked_experts=zero,
         masked_fraction=zero,
+        # wire-compression error: filled in by the EP layers via
+        # with_wire_error() once the dispatch payload exists
+        wire_rtq_error=zero,
     )
 
 
@@ -145,6 +155,20 @@ def with_degradation(stats: MoEStats, masked_experts,
         masked_experts=jnp.asarray(masked_experts, jnp.float32),
         masked_fraction=jnp.asarray(masked_fraction, jnp.float32),
     )
+
+
+def with_wire_error(stats: MoEStats, wire_rtq_error,
+                    reduce_axes=None) -> MoEStats:
+    """Attach the wire-compression round-trip error
+    (:func:`flashmoe_tpu.ops.wire.roundtrip_error`) to a stats tuple.
+    Inside a shard_map body pass ``reduce_axes`` to pmean the per-shard
+    proxy across ranks (every rank holds the same token count)."""
+    err = jnp.asarray(wire_rtq_error, jnp.float32)
+    if reduce_axes is not None:
+        import jax
+
+        err = jax.lax.pmean(err, reduce_axes)
+    return stats._replace(wire_rtq_error=err)
 
 
 def reduce_stats(local: MoEStats, probs_mean, reduce_axes) -> MoEStats:
@@ -168,12 +192,14 @@ def reduce_stats(local: MoEStats, probs_mean, reduce_axes) -> MoEStats:
         imbalance=load_imbalance(g_load),
         router_entropy=router_entropy(g_probs, g_load),
         topk_confidence=jax.lax.pmean(local.topk_confidence, reduce_axes),
-        # tier-0 degradation counters pass through untouched: they are
-        # zeros unless degrade_unhealthy_experts is on, and the layer
-        # reduces them itself in that case — reducing constants here
-        # would add two collectives to every stats-on graph for nothing
+        # tier-0 degradation counters and the wire-error proxy pass
+        # through untouched: they are zeros unless their feature flag is
+        # on, and the layer reduces them itself in that case — reducing
+        # constants here would add collectives to every stats-on graph
+        # for nothing
         masked_experts=local.masked_experts,
         masked_fraction=local.masked_fraction,
+        wire_rtq_error=local.wire_rtq_error,
     )
 
 
@@ -196,4 +222,5 @@ def stats_to_host(stats: MoEStats) -> dict:
         "topk_confidence": float(host.topk_confidence),
         "masked_experts": float(host.masked_experts),
         "masked_fraction": float(host.masked_fraction),
+        "wire_rtq_error": float(host.wire_rtq_error),
     }

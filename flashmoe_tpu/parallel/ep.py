@@ -24,6 +24,13 @@ latency-hiding scheduler's job at this level (it overlaps the all-to-all
 with surrounding compute); the fused Pallas path in
 :mod:`flashmoe_tpu.parallel.fused` goes further with device-initiated
 remote DMA inside the kernel.
+
+Both exchanges optionally compress their payload to a narrow wire dtype
+(``MoEConfig.wire_dtype`` / ``wire_dtype_combine`` —
+:mod:`flashmoe_tpu.ops.wire`): rows quantize just before the a2a and
+dequantize just after, halving (bf16) or quartering (fp8 + f32 per-row
+scale sidecar) the ICI/DCN bytes while every compute stage stays at the
+compute dtype.  Off by default; the wire-off graph is bit-identical.
 """
 
 from __future__ import annotations
@@ -40,6 +47,7 @@ from flashmoe_tpu.models.reference import shared_expert_ffn
 from flashmoe_tpu.ops import dispatch as dsp
 from flashmoe_tpu.ops import expert as exp
 from flashmoe_tpu.ops import stats as st
+from flashmoe_tpu.ops import wire as wr
 from flashmoe_tpu.ops.gate import router
 from flashmoe_tpu.ops.moe import MoEOutput, dense_ffn
 from flashmoe_tpu.utils.telemetry import trace_span
@@ -84,6 +92,35 @@ def _hierarchical_a2a(t, axis: str, d: int, inner: int, *, reverse: bool):
     return t.reshape((d,) + rest)
 
 
+def _exchange(t, axis: str, d: int, dcn_inner: int | None, *,
+              reverse: bool):
+    """One a2a hop of a ``[D, ...]`` dest-major array: the two-stage
+    ICI+DCN decomposition when a slice blocking is known, the flat
+    ``all_to_all`` otherwise.  Shape-generic so the wire codec's payload
+    and scale sidecar ride the identical route."""
+    if dcn_inner is not None and 1 < dcn_inner < d:
+        return _hierarchical_a2a(t, axis, d, dcn_inner, reverse=reverse)
+    return jax.lax.all_to_all(
+        t, axis, split_axis=0, concat_axis=0, tiled=False,
+    )
+
+
+def _wired_exchange(t, wire_dtype, axis: str, d: int,
+                    dcn_inner: int | None, *, reverse: bool):
+    """Exchange ``t`` ([D, ..., H], rows on the last axis), quantized to
+    ``wire_dtype`` for the wire only (``None`` = raw — the graph is then
+    exactly the pre-compression one).  For fp8 wires the per-row f32
+    scales ride the same (flat or hierarchical) route as the payload, so
+    both hops of the two-stage exchange stay consistent."""
+    if wire_dtype is None:
+        return _exchange(t, axis, d, dcn_inner, reverse=reverse)
+    payload, scales = wr.encode(t, wire_dtype)
+    payload = _exchange(payload, axis, d, dcn_inner, reverse=reverse)
+    if scales is not None:
+        scales = _exchange(scales, axis, d, dcn_inner, reverse=reverse)
+    return wr.decode(payload, scales, t.dtype)
+
+
 def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
                   reduce_axes: tuple[str, ...] = ("ep",),
                   tp_axis: str | None = None,
@@ -106,6 +143,8 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
     s_loc, h = x.shape
     e, nlx = cfg.num_experts, cfg.num_experts // d
     cap = local_capacity(cfg, s_loc)
+    wire_disp = wr.resolve(cfg.wire_dtype)
+    wire_comb = wr.resolve(cfg.wire_dtype_combine)
 
     # phase spans mirror the reference's NVTX "Flashmoe" domain
     # (telemetry.cuh): named HLO scopes so xprof traces show gate /
@@ -119,19 +158,19 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
         xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)  # [E, C, H]
 
     # exchange expert-major slabs: [E, C, H] -> [D, nLx, C, H] received
+    wire_err = None
     with trace_span("moe.a2a_dispatch"):
+        send = xbuf.reshape(d, nlx, cap, h)
+        if cfg.collect_stats and wire_disp is not None:
+            # round-trip error proxy on the payload actually shipped —
+            # stats-gated, so the stats-off graph carries no extra pass
+            wire_err = wr.roundtrip_error(send, wire_disp)
         if skip_exchange:
-            recv = xbuf.reshape(d, nlx, cap, h)
-        elif dcn_inner is not None and 1 < dcn_inner < d:
-            recv = _hierarchical_a2a(
-                xbuf.reshape(d, nlx, cap, h), axis, d, dcn_inner,
-                reverse=False,
-            )
+            recv = send
         else:
-            recv = jax.lax.all_to_all(
-                xbuf.reshape(d, nlx, cap, h), axis, split_axis=0,
-                concat_axis=0, tiled=False,
-            )  # [D, nLx, C, H] — dim 0 now indexes source rank
+            recv = _wired_exchange(send, wire_disp, axis, d, dcn_inner,
+                                   reverse=False)
+            # [D, nLx, C, H] — dim 0 now indexes source rank
     ybuf_in = recv.transpose(1, 0, 2, 3).reshape(nlx, d * cap, h)
 
     ffn_params = params
@@ -149,24 +188,32 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
         if tp_axis is not None:
             yloc = jax.lax.psum(yloc, tp_axis)
 
-    # reverse: [nLx, D*C, H] -> [D, nLx, C, H] -> all_to_all -> [E, C, H]
-    with trace_span("moe.a2a_combine"):
-        ysend = yloc.reshape(nlx, d, cap, h).transpose(1, 0, 2, 3)
-        if skip_exchange:
-            yback = ysend
-        elif dcn_inner is not None and 1 < dcn_inner < d:
-            yback = _hierarchical_a2a(ysend, axis, d, dcn_inner,
-                                      reverse=True)
-        else:
-            yback = jax.lax.all_to_all(
-                ysend, axis, split_axis=0, concat_axis=0, tiled=False
-            )  # [D, nLx, C, H] — dim 0 indexes expert-owner rank
-    ybuf = yback.reshape(e, cap, h)
-
     from flashmoe_tpu.chaos import inject as chaos_inject
 
     if chaos_inject.is_armed("nan_expert"):  # trace-time check only
-        ybuf = chaos_inject.poison_expert(ybuf)
+        # poison BEFORE the return exchange: the fault originates at the
+        # sick expert's owner and must cross the transport — wire
+        # compression included — before the health mask sees it (the
+        # chaos drill's through-the-wire guarantee, tests/test_chaos.py).
+        # The armed spec names a GLOBAL expert id, exactly as at the
+        # [E, C, H] hook site in ops/moe.py.
+        yloc = chaos_inject.poison_local_expert(yloc, axis, e)
+
+    # reverse: [nLx, D*C, H] -> [D, nLx, C, H] -> all_to_all -> [E, C, H]
+    with trace_span("moe.a2a_combine"):
+        ysend = yloc.reshape(nlx, d, cap, h).transpose(1, 0, 2, 3)
+        if cfg.collect_stats and wire_comb is not None:
+            comb_err = wr.roundtrip_error(ysend, wire_comb)
+            wire_err = (comb_err if wire_err is None
+                        else jnp.maximum(wire_err, comb_err))
+        if skip_exchange:
+            yback = ysend
+        else:
+            yback = _wired_exchange(ysend, wire_comb, axis, d, dcn_inner,
+                                    reverse=True)
+            # [D, nLx, C, H] — dim 0 indexes expert-owner rank
+    ybuf = yback.reshape(e, cap, h)
+
     healthy = None
     combine_w = r.combine_weights
     if cfg.degrade_unhealthy_experts:
@@ -197,6 +244,8 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
 
             stats = hlt.attach_degradation(stats, healthy, r.expert_idx,
                                            reduce_axes)
+        if wire_err is not None:
+            stats = st.with_wire_error(stats, wire_err, reduce_axes)
     return MoEOutput(out.astype(cfg.dtype), aux, z, counts, stats)
 
 

@@ -1382,6 +1382,14 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
     statically from the measured topology).
     """
 
+    if cfg.wire_dtype or cfg.wire_dtype_combine:
+        # config.py already rejects moe_backend='fused' + wire; this
+        # guards DIRECT layer calls so a wire knob is never silently
+        # ignored by the raw-slab RDMA transport
+        raise ValueError(
+            "fused_ep_moe_layer moves raw slabs in-kernel and cannot "
+            "honor wire_dtype compression; use ep_moe_layer or "
+            "ragged_ep_moe_layer")
     d_world = mesh.shape["ep"]
     if src_order is None:
         # a bootstrapped runtime on a heterogeneous fabric publishes its

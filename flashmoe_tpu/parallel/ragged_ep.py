@@ -35,8 +35,78 @@ from flashmoe_tpu.utils.compat import axis_size, shard_map
 from flashmoe_tpu.ops import expert as exp
 from flashmoe_tpu.ops import ragged as rag
 from flashmoe_tpu.ops import stats as st
+from flashmoe_tpu.ops import wire as wr
 from flashmoe_tpu.ops.gate import router
 from flashmoe_tpu.ops.moe import MoEOutput
+
+
+def _row_exchange(arr, *, axis: str, d: int, exchange: str,
+                  block_rows: int, out_bound: int,
+                  send_offsets, send_sizes, remote_offsets,
+                  recv_sizes, recv_offsets):
+    """Move ragged row blocks of ``arr`` ([N, W], any W / dtype) between
+    ranks.  Rank-local blocks start at ``send_offsets`` with
+    ``send_sizes`` rows; block ``p`` lands at ``remote_offsets[p]`` of
+    peer ``p``'s ``[out_bound, W]`` output, which locally holds
+    ``recv_sizes`` rows per source starting at ``recv_offsets``
+    (``recv_offsets`` being the local cumsum view ``remote_offsets``
+    describes remotely).  One implementation for both transfer
+    directions and for the payload AND the fp8 scale sidecar, so the
+    two can never take different routes.
+
+    ``exchange='ragged'`` is the TPU ``ragged_all_to_all``; ``'dense'``
+    pads each block to ``block_rows`` rows and compacts after a dense
+    ``all_to_all`` (CPU fallback — identical layout logic)."""
+    w = arr.shape[1]
+    if exchange == "ragged":
+        return jax.lax.ragged_all_to_all(
+            arr, jnp.zeros((out_bound, w), arr.dtype),
+            send_offsets, send_sizes, remote_offsets, recv_sizes,
+            axis_name=axis,
+        )
+    blocks = jnp.zeros((d, block_rows, w), arr.dtype)
+
+    def fill(peer, blocks):
+        rows = jax.lax.dynamic_slice(
+            jnp.pad(arr, ((0, block_rows), (0, 0))),
+            (send_offsets[peer], 0), (block_rows, w),
+        )
+        mask = (jnp.arange(block_rows) < send_sizes[peer])[:, None]
+        return blocks.at[peer].set(jnp.where(mask, rows, 0))
+
+    blocks = jax.lax.fori_loop(0, d, fill, blocks)
+    got = jax.lax.all_to_all(
+        blocks.reshape(d, 1, block_rows, w), axis, split_axis=0,
+        concat_axis=0, tiled=False,
+    ).reshape(d, block_rows, w)
+    buf = jnp.zeros((out_bound, w), arr.dtype)
+
+    def compact(peer, buf):
+        rows = got[peer]
+        idx = jnp.where(
+            jnp.arange(block_rows) < recv_sizes[peer],
+            recv_offsets[peer] + jnp.arange(block_rows),
+            out_bound,  # dropped
+        )
+        return buf.at[idx].set(rows, mode="drop")
+
+    return jax.lax.fori_loop(0, d, compact, buf)
+
+
+def _wired_row_exchange(arr, wire_dtype, **kw):
+    """:func:`_row_exchange` with the wire codec applied at the
+    boundary: rows quantize to ``wire_dtype`` before the transfer and
+    dequantize after; fp8 per-row scales ride an identical second
+    exchange as a [N, 1] column.  ``wire_dtype=None`` is the raw path —
+    the exact pre-compression graph."""
+    if wire_dtype is None:
+        return _row_exchange(arr, **kw)
+    payload, scales = wr.encode(arr, wire_dtype)
+    payload = _row_exchange(payload, **kw)
+    if scales is None:
+        return wr.decode(payload, None, arr.dtype)
+    scales = _row_exchange(scales[:, None], **kw)
+    return wr.decode(payload, scales[:, 0], arr.dtype)
 
 
 def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
@@ -48,6 +118,8 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
     nlx = e // d
     n_assign = s_loc * cfg.expert_top_k
     recv_bound = d * n_assign  # worst case: everyone routes to me
+    wire_disp = wr.resolve(cfg.wire_dtype)
+    wire_comb = wr.resolve(cfg.wire_dtype_combine)
 
     r = router(x, params["gate_w"], cfg, use_pallas=use_pallas,
                interpret=interpret)
@@ -78,42 +150,16 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
     ).reshape(d, nlx)
 
     # ---- forward data exchange: src-major ragged layout ----
-    if exchange == "ragged":
-        x_recv = jax.lax.ragged_all_to_all(
-            xs, jnp.zeros((recv_bound, h), xs.dtype),
-            input_offsets, send_sizes, out_offsets, recv_sizes,
-            axis_name=axis,
-        )
-    else:
-        # dense fallback: pad each src->dst block to n_assign rows
-        blocks = jnp.zeros((d, n_assign, h), xs.dtype)
-
-        def fill(dst, blocks):
-            rows = jax.lax.dynamic_slice(
-                jnp.pad(xs, ((0, n_assign), (0, 0))),
-                (input_offsets[dst], 0), (n_assign, h),
-            )
-            mask = (jnp.arange(n_assign) < send_sizes[dst])[:, None]
-            return blocks.at[dst].set(jnp.where(mask, rows, 0))
-
-        blocks = jax.lax.fori_loop(0, d, fill, blocks)
-        got = jax.lax.all_to_all(
-            blocks.reshape(d, 1, n_assign, h), axis, split_axis=0,
-            concat_axis=0, tiled=False,
-        ).reshape(d, n_assign, h)
-        # compact the padded blocks into the ragged src-major layout
-        x_recv = jnp.zeros((recv_bound, h), xs.dtype)
-
-        def compact(src, buf):
-            rows = got[src]
-            idx = jnp.where(
-                jnp.arange(n_assign) < recv_sizes[src],
-                recv_offsets[src] + jnp.arange(n_assign),
-                recv_bound,  # dropped
-            )
-            return buf.at[idx].set(rows, mode="drop")
-
-        x_recv = jax.lax.fori_loop(0, d, compact, x_recv)
+    wire_err = None
+    if cfg.collect_stats and wire_disp is not None:
+        wire_err = wr.roundtrip_error(xs, wire_disp)
+    x_recv = _wired_row_exchange(
+        xs, wire_disp, axis=axis, d=d, exchange=exchange,
+        block_rows=n_assign, out_bound=recv_bound,
+        send_offsets=input_offsets, send_sizes=send_sizes,
+        remote_offsets=out_offsets, recv_sizes=recv_sizes,
+        recv_offsets=recv_offsets,
+    )
 
     # ---- regroup src-major -> tile-padded expert-major (arithmetic) ----
     # per-expert totals and padded segment starts
@@ -204,46 +250,23 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
         (rows < total_recv)[:, None], y_src_major, 0
     ).astype(xs.dtype)
 
-    if exchange == "ragged":
-        # returned rows must land where the source originally staged them:
-        # on rank s that's s's input_offsets[my] = exclusive row-cumsum of
-        # its send sizes — derivable from the gathered send matrix
-        rev_out_offsets = (
-            jnp.cumsum(all_send, axis=1) - all_send
-        )[:, my].astype(jnp.int32)
-        ys = jax.lax.ragged_all_to_all(
-            y_src_major, jnp.zeros((n_assign, h), xs.dtype),
-            recv_offsets, recv_sizes, rev_out_offsets, send_sizes,
-            axis_name=axis,
-        )
-    else:
-        blocks = jnp.zeros((d, n_assign, h), xs.dtype)
-
-        def fill_y(src, blocks):
-            rws = jax.lax.dynamic_slice(
-                jnp.pad(y_src_major, ((0, n_assign), (0, 0))),
-                (recv_offsets[src], 0), (n_assign, h),
-            )
-            mask = (jnp.arange(n_assign) < recv_sizes[src])[:, None]
-            return blocks.at[src].set(jnp.where(mask, rws, 0))
-
-        blocks = jax.lax.fori_loop(0, d, fill_y, blocks)
-        got_y = jax.lax.all_to_all(
-            blocks.reshape(d, 1, n_assign, h), axis, split_axis=0,
-            concat_axis=0, tiled=False,
-        ).reshape(d, n_assign, h)
-        ys = jnp.zeros((n_assign, h), xs.dtype)
-
-        def compact_y(dst, buf):
-            rws = got_y[dst]
-            idx = jnp.where(
-                jnp.arange(n_assign) < send_sizes[dst],
-                input_offsets[dst] + jnp.arange(n_assign),
-                n_assign,
-            )
-            return buf.at[idx].set(rws, mode="drop")
-
-        ys = jax.lax.fori_loop(0, d, compact_y, ys)
+    # returned rows must land where the source originally staged them:
+    # on rank s that's s's input_offsets[my] = exclusive row-cumsum of
+    # its send sizes — derivable from the gathered send matrix
+    rev_out_offsets = (
+        jnp.cumsum(all_send, axis=1) - all_send
+    )[:, my].astype(jnp.int32)
+    if cfg.collect_stats and wire_comb is not None:
+        comb_err = wr.roundtrip_error(y_src_major, wire_comb)
+        wire_err = (comb_err if wire_err is None
+                    else jnp.maximum(wire_err, comb_err))
+    ys = _wired_row_exchange(
+        y_src_major, wire_comb, axis=axis, d=d, exchange=exchange,
+        block_rows=n_assign, out_bound=n_assign,
+        send_offsets=recv_offsets, send_sizes=recv_sizes,
+        remote_offsets=rev_out_offsets, recv_sizes=send_sizes,
+        recv_offsets=input_offsets,
+    )
 
     # ---- combine in the original expert-sorted layout ----
     healthy = None
@@ -273,6 +296,8 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
 
             stats = hlt.attach_degradation(stats, healthy, r.expert_idx,
                                            reduce_axes)
+        if wire_err is not None:
+            stats = st.with_wire_error(stats, wire_err, reduce_axes)
     return MoEOutput(out.astype(cfg.dtype), aux, z, cnts, stats)
 
 

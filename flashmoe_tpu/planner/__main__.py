@@ -6,8 +6,9 @@ Examples::
                                                         # all generations
     python -m flashmoe_tpu.planner --config mixtral --d 8 --gen v5p
     python -m flashmoe_tpu.planner --slices 2           # ep spans 2 slices
+    python -m flashmoe_tpu.planner --wire e4m3          # price fp8 EP wire
     python -m flashmoe_tpu.planner --json               # machine-readable
-    python -m flashmoe_tpu.planner --write-golden       # refresh the
+    python -m flashmoe_tpu.planner --regen-golden       # refresh the
                                                         # CI-gated tables
 """
 
@@ -42,10 +43,18 @@ def main(argv=None) -> int:
                     help="achieved fraction of peak matmul throughput "
                          "(1.0 = roofline; pass a measured mxu_util "
                          "for a calibrated prediction)")
+    ap.add_argument("--wire", default=None,
+                    help="EP payload wire dtype for the dispatch leg "
+                         "(bf16 / e4m3 / e5m2; default off)")
+    ap.add_argument("--wire-combine", default=None,
+                    help="EP payload wire dtype for the combine leg "
+                         "(default off — high-precision returns)")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON document instead of tables")
-    ap.add_argument("--write-golden", action="store_true",
-                    help="regenerate the CI-gated golden tables")
+    ap.add_argument("--write-golden", "--regen-golden",
+                    dest="write_golden", action="store_true",
+                    help="regenerate the CI-gated golden tables "
+                         "(includes the wire-dtype dimension)")
     args = ap.parse_args(argv)
 
     if args.write_golden:
@@ -57,6 +66,9 @@ def main(argv=None) -> int:
         cfg = BENCH_CONFIGS[args.config]
     else:
         cfg = MoEConfig.from_json(args.config)
+    if args.wire or args.wire_combine:
+        cfg = cfg.replace(wire_dtype=args.wire,
+                          wire_dtype_combine=args.wire_combine)
     gens = args.gen or list(GOLDEN_GENS)
 
     doc = {"config": args.config, "d": args.d, "slices": args.slices,
@@ -77,11 +89,15 @@ def main(argv=None) -> int:
                 ],
             }
             continue
+        wire_tag = ""
+        if cfg.wire_dtype or cfg.wire_dtype_combine:
+            wire_tag = (f" wire={cfg.wire_dtype or 'off'}/"
+                        f"{cfg.wire_dtype_combine or 'off'}")
         print(f"\n# {args.config}: E={cfg.num_experts} "
               f"k={cfg.expert_top_k} H={cfg.hidden_size} "
               f"I={cfg.intermediate_size} S={cfg.tokens} "
               f"d={args.d} gen={gen} slices={args.slices} "
-              f"mxu={args.mxu:.2f}")
+              f"mxu={args.mxu:.2f}{wire_tag}")
         print(explain_table(preds))
         if sel.mode == "measured":
             print(f"winner: {sel.winner} (MEASURED "

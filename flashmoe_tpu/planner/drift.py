@@ -102,7 +102,8 @@ def record_drift(cfg: MoEConfig, path: str, measured_ms: float, *,
         exceeded=exceeded,
         config=dict(e=cfg.num_experts, k=cfg.expert_top_k,
                     h=cfg.hidden_size, i=cfg.intermediate_size,
-                    s=cfg.tokens))
+                    s=cfg.tokens, wire=cfg.wire_dtype or "off",
+                    wire_combine=cfg.wire_dtype_combine or "off"))
     metrics.histogram("planner.drift_abs_rel_error", abs(rel))
     if exceeded and warn:
         warnings.warn(

@@ -2,12 +2,14 @@
 
 ``golden.json`` (committed next to this module) freezes the planner's
 predicted per-path latencies and winners on the canonical configs at
-d=8 across every supported generation.  ``tests/test_planner.py``
-recomputes and compares: any change to the cost model, the kernels'
-schedule resolution, or the spec tables that moves a prediction by
-more than the tolerance — or flips a predicted winner — fails CI and
-must be re-approved by regenerating the table
-(``python -m flashmoe_tpu.planner --write-golden``) in the same PR, so
+d=8 across every supported generation AND every golden wire-dtype
+variant (EP payload compression off / fp8 — the knob dimension added
+with ``MoEConfig.wire_dtype``).  ``tests/test_planner.py`` recomputes
+and compares: any change to the cost model, the kernels' schedule
+resolution, or the spec tables that moves a prediction by more than
+the tolerance — or flips a predicted winner — fails CI and must be
+re-approved by regenerating the table
+(``python -m flashmoe_tpu.planner --regen-golden``) in the same PR, so
 the diff shows exactly which numbers moved.
 """
 
@@ -23,6 +25,10 @@ GOLDEN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 GOLDEN_CONFIGS = ("reference", "mixtral", "deepseek")
 GOLDEN_GENS = ("v4", "v5e", "v5p", "v6e")
 GOLDEN_D = 8
+# the wire-dtype dimension: raw payloads and the activation-default fp8
+# wire (dispatch leg e4m3, combine leg high-precision — the recommended
+# production split, docs/PERF.md).  Keyed by the canonical wire tag.
+GOLDEN_WIRES = {"off": {}, "e4m3": {"wire_dtype": "e4m3"}}
 # relative tolerance of the CI gate: generous enough for float noise,
 # far below any modeling change worth reviewing
 GOLDEN_RTOL = 1e-3
@@ -39,18 +45,21 @@ def golden_snapshot() -> dict:
         cfg = BENCH_CONFIGS[name]
         gens = {}
         for gen in GOLDEN_GENS:
-            preds = predict_paths(cfg, GOLDEN_D, gen)
-            winner = next(p for p in preds if p.feasible)
-            gens[gen] = {
-                "winner": winner.path,
-                "backend": winner.backend,
-                "paths": {
-                    p.path: dict(
-                        {t: round(getattr(p, t), 6) for t in _TERMS},
-                        feasible=p.feasible)
-                    for p in preds
-                },
-            }
+            wires = {}
+            for wname, knobs in GOLDEN_WIRES.items():
+                preds = predict_paths(cfg.replace(**knobs), GOLDEN_D, gen)
+                winner = next(p for p in preds if p.feasible)
+                wires[wname] = {
+                    "winner": winner.path,
+                    "backend": winner.backend,
+                    "paths": {
+                        p.path: dict(
+                            {t: round(getattr(p, t), 6) for t in _TERMS},
+                            feasible=p.feasible)
+                        for p in preds
+                    },
+                }
+            gens[gen] = wires
         out["configs"][name] = gens
     return out
 

@@ -26,7 +26,12 @@ ranks, uniform routing):
   chip_ms      max(compute_ms, hbm_ms): the on-chip roofline (MXU and
                HBM pipelines overlap within a kernel).
   ici_ms       wire serialization of the expert all-to-all on this
-               rank's ICI links, both directions, alpha included.
+               rank's ICI links, both directions, alpha included.  Each
+               leg serializes at its own wire-dtype row size
+               (``MoEConfig.wire_dtype`` / ``wire_dtype_combine``,
+               priced via ``analysis.wire_row_bytes``), so fp8/bf16
+               payload compression shrinks this term — and disqualifies
+               the fused RDMA rows, whose transport moves raw slabs.
   dcn_ms       cross-slice share of that exchange when the ep axis
                spans slices (``a2a_transport_cost``: flat per-peer
                messages for the collective path, one aggregated message
@@ -96,6 +101,8 @@ class PathPrediction:
     feasible: bool
     note: str                  # why infeasible / which overlap model
     cost: PathCost             # the byte decomposition priced
+    wire: str = "off/off"      # wire dtypes priced (dispatch/combine
+                               # legs, canonical names; "off/off" = raw)
 
     @property
     def family(self) -> str:
@@ -122,20 +129,29 @@ def _ici_link(gen: str) -> tuple[float, float]:
     return lat_us / 1e3, gbps * 1e6
 
 
-def _slab_bytes(cfg: MoEConfig, d: int, *, padded: bool = False) -> float:
+def _slab_bytes(cfg: MoEConfig, d: int, *, padded: bool = False,
+                leg: str = "dispatch") -> float:
     """One (dest-rank) capacity slab: the unit both exchanges move.
 
     ``padded``: the fused kernel RDMAs capacity padded to a 32-multiple
     (the same padding ``analysis._geom`` prices); the collective layer
-    exchanges the unpadded ``[E, C, H]`` buffer (``ep._ep_moe_shard``)."""
+    exchanges the unpadded ``[E, C, H]`` buffer (``ep._ep_moe_shard``).
+    ``leg`` selects which exchange is priced: rows serialize at that
+    leg's WIRE row size (``analysis.wire_row_bytes`` — compute row size
+    when ``wire_dtype`` is off), so compression shrinks the ici/dcn
+    terms by the wire/compute itemsize ratio."""
+    from flashmoe_tpu.analysis import wire_row_bytes
     from flashmoe_tpu.parallel.ep import local_capacity
 
     s_loc = cfg.tokens // d
     cap = local_capacity(cfg, s_loc)
-    if padded:
-        cap = -(-cap // 32) * 32
     nlx = cfg.num_experts // d
-    return nlx * cap * cfg.hidden_size * jnp.dtype(cfg.dtype).itemsize
+    if padded:
+        # fused kernel slabs: raw compute rows, 32-padded — the RDMA
+        # transport never compresses (config.py rejects fused + wire)
+        cap = -(-cap // 32) * 32
+        return nlx * cap * cfg.hidden_size * jnp.dtype(cfg.dtype).itemsize
+    return nlx * cap * wire_row_bytes(cfg, leg)
 
 
 def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
@@ -161,8 +177,14 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
     a_ici, bw_link = _ici_link(gen)
     rows = []
 
+    from flashmoe_tpu.ops import wire as wr
+
+    wire_tag = (f"{wr.canonical_name(cfg.wire_dtype)}/"
+                f"{wr.canonical_name(cfg.wire_dtype_combine)}")
+    wire_on = wire_tag != "off/off"
+
     def mk(path, cost, ici_ms, dcn_ms, total_ms=None, schedule=None,
-           feasible=True, note=""):
+           feasible=True, note="", wire="off/off"):
         compute_ms = cost.flops / (peak_fs * mxu_fraction) * 1e3
         hbm_ms = cost.total_bytes / hbm_bs * 1e3
         chip_ms = max(compute_ms, hbm_ms)
@@ -172,7 +194,7 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
             compute_ms=compute_ms, hbm_ms=hbm_ms, ici_ms=ici_ms,
             dcn_ms=dcn_ms, serial_ms=serial_ms,
             total_ms=serial_ms if total_ms is None else total_ms,
-            feasible=feasible, note=note, cost=cost))
+            feasible=feasible, note=note, cost=cost, wire=wire))
         return rows[-1]
 
     if d == 1:
@@ -186,38 +208,51 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
 
     from flashmoe_tpu.parallel.fused import schedule_metadata
 
-    slab = _slab_bytes(cfg, d)
     inner = d // slices
 
+    def two_leg(slab_by_leg, kind):
+        """(ici_ms, dcn_ms) of both exchange legs, each serialized at
+        its own wire row size — identical to the old symmetric 2x form
+        when both legs share a size (wire off)."""
+        ici = dcn = 0.0
+        for slab in slab_by_leg:
+            if slices > 1:
+                t = a2a_transport_cost(d, inner, slab, gen=gen,
+                                       links=links)[kind]
+                ici += t["ici_ms"]
+                dcn += t["dcn_ms"]
+            else:
+                ici += (d - 1) * (a_ici + slab / (bw_link * links))
+        return ici, dcn
+
+    slab_legs = [_slab_bytes(cfg, d, leg="dispatch"),
+                 _slab_bytes(cfg, d, leg="combine")]
+    wire_note = f" [wire {wire_tag}]" if wire_on else ""
+
     # --- collective EP: capacity slabs, flat all_to_all ---------------
-    if slices > 1:
-        t = a2a_transport_cost(d, inner, slab, gen=gen, links=links)["flat"]
-        ici, dcn = 2 * t["ici_ms"], 2 * t["dcn_ms"]
-    else:
-        ici, dcn = 2 * (d - 1) * (a_ici + slab / (bw_link * links)), 0.0
+    ici, dcn = two_leg(slab_legs, "flat")
     mk("collective", path_costs(cfg, "explicit", d_world=d), ici, dcn,
-       note="serialized a2a (XLA cannot hide it within the layer)")
+       wire=wire_tag,
+       note="serialized a2a (XLA cannot hide it within the layer)"
+            + wire_note)
 
     # --- hierarchical two-stage ICI+DCN (multi-slice only) ------------
     if slices > 1:
-        t = a2a_transport_cost(d, inner, slab, gen=gen,
-                               links=links)["hierarchical"]
+        ici, dcn = two_leg(slab_legs, "hierarchical")
         mk("hierarchical", path_costs(cfg, "explicit", d_world=d),
-           2 * t["ici_ms"], 2 * t["dcn_ms"],
-           note="one aggregated DCN message per slice pair")
+           ici, dcn, wire=wire_tag,
+           note="one aggregated DCN message per slice pair" + wire_note)
 
     # --- ragged / dropless EP: routed rows, no capacity padding -------
+    from flashmoe_tpu.analysis import wire_row_bytes
+
     rag = path_costs(cfg, "ragged", d_world=d)
-    rag_slab = (cfg.tokens // d) * cfg.expert_top_k / d \
-        * cfg.hidden_size * jnp.dtype(cfg.dtype).itemsize
-    if slices > 1:
-        t = a2a_transport_cost(d, inner, rag_slab, gen=gen,
-                               links=links)["flat"]
-        ici, dcn = 2 * t["ici_ms"], 2 * t["dcn_ms"]
-    else:
-        ici, dcn = 2 * (d - 1) * (a_ici + rag_slab / (bw_link * links)), 0.0
-    mk("ragged", rag, ici, dcn,
-       note="uniform-routing expectation; skew moves more")
+    rag_rows = (cfg.tokens // d) * cfg.expert_top_k / d
+    ici, dcn = two_leg([rag_rows * wire_row_bytes(cfg, "dispatch"),
+                        rag_rows * wire_row_bytes(cfg, "combine")],
+                       "flat")
+    mk("ragged", rag, ici, dcn, wire=wire_tag,
+       note="uniform-routing expectation; skew moves more" + wire_note)
 
     # --- fused RDMA: one row per FFN schedule -------------------------
     meta = schedule_metadata(cfg, d)
@@ -233,12 +268,18 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
             return (max(chip / d, t_x) + (d - 1) / d * chip + t_x / nlx)
         return max(chip, t_x + chip / d) + t_x / max(d - 1, 1)
 
+    def fused_why_out():
+        if wire_on:
+            # the in-kernel RDMA moves raw slabs; config.py rejects the
+            # combination outright, so the planner must never pick it
+            return "wire-dtype compression is XLA-transport only"
+        return ("fused RDMA is intra-slice only" if slices > 1
+                else "VMEM budget exceeded")
+
     for sched in ("batched", "resident", "stream"):
         cost = path_costs(cfg, "fused", d_world=d, schedule=sched)
-        ok = meta["feasible"][sched] and slices == 1
-        note = ("in-kernel arrival overlap"
-                if ok else ("fused RDMA is intra-slice only"
-                            if slices > 1 else "VMEM budget exceeded"))
+        ok = meta["feasible"][sched] and slices == 1 and not wire_on
+        note = "in-kernel arrival overlap" if ok else fused_why_out()
         mk(f"fused[{sched}]", cost, 2 * t_x, 0.0,
            total_ms=fused_total(cost, sched), schedule=sched,
            feasible=ok, note=note)
@@ -246,12 +287,11 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
     # --- fused + in-kernel combine at the resolved schedule -----------
     sched = meta["schedule"]
     cost = path_costs(cfg, "fused_combine", d_world=d)
-    ok = meta["feasible"][sched] and slices == 1
+    ok = meta["feasible"][sched] and slices == 1 and not wire_on
     mk("fused_combine", cost, 2 * t_x, 0.0,
        total_ms=fused_total(cost, sched), schedule=sched, feasible=ok,
        note=("sorted per-row returns; combine off the critical path"
-             if ok else ("fused RDMA is intra-slice only"
-                         if slices > 1 else "VMEM budget exceeded")))
+             if ok else fused_why_out()))
 
     rows.sort(key=lambda r: (not r.feasible, r.total_ms))
     return rows

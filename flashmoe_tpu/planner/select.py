@@ -97,9 +97,17 @@ class Selection:
 
 
 def _shape_key(cfg: MoEConfig, d: int) -> dict:
+    # wire/wire_combine ride the key so a latency measured with payload
+    # compression on is never applied to an uncompressed run (and vice
+    # versa) — tuning.measured_path_latencies matches them STRICTLY,
+    # with "off" as the implicit default for legacy entries
+    from flashmoe_tpu.ops import wire as wr
+
     return dict(h=cfg.hidden_size, i=cfg.intermediate_size,
                 e=cfg.num_experts, k=cfg.expert_top_k, s=cfg.tokens,
-                d=d, dtype=jnp.dtype(cfg.dtype).name)
+                d=d, dtype=jnp.dtype(cfg.dtype).name,
+                wire=wr.canonical_name(cfg.wire_dtype),
+                wire_combine=wr.canonical_name(cfg.wire_dtype_combine))
 
 
 def _bench_record_latencies(cfg: MoEConfig, d: int) -> dict:
@@ -110,12 +118,16 @@ def _bench_record_latencies(cfg: MoEConfig, d: int) -> dict:
     must never override an 8-rank selection.  ``path``/``value`` (ms)
     name the primary measurement; ``xla_path_ms`` contributes the xla
     leg of the same record.  Unreadable files contribute nothing."""
+    from flashmoe_tpu.ops import wire as wr
+
     path = os.environ.get("FLASHMOE_BENCH_RECORDS")
     if not path or not os.path.exists(path):
         return {}
     sig = (f"E={cfg.num_experts},k={cfg.expert_top_k},"
            f"H={cfg.hidden_size},I={cfg.intermediate_size},"
            f"S={cfg.tokens},{jnp.dtype(cfg.dtype).name}")
+    wire_sig = (wr.canonical_name(cfg.wire_dtype),
+                wr.canonical_name(cfg.wire_dtype_combine))
     out: dict[str, float] = {}
 
     def keep(p, v):
@@ -132,6 +144,13 @@ def _bench_record_latencies(cfg: MoEConfig, d: int) -> dict:
                 if sig not in str(rec.get("metric", "")):
                     continue
                 if int(rec.get("d", 1)) != d:
+                    continue
+                # wire knobs are part of the measurement's identity: a
+                # compressed timing never overrides an uncompressed
+                # selection (records without the field are legacy = off)
+                if (str(rec.get("wire_dtype", "off")),
+                        str(rec.get("wire_dtype_combine",
+                                    "off"))) != wire_sig:
                     continue
                 keep(rec.get("path"), rec.get("value"))
                 keep("xla", rec.get("xla_path_ms"))

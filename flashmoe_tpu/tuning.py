@@ -94,6 +94,12 @@ def measured_path_latencies(gen: str | None = None, **shape) -> dict:
          "match": {"path": "fused", "h": 2048, "i": 2048, "d": 8},
          "measured_ms": 2.71}
 
+    The ``wire`` / ``wire_combine`` keys (EP payload compression,
+    ``MoEConfig.wire_dtype``) are matched STRICTLY with an implicit
+    ``"off"`` default on both sides: a latency measured with
+    compression on is never applied to an uncompressed run — and a
+    legacy entry without the keys never applies to a compressed one.
+
     The planner's measured-winner override
     (:mod:`flashmoe_tpu.planner.select`) consults this: a committed
     bench/tune_sweep measurement beats any prediction for the paths it
@@ -108,6 +114,9 @@ def measured_path_latencies(gen: str | None = None, **shape) -> dict:
         path = m.pop("path", None)
         ms = ent.get("measured_ms", ent.get("set", {}).get("measured_ms"))
         if path is None or ms is None:
+            continue
+        if any(str(m.pop(wk, "off")) != str(shape.get(wk, "off"))
+               for wk in ("wire", "wire_combine")):
             continue
         if all(shape.get(kk) == v for kk, v in m.items()):
             if path not in best or len(m) > best[path][0]:

@@ -6,6 +6,7 @@ import subprocess
 import sys
 
 import jax.numpy as jnp
+import pytest
 
 
 def test_emit_partial_vs_full(capsys):
@@ -40,6 +41,75 @@ def test_mxu_util_label(monkeypatch):
     # land in a sane (0, 1) band so the driver can gate on it
     u = bench._mxu_util(cfg, 2.749e-3)
     assert 0.1 < u < 1.0
+
+
+def test_probe_retry_bounded_by_attempts(monkeypatch):
+    """Satellite: FLASHMOE_PROBE_ATTEMPTS caps the retry loop — a wedged
+    tunnel stops after N probes instead of burning the whole budget
+    (BENCH_r05: 309 s of retries), and the hung flag survives so main()
+    can emit the skip record instead of an error."""
+    import bench
+
+    calls = []
+
+    def fake_probe(timeout_s):
+        calls.append(timeout_s)
+        return False, f"backend probe hung >{timeout_s}s", True
+
+    monkeypatch.setattr(bench, "_probe_backend", fake_probe)
+    ok, info, hung = bench._probe_backend_retry(
+        budget_s=10_000, each_s=10, max_attempts=2)
+    assert (ok, hung) == (False, True)
+    assert len(calls) == 2
+    assert "2 attempts" in info
+    # a non-hung failure keeps hung=False (main() then errors, rc 2)
+    monkeypatch.setattr(
+        bench, "_probe_backend",
+        lambda t: (False, "backend probe rc=1: boom", False))
+    ok, info, hung = bench._probe_backend_retry(
+        budget_s=10_000, each_s=10, max_attempts=1)
+    assert (ok, hung) == (False, False)
+
+
+def test_cli_emits_skipped_record_when_probe_hangs(monkeypatch, capsys):
+    """A backend that never answers yields ONE well-formed
+    skipped:true JSON record and exit code 0 — machine-distinguishable
+    from both an error (rc 2) and a measurement."""
+    import sys as _sys
+
+    import bench
+
+    monkeypatch.setattr(
+        bench, "_probe_backend_retry",
+        lambda budget_s, each_s=90, max_attempts=0:
+        (False, "backend probe hung >10s after 2 attempts / 20s", True))
+    monkeypatch.setattr(_sys, "argv",
+                        ["bench.py", "--probe-attempts", "2"])
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["skipped"] is True
+    assert rec["value"] is None and rec["vs_baseline"] is None
+    assert "hung" in rec["reason"]
+
+
+def test_wire_fields_in_records():
+    """Records carry the wire identity (selection keys) and the modeled
+    comm bytes the wire saves at the config's nominal ep width."""
+    import bench
+    from flashmoe_tpu.config import BENCH_CONFIGS
+
+    cfg = BENCH_CONFIGS["reference"]
+    off = bench._wire_fields(cfg)
+    assert off == {"wire_dtype": "off", "wire_dtype_combine": "off"}
+    on = bench._wire_fields(cfg.replace(ep=8, wire_dtype="e4m3"))
+    assert on["wire_dtype"] == "e4m3"
+    assert on["wire_modeled_comm_saved_mb"] > 0
+    assert on["wire_modeled_comm_mb"] > 0
+    # single chip: no exchange to save on, but the identity still rides
+    one = bench._wire_fields(cfg.replace(ep=1, wire_dtype="e4m3"))
+    assert one["wire_modeled_comm_saved_mb"] == 0.0
 
 
 def test_cli_emits_json_error_fast_when_backend_dead():

@@ -180,6 +180,40 @@ def test_degrade_ep_layer_masks_and_counts(devices):
 
 
 @pytest.mark.slow
+def test_degrade_masks_nan_expert_through_fp8_wire(devices):
+    """Chaos drill for the wire codec: a poisoned expert output must
+    still trip the tier-0 health mask AFTER crossing an fp8 combine
+    wire (nan_expert injects at the expert's owner, BEFORE the return
+    exchange — ops/wire.py guarantees non-finite rows decode
+    non-finite)."""
+    from flashmoe_tpu.parallel.ep import ep_moe_layer
+
+    cfg, mesh, params, x = _ep_setup(devices)
+    wired = cfg.replace(wire_dtype="e4m3", wire_dtype_combine="e4m3",
+                        collect_stats=True)
+    inject.arm("nan_expert", expert=1)
+    sick_off = ep_moe_layer(params, x, wired, mesh)
+    assert not bool(np.isfinite(np.asarray(sick_off.out)).all())
+    on = wired.replace(degrade_unhealthy_experts=True)
+    sick_on = ep_moe_layer(params, x, on, mesh)
+    assert bool(np.isfinite(np.asarray(sick_on.out)).all())
+    # the armed spec names ONE global expert: all 8 ranks mask exactly
+    # their own exposure to it, nothing else (the pre-exchange injector
+    # keeps global-expert-id semantics — chaos/inject.py
+    # poison_local_expert)
+    assert float(sick_on.stats.masked_experts) == 8.0
+    assert float(sick_on.stats.masked_fraction) > 0.0
+    # and the uncompressed layer masks the same injection (the move of
+    # the injection point to the pre-exchange side keeps the drill
+    # meaningful with the wire off too)
+    raw_on = cfg.replace(degrade_unhealthy_experts=True,
+                         collect_stats=True)
+    raw = ep_moe_layer(params, x, raw_on, mesh)
+    assert bool(np.isfinite(np.asarray(raw.out)).all())
+    assert float(raw.stats.masked_experts) == 8.0
+
+
+@pytest.mark.slow
 def test_degrade_ragged_ep_layer(devices):
     from flashmoe_tpu.parallel.ragged_ep import ragged_ep_moe_layer
 

@@ -347,6 +347,31 @@ def test_observe_cli_text_output(tmp_path, capsys):
     assert "drop rate: mean 0.125" in out
 
 
+def test_observe_wire_report(tmp_path, capsys):
+    """Flight records carrying the wire round-trip error surface in the
+    wire report (and the text rendering); wire-off dumps report none."""
+    from flashmoe_tpu import observe
+
+    path = str(tmp_path / "flight.jsonl")
+    with open(path, "w") as f:
+        for step, err in enumerate([0.0, 0.021, 0.025]):
+            f.write(json.dumps({
+                "step": step,
+                "moe": [{"expert_load": [1.0], "wire_rtq_error": err}],
+            }) + "\n")
+    assert observe.main([path, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["wire"]["steps_with_wire"] == 2  # the 0.0 step = wire off
+    assert doc["wire"]["max_rtq_error"] == pytest.approx(0.025)
+    assert doc["wire"]["mean_rtq_error"] == pytest.approx(0.023)
+    assert observe.main([path]) == 0
+    assert "wire compression" in capsys.readouterr().out
+    # a wire-off dump carries no wire section in the text rendering
+    off = _synthetic_flight(tmp_path)
+    assert observe.main([off]) == 0
+    assert "wire compression" not in capsys.readouterr().out
+
+
 def test_observe_cli_rejects_empty(tmp_path, capsys):
     from flashmoe_tpu import observe
 

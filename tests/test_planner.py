@@ -48,30 +48,55 @@ def _hermetic(monkeypatch):
 
 def test_golden_tables_match_model():
     """Recompute every golden prediction and compare: terms within
-    GOLDEN_RTOL, winners and feasibility exactly."""
+    GOLDEN_RTOL, winners and feasibility exactly — across every
+    (config, generation, wire-dtype) point."""
     live, frozen = golden_snapshot(), load_golden()
     assert live["d"] == frozen["d"] == GOLDEN_D
     assert set(live["configs"]) == set(frozen["configs"])
     for cname, gens in frozen["configs"].items():
-        for gen, g in gens.items():
-            l = live["configs"][cname][gen]
-            assert l["winner"] == g["winner"], (
-                f"predicted winner flipped for {cname}@{gen}: "
-                f"{g['winner']} -> {l['winner']}; if intentional, "
-                f"regenerate with python -m flashmoe_tpu.planner "
-                f"--write-golden and justify in the PR")
-            assert l["backend"] == g["backend"]
-            assert set(l["paths"]) == set(g["paths"])
-            for pname, terms in g["paths"].items():
-                lt = l["paths"][pname]
-                assert lt["feasible"] == terms["feasible"], (cname, gen,
-                                                             pname)
-                for term, want in terms.items():
-                    if term == "feasible":
-                        continue
-                    assert lt[term] == pytest.approx(
-                        want, rel=GOLDEN_RTOL, abs=1e-9), (
-                        f"{cname}@{gen}/{pname}.{term}")
+        for gen, wires in gens.items():
+            for wname, g in wires.items():
+                l = live["configs"][cname][gen][wname]
+                assert l["winner"] == g["winner"], (
+                    f"predicted winner flipped for {cname}@{gen}"
+                    f"[wire={wname}]: {g['winner']} -> {l['winner']}; "
+                    f"if intentional, regenerate with python -m "
+                    f"flashmoe_tpu.planner --regen-golden and justify "
+                    f"in the PR")
+                assert l["backend"] == g["backend"]
+                assert set(l["paths"]) == set(g["paths"])
+                for pname, terms in g["paths"].items():
+                    lt = l["paths"][pname]
+                    assert lt["feasible"] == terms["feasible"], (
+                        cname, gen, wname, pname)
+                    for term, want in terms.items():
+                        if term == "feasible":
+                            continue
+                        assert lt[term] == pytest.approx(
+                            want, rel=GOLDEN_RTOL, abs=1e-9), (
+                            f"{cname}@{gen}[{wname}]/{pname}.{term}")
+
+
+def test_golden_tables_cover_wire_dimension():
+    """CI gate for the knob dimension itself: every golden (config, gen)
+    point must carry every GOLDEN_WIRES variant, so a future knob added
+    to GOLDEN_WIRES cannot silently skip the CI-gated tables — and the
+    compressed variant must actually be cheaper on the wire."""
+    from flashmoe_tpu.planner.golden import GOLDEN_WIRES
+
+    frozen = load_golden()
+    assert set(GOLDEN_WIRES) >= {"off", "e4m3"}
+    for cname, gens in frozen["configs"].items():
+        for gen, wires in gens.items():
+            assert set(wires) == set(GOLDEN_WIRES), (cname, gen)
+            off = wires["off"]["paths"]["collective"]
+            on = wires["e4m3"]["paths"]["collective"]
+            assert on["ici_ms"] < off["ici_ms"], (cname, gen)
+            assert on["hbm_ms"] < off["hbm_ms"], (cname, gen)
+            # the fused rows are disqualified under compression
+            for pname, terms in wires["e4m3"]["paths"].items():
+                if pname.startswith("fused"):
+                    assert not terms["feasible"], (cname, gen, pname)
 
 
 def test_d8_canonical_breakdown_all_generations():
