@@ -231,10 +231,13 @@ def _emit(cfg, name, t_fused, t_xla, note: str | None = None):
         rec["fused_variant"] = _PARTIAL.get("fused_variant", "explicit")
     # path/d identify this measurement for the planner's measured-winner
     # override (planner/select.py:_bench_record_latencies): the headline
-    # bench times the single-chip (d=1) kernels
+    # bench times the single-chip (d=1) kernels.  a2a_chunks rides the
+    # identity like the wire knobs: a chunk-pipelined timing never
+    # overrides a serial selection (and vice versa)
     rec["path"] = ("gather" if _PARTIAL.get("fused_variant") == "gather"
                    else "explicit")
     rec["d"] = 1
+    rec["a2a_chunks"] = cfg.a2a_chunks or 1
     # wire-dtype knobs are part of the measurement identity (a
     # compressed timing never overrides an uncompressed selection), and
     # the modeled EP comm bytes at the config's nominal ep width show
@@ -362,8 +365,18 @@ def _bench_checkpoint(trials: int):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def _bench_overlap(ep: int, trials: int):
-    """Overlap efficiency on an ep-way mesh (BASELINE.json metric 3).
+def _bench_overlap(ep: int, trials: int, *, path: str | None = None,
+                   wire_dtype: str | None = None,
+                   wire_combine: str | None = None,
+                   a2a_chunks: int | None = None):
+    """Overlap efficiency on an ep-way mesh (BASELINE.json metric 3),
+    per chunk count: one record for the serial schedule and one per
+    chunked-pipeline depth (``MoEConfig.a2a_chunks``), each reporting
+    the measured efficiency next to its analytic bound
+    (``overlap.chunked_overlap_bound`` for the chunked XLA schedules,
+    ``overlap.overlap_bound`` for the fused kernel) with the
+    predicted-vs-measured overlap fraction validated through the drift
+    monitor (``planner.overlap_drift``).
 
     Multi-chip hardware is absent in this container, so the mesh is the
     virtual 8-device CPU backend (interpret-mode kernels) unless
@@ -387,47 +400,105 @@ def _bench_overlap(ep: int, trials: int):
         intermediate_size=512, sequence_len=256 * ep, capacity_factor=1.0,
         drop_tokens=True, ep=ep,
         dtype=jnp.float32 if not on_tpu else jnp.bfloat16,
+        wire_dtype=wire_dtype, wire_dtype_combine=wire_combine,
     )
     mesh = make_mesh(cfg, dp=1, devices=devices)
     # off-hardware, interpret-mode Pallas is ~100x slower than compiled XLA,
     # which would poison the ratio — the virtual mesh measures the collective
-    # path (compiled end to end); real chips measure the fused kernel
-    path = "fused" if on_tpu else "collective"
-    m = measure_overlap(cfg, mesh, path=path, trials=trials,
-                        interpret=False)
-    rec = {
-        "metric": f"overlap_efficiency[{path},ep={ep},E={cfg.num_experts},"
-                  f"{'tpu' if on_tpu else 'virtual_cpu'}]",
-        "value": round(m["overlap_efficiency"], 3),
-        "unit": "ratio_vs_serialized",
-        "vs_baseline": round(m["overlap_efficiency"], 3),
-        "t_overlapped_ms": round(m["t_overlapped_ms"], 3),
-        "t_compute_ms": round(m["t_compute_ms"], 3),
-        "t_comm_ms": round(m["t_comm_ms"], 3),
-    }
-    try:
-        rec.update(_skew_metrics(cfg, ep, m))
-    except Exception as e:  # noqa: BLE001 — the measurement stands alone
-        rec["skew_error"] = f"{type(e).__name__}: {str(e)[:120]}"
-    try:
-        from flashmoe_tpu.parallel.overlap import overlap_bound
-        from flashmoe_tpu.parallel.topology import tpu_generation
+    # path (compiled end to end); real chips measure the fused kernel,
+    # UNLESS wire/chunk knobs are set: those are XLA-transport features
+    # (the fused kernel rejects wire dtypes and ignores a2a_chunks), so
+    # the measurement they ask for is the collective schedule
+    if path is None:
+        path = "fused" if on_tpu else "collective"
+        if path == "fused" and (wire_dtype or wire_combine or a2a_chunks):
+            print("# wire/a2a-chunks knobs are XLA-transport only: "
+                  "measuring the collective path instead of the fused "
+                  "kernel", file=sys.stderr, flush=True)
+            path = "collective"
+    nlx = cfg.num_experts // ep
+    if path == "fused":
+        chunk_list = [1]  # the kernel overlaps in-kernel; no chunk knob
+    elif a2a_chunks:
+        chunk_list = sorted({1} | {n for n in (a2a_chunks,)
+                                   if nlx % n == 0})
+        if a2a_chunks > 1 and nlx % a2a_chunks:
+            print(f"# a2a_chunks={a2a_chunks} does not divide "
+                  f"nLx={nlx}; measuring serial only",
+                  file=sys.stderr, flush=True)
+    else:
+        chunk_list = [1] + [n for n in (2, 4) if nlx % n == 0]
 
-        gen = tpu_generation(devices[0])
-        if gen in ("v4", "v5e", "v5p", "v6e"):
-            b = overlap_bound(
-                cfg, ep, gen,
-                fuse_combine=os.environ.get(
-                    "FLASHMOE_FUSED_COMBINE") == "1")
-            # the number this measurement is judged against (BASELINE.md
-            # round-5 note) — reported side by side, never in isolation;
-            # resolved for the FFN schedule that will actually run
-            rec["expected_bound"] = round(b["overlap_efficiency_bound"], 3)
-            rec["expected_bound_schedule"] = b["schedule"]
-    except Exception as e:  # noqa: BLE001 — but record the breakage
-        rec["bound_error"] = f"{type(e).__name__}: {str(e)[:120]}"
-    print(json.dumps(rec), flush=True)
-    _flush_observability(rec)
+    from flashmoe_tpu.parallel.topology import tpu_generation
+
+    gen = tpu_generation(devices[0])
+    for n in chunk_list:
+        m = measure_overlap(cfg, mesh, path=path, trials=trials,
+                            interpret=False,
+                            a2a_chunks=n if path != "fused" else None)
+        rec = {
+            "metric": f"overlap_efficiency[{path},ep={ep},"
+                      f"E={cfg.num_experts},chunks={n},"
+                      f"{'tpu' if on_tpu else 'virtual_cpu'}]",
+            "value": round(m["overlap_efficiency"], 3),
+            "unit": "ratio_vs_serialized",
+            "vs_baseline": round(m["overlap_efficiency"], 3),
+            "t_overlapped_ms": round(m["t_overlapped_ms"], 3),
+            "t_compute_ms": round(m["t_compute_ms"], 3),
+            "t_comm_ms": round(m["t_comm_ms"], 3),
+            # what one pipeline stage occupies (the moe.a2a_dispatch.k /
+            # moe.expert.k trace spans, averaged) — the observe phase
+            # breakdown then shows per-chunk pipeline occupancy
+            "per_chunk_a2a_ms": round(m["t_comm_ms"] / n, 3),
+            "per_chunk_expert_ms": round(m["t_compute_ms"] / n, 3),
+            "a2a_chunks": n,
+            "path": path,
+        }
+        rec.update(_wire_fields(cfg))
+        if n == 1:
+            try:
+                rec.update(_skew_metrics(cfg, ep, m))
+            except Exception as e:  # noqa: BLE001 — stands alone
+                rec["skew_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+        try:
+            if gen in ("v4", "v5e", "v5p", "v6e"):
+                if path == "fused":
+                    from flashmoe_tpu.parallel.overlap import overlap_bound
+
+                    b = overlap_bound(
+                        cfg, ep, gen,
+                        fuse_combine=os.environ.get(
+                            "FLASHMOE_FUSED_COMBINE") == "1")
+                    # the number this measurement is judged against
+                    # (BASELINE.md round-5 note) — reported side by
+                    # side, never in isolation; resolved for the FFN
+                    # schedule that will actually run
+                    rec["expected_bound"] = round(
+                        b["overlap_efficiency_bound"], 3)
+                    rec["expected_bound_schedule"] = b["schedule"]
+                else:
+                    from flashmoe_tpu.parallel.overlap import (
+                        chunked_overlap_bound,
+                    )
+
+                    b = chunked_overlap_bound(cfg, ep, gen, n, path=path)
+                    rec["expected_bound"] = round(
+                        b["overlap_efficiency_bound"], 3)
+                # measured-vs-analytic overlap fraction through the
+                # drift monitor: the loop that tells us when the
+                # pipeline model (and the chunk picks it drives) has
+                # drifted from what the hardware delivers
+                from flashmoe_tpu.planner.drift import record_overlap_drift
+
+                dr = record_overlap_drift(
+                    path, m["overlap_efficiency"],
+                    predicted_fraction=rec["expected_bound"],
+                    gen=gen, d=ep, chunks=n)
+                rec["overlap_drift_exceeded"] = dr.exceeded
+        except Exception as e:  # noqa: BLE001 — but record the breakage
+            rec["bound_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+        print(json.dumps(rec), flush=True)
+        _flush_observability(rec)
 
 
 def _skew_metrics(cfg: MoEConfig, ep: int, m: dict) -> dict:
@@ -470,13 +541,15 @@ def _skew_metrics(cfg: MoEConfig, ep: int, m: dict) -> dict:
 
 
 def _sweep_ep(trials: int, wire_dtype: str | None = None,
-              wire_combine: str | None = None):
+              wire_combine: str | None = None,
+              a2a_chunks: int | None = None):
     """Weak-scaling sweep over the ep axis: per-rank tokens held constant
     while the mesh grows (the reference's ``scaling_gpus_8`` axis).
     Virtual CPU mesh when multi-chip hardware is absent; identical
     procedure on real chips (FLASHMOE_OVERLAP_TPU=1).  ``wire_dtype`` /
-    ``wire_combine`` compress the EP exchange payload (ops/wire.py) —
-    the workload the knob exists for, so the sweep honors it."""
+    ``wire_combine`` compress the EP exchange payload (ops/wire.py) and
+    ``a2a_chunks`` runs the chunked double-buffered pipeline — the
+    workloads those knobs exist for, so the sweep honors them."""
     import os
 
     from flashmoe_tpu.parallel.mesh import make_mesh
@@ -495,12 +568,19 @@ def _sweep_ep(trials: int, wire_dtype: str | None = None,
     for ep in (2, 4, 8):
         if len(devs) < ep:
             break
+        chunks = (a2a_chunks if a2a_chunks and a2a_chunks > 1
+                  and (16 // ep) % a2a_chunks == 0 else None)
+        if a2a_chunks and chunks is None and a2a_chunks > 1:
+            print(f"# ep={ep}: a2a_chunks={a2a_chunks} does not divide "
+                  f"nLx={16 // ep}; measuring serial", file=sys.stderr,
+                  flush=True)
         cfg = MoEConfig(
             num_experts=16, expert_top_k=2, hidden_size=256,
             intermediate_size=512, sequence_len=256 * ep,
             capacity_factor=1.0, drop_tokens=True, ep=ep,
             dtype=jnp.bfloat16 if on_tpu else jnp.float32,
             wire_dtype=wire_dtype, wire_dtype_combine=wire_combine,
+            a2a_chunks=chunks,
         )
         mesh = make_mesh(cfg, dp=1, devices=devs[:ep])
         params = init_moe_params(jax.random.PRNGKey(0), cfg)
@@ -519,6 +599,7 @@ def _sweep_ep(trials: int, wire_dtype: str | None = None,
             "value": round(t * 1e3, 3),
             "unit": "ms",
             "vs_baseline": round(base_t / t, 3),  # weak-scaling efficiency
+            "a2a_chunks": cfg.a2a_chunks or 1,
         }
         rec.update(_wire_fields(cfg))
         print(json.dumps(rec), flush=True)
@@ -628,6 +709,12 @@ def main():
                          "on every emitted measurement")
     ap.add_argument("--wire-combine", default=None,
                     help="EP payload wire dtype for the combine leg")
+    ap.add_argument("--a2a-chunks", type=int, default=None,
+                    help="chunked double-buffered EP pipeline depth "
+                         "(MoEConfig.a2a_chunks; default off = serial "
+                         "schedule) — honored by the latency bench, "
+                         "the ep sweep, and --overlap (which also "
+                         "measures the serial baseline for comparison)")
     ap.add_argument("--obs-dir",
                     default=os.environ.get("FLASHMOE_OBS_DIR"),
                     help="directory for observability artifacts "
@@ -667,12 +754,18 @@ def main():
     if args.deadline > 0:
         signal.signal(signal.SIGALRM, on_deadline)
 
-    if (args.wire_dtype or args.wire_combine) and (args.ckpt
-                                                   or args.overlap):
-        # refuse rather than silently measure uncompressed: these modes
-        # build their own configs and do not exchange wire payloads
-        ap.error("--wire-dtype/--wire-combine apply to the latency "
-                 "bench and --sweep runs, not --ckpt/--overlap")
+    if (args.wire_dtype or args.wire_combine or args.a2a_chunks) \
+            and args.ckpt:
+        # refuse rather than silently measure uncompressed: the ckpt
+        # mode is host-side and exchanges no wire payloads.  --overlap
+        # now HONORS both knobs: the chunked schedule encodes/decodes
+        # per chunk inside the pipeline, so compressed chunked overlap
+        # is exactly the workload the knobs exist for.
+        ap.error("--wire-dtype/--wire-combine/--a2a-chunks apply to "
+                 "the latency bench, --sweep and --overlap runs, "
+                 "not --ckpt")
+    if args.a2a_chunks is not None and args.a2a_chunks < 1:
+        ap.error("--a2a-chunks must be >= 1")
     if args.ckpt:
         if args.deadline > 0:
             signal.alarm(args.deadline)  # host-side path: no probe leg
@@ -681,13 +774,17 @@ def main():
     if args.overlap:
         if args.deadline > 0:
             signal.alarm(args.deadline)  # virtual-mesh path: no probe leg
-        _bench_overlap(args.overlap, args.trials)
+        _bench_overlap(args.overlap, args.trials,
+                       wire_dtype=args.wire_dtype,
+                       wire_combine=args.wire_combine,
+                       a2a_chunks=args.a2a_chunks)
         return
     if args.sweep == "ep":
         if args.deadline > 0:
             signal.alarm(args.deadline)
         _sweep_ep(args.trials, wire_dtype=args.wire_dtype,
-                  wire_combine=args.wire_combine)
+                  wire_combine=args.wire_combine,
+                  a2a_chunks=args.a2a_chunks)
         return
 
     ok, info, hung = _probe_backend_retry(args.probe_budget,
@@ -720,6 +817,9 @@ def main():
     if args.wire_dtype or args.wire_combine:
         cfg = cfg.replace(wire_dtype=args.wire_dtype,
                           wire_dtype_combine=args.wire_combine)
+    if args.a2a_chunks and args.a2a_chunks > 1:
+        cfg = cfg.replace(a2a_chunks=args.a2a_chunks)  # ValueError if
+        # the count cannot divide this config's local-expert axis
 
     try:
         if args.sweep == "tokens":

@@ -290,7 +290,8 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1,
 
 
 def a2a_transport_cost(d: int, inner: int, slab_bytes: float,
-                       gen: str = "v5e", links: int = 1) -> dict:
+                       gen: str = "v5e", links: int = 1,
+                       chunks: int = 1) -> dict:
     """Model the flat vs two-stage (ICI+DCN) all-to-all on a ``d``-rank
     ep axis spanning ``d // inner`` slices, per rank per direction
     (``parallel/ep.py:_hierarchical_a2a``; the reference's per-peer
@@ -309,6 +310,16 @@ def a2a_transport_cost(d: int, inner: int, slab_bytes: float,
     beta term divides; per-message alpha and the host-NIC DCN path do
     not) — pass the mesh's link count so single-slice and multi-slice
     predictions stay comparable (planner code-review finding).
+
+    ``chunks``: the chunked-pipeline depth (``MoEConfig.a2a_chunks``) —
+    each per-peer slab splits into ``chunks`` messages of
+    ``slab_bytes / chunks``, so the beta (serialization) terms are
+    unchanged while every per-message alpha multiplies by ``chunks``.
+    This is the chunking overhead the planner's overlap-adjusted
+    makespan (:mod:`flashmoe_tpu.planner.model`) charges against the
+    pipeline's hiding: more chunks hide more compute but pay more
+    message latencies — the IO-aware tradeoff SonicMoE's tile knob
+    makes (arXiv 2512.14080).
     """
     from flashmoe_tpu.parallel.topology import _DCN_SPEC, _ICI_SPECS
 
@@ -316,25 +327,55 @@ def a2a_transport_cost(d: int, inner: int, slab_bytes: float,
         raise ValueError(
             f"ep axis d={d} is not divisible into slices of inner={inner} "
             f"ranks; the two-stage decomposition needs d % inner == 0")
+    if chunks < 1:
+        raise ValueError(f"chunks={chunks} must be >= 1")
     a_ici, bw_ici = _ICI_SPECS.get(gen, _ICI_SPECS["default"])
     a_dcn, bw_dcn = _DCN_SPEC
     a_ici, a_dcn = a_ici / 1e3, a_dcn / 1e3              # ms
+    a_ici, a_dcn = a_ici * chunks, a_dcn * chunks        # n msgs/peer
     bw_ici = bw_ici * 1e6 * max(links, 1)                # B/ms, striped
     bw_dcn = bw_dcn * 1e6                                # B/ms
     outer = d // inner
     flat = {
-        "dcn_messages": d - inner,
+        "dcn_messages": (d - inner) * chunks,
         "dcn_ms": (d - inner) * (a_dcn + slab_bytes / bw_dcn),
         "ici_ms": (inner - 1) * (a_ici + slab_bytes / bw_ici),
     }
     hier = {
-        "dcn_messages": outer - 1,
+        "dcn_messages": (outer - 1) * chunks,
         "dcn_ms": (outer - 1) * (a_dcn + inner * slab_bytes / bw_dcn),
         "ici_ms": (inner - 1) * (a_ici + outer * slab_bytes / bw_ici),
     }
     for c in (flat, hier):
         c["total_ms"] = c["dcn_ms"] + c["ici_ms"]
     return {"flat": flat, "hierarchical": hier}
+
+
+def chunked_pipeline_ms(chip_ms: float, dispatch_leg_ms: float,
+                        combine_leg_ms: float, chunks: int) -> float:
+    """Makespan of the chunked double-buffered EP schedule
+    (``MoEConfig.a2a_chunks``) on the XLA transports — the
+    overlap-adjusted cost the planner uses in place of the serial
+    ``chip + dispatch + combine`` sum.
+
+    ``dispatch_leg_ms`` / ``combine_leg_ms`` are the FULL chunked leg
+    times (alpha already multiplied by ``chunks`` —
+    :func:`a2a_transport_cost`); each chunk's share is ``leg / chunks``.
+    Two-resource pipeline bound over ``chunks`` independent
+    a2a -> FFN -> a2a chains:
+
+      * compute-bound: the MXU runs continuously once chunk 0's
+        dispatch lands, and the last chunk's combine trails it —
+        ``chip + (dispatch + combine) / n``;
+      * wire-bound: the wire runs continuously except for chunk 0's
+        FFN fill — ``dispatch + combine + chip / n``.
+
+    ``chunks=1`` reduces exactly to the serial makespan, so one formula
+    prices both schedules."""
+    if chunks < 1:
+        raise ValueError(f"chunks={chunks} must be >= 1")
+    e_total = dispatch_leg_ms + combine_leg_ms
+    return max(chip_ms + e_total / chunks, e_total + chip_ms / chunks)
 
 
 def candidate_table(cfg: MoEConfig, d_world: int = 1) -> str:

@@ -81,22 +81,36 @@ def poison_expert(ybuf):
     return ybuf.at[e].set(jnp.asarray(jnp.nan, ybuf.dtype))
 
 
-def poison_local_expert(yloc, axis: str, num_experts: int):
+def poison_local_expert(yloc, axis: str, num_experts: int, *,
+                        local_offset: int = 0,
+                        local_total: int | None = None):
     """NaN the armed GLOBAL expert's rows of a pre-exchange expert-
-    parallel buffer ``[nLx, rows, H]`` inside a shard_map body over
-    ``axis``: only the expert's owner rank poisons, at local row
-    ``expert % nLx`` — the same global-expert-id semantics as
-    :func:`poison_expert`'s ``[E, C, H]`` site, but applied where the
-    fault physically originates (the owner, BEFORE the return
-    exchange), so the NaN crosses the transport — wire compression
-    included — before any health mask sees it."""
+    parallel buffer ``[nE, rows, H]`` inside a shard_map body over
+    ``axis``: only the expert's owner rank poisons, at its local row —
+    the same global-expert-id semantics as :func:`poison_expert`'s
+    ``[E, C, H]`` site, but applied where the fault physically
+    originates (the owner, BEFORE the return exchange), so the NaN
+    crosses the transport — wire compression included — before any
+    health mask sees it.
+
+    The buffer may be a chunk of the owner's local experts (the chunked
+    a2a pipeline, ``MoEConfig.a2a_chunks``): ``local_total`` is the
+    owner's full local-expert count (default: the buffer's own leading
+    dim — the whole-slab case) and ``local_offset`` the first local
+    expert this buffer covers.  A chunk that does not contain the armed
+    expert is returned untouched — all offsets are trace-time ints, so
+    the decision is static per chunk."""
     import jax
 
     yloc = jnp.asarray(yloc)
-    nlx = yloc.shape[0]
+    nrows = yloc.shape[0]
+    total = local_total if local_total is not None else nrows
     e = int(_ARMED["nan_expert"].get("expert", 0)) % num_experts
-    mine = jax.lax.axis_index(axis) == e // nlx
-    poisoned = yloc.at[e % nlx].set(jnp.asarray(jnp.nan, yloc.dtype))
+    row = e % total - local_offset
+    if row < 0 or row >= nrows:
+        return yloc  # armed expert lives in another chunk
+    mine = jax.lax.axis_index(axis) == e // total
+    poisoned = yloc.at[row].set(jnp.asarray(jnp.nan, yloc.dtype))
     return jnp.where(mine, poisoned, yloc)
 
 

@@ -141,6 +141,22 @@ class MoEConfig:
     wire_dtype: str | None = None
     wire_dtype_combine: str | None = None
 
+    # Chunked double-buffered EP dispatch (Comet-style compute–
+    # communication overlap, arXiv 2502.19811): split the [E, C, H]
+    # exchange slab along the local-expert axis into this many chunks
+    # and software-pipeline the XLA transports so chunk k's expert FFN
+    # overlaps chunk k+1's all-to-all, on the dispatch AND combine legs
+    # (parallel/ep.py / parallel/ragged_ep.py; priced by the planner,
+    # which also picks the best count under moe_backend='auto').
+    # Composes with the wire codec: each chunk encodes/decodes inside
+    # the pipeline.  Must divide num_experts // ep (validated here; the
+    # shard body re-validates against the actual mesh).  Default None:
+    # OFF, the serial schedule — bit-identical to a pre-chunking build
+    # (the collect_stats / wire_dtype convention, asserted by
+    # tests/test_chunked.py).  The fused RDMA kernel ignores the knob:
+    # its transport already overlaps in-kernel per-slab (docs/PERF.md).
+    a2a_chunks: int | None = None
+
     # In-graph MoE observability (flashmoe_tpu/ops/stats.py): when True,
     # every MoE layer additionally returns a MoEStats tuple (per-expert
     # load histogram, dropped-token fraction, capacity utilization,
@@ -222,6 +238,22 @@ class MoEConfig:
                     f"{jnp.dtype(self.dtype).name} "
                     f"({jnp.dtype(self.dtype).itemsize} B); a wire must "
                     f"compress, not inflate")
+        # chunked a2a pipeline: reject impossible chunk counts at config
+        # time (clear ValueError) instead of a shape error inside the
+        # pipeline loop; the shard body re-checks against the actual
+        # mesh width, which may differ from cfg.ep
+        if self.a2a_chunks is not None:
+            n = self.a2a_chunks
+            if not isinstance(n, int) or n < 1:
+                raise ValueError(
+                    f"a2a_chunks={n!r} must be a positive int (or None "
+                    f"for the serial schedule)")
+            nlx = self.num_experts // max(self.ep, 1)
+            if n > 1 and (nlx == 0 or nlx % n):
+                raise ValueError(
+                    f"a2a_chunks={n} must divide the local-expert axis "
+                    f"(num_experts // ep = {nlx}); pick a divisor or "
+                    f"leave a2a_chunks=None for the serial schedule")
         if ((self.wire_dtype or self.wire_dtype_combine)
                 and self.moe_backend == "fused"):
             raise ValueError(

@@ -148,15 +148,15 @@ def attention(layer, x, cfg: MoEConfig, positions=None, mesh=None,
     return ctx @ layer["wo"].astype(x.dtype)
 
 
-def _resolved_backend(cfg: MoEConfig, mesh) -> str:
-    """cfg.moe_backend with 'auto' resolved by the analytical planner
-    (predicted-latency winner, measured override; decision recorded in
-    telemetry)."""
+def _resolved_plan(cfg: MoEConfig, mesh) -> tuple[str, int | None]:
+    """(moe_backend, a2a_chunks) with 'auto' resolved by the analytical
+    planner (predicted-latency winner + chunked-pipeline sweep,
+    measured override; decision recorded in telemetry)."""
     if cfg.moe_backend != "auto":
-        return cfg.moe_backend
-    from flashmoe_tpu.parallel.ep import resolve_moe_backend
+        return cfg.moe_backend, cfg.a2a_chunks
+    from flashmoe_tpu.parallel.ep import resolve_moe_plan
 
-    return resolve_moe_backend(cfg, mesh)
+    return resolve_moe_plan(cfg, mesh)
 
 
 def _ffn(layer, x, cfg: MoEConfig, li: int, mesh, use_pallas):
@@ -168,7 +168,13 @@ def _ffn(layer, x, cfg: MoEConfig, li: int, mesh, use_pallas):
     )
     if mesh is not None and layer_cfg.num_experts > 1 and cfg.ep > 1:
         axes = ("dp", "ep") + (("sp",) if cfg.sp > 1 else ())
-        backend = _resolved_backend(cfg, mesh)
+        backend, chunks = _resolved_plan(cfg, mesh)
+        # the planner's chunked-pipeline pick rides the layer config
+        # (parallel/ep.py reads cfg.a2a_chunks); explicit settings and
+        # unservable picks pass through untouched
+        from flashmoe_tpu.parallel.ep import apply_chunk_pick
+
+        layer_cfg = apply_chunk_pick(layer_cfg, backend, chunks)
         if backend == "fused" and cfg.tp == 1:
             from flashmoe_tpu.parallel.fused import fused_ep_moe_layer
 
@@ -230,7 +236,7 @@ def forward(params, tokens, cfg: MoEConfig, mesh=None, use_pallas=None):
     # storing the exchange intermediates).  Non-MoE blocks keep remat.
     fused_active = (cfg.ep > 1 and cfg.tp == 1 and mesh is not None
                     and cfg.num_experts > 1
-                    and _resolved_backend(cfg, mesh) == "fused")
+                    and _resolved_plan(cfg, mesh)[0] == "fused")
     blk_remat = jax.checkpoint(
         block, static_argnums=(2, 3, 4, 5),
         policy=jax.checkpoint_policies.nothing_saveable,

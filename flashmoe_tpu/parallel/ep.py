@@ -31,6 +31,19 @@ Both exchanges optionally compress their payload to a narrow wire dtype
 dequantize just after, halving (bf16) or quartering (fp8 + f32 per-row
 scale sidecar) the ICI/DCN bytes while every compute stage stays at the
 compute dtype.  Off by default; the wire-off graph is bit-identical.
+
+With ``MoEConfig.a2a_chunks = n`` the exchange additionally runs as a
+chunked software pipeline (Comet, arXiv 2502.19811): the ``[D, nLx, C,
+H]`` slab splits into ``n`` chunks along the local-expert axis and each
+chunk runs its own dispatch-a2a -> expert-FFN -> combine-a2a chain.
+The ``n`` chains are independent in the graph (unrolled, no carried
+state), so XLA's latency-hiding scheduler can issue chunk ``k+1``'s
+all-to-all while chunk ``k``'s GEMMs occupy the MXU — on both legs,
+for the flat and the hierarchical exchange, with the wire codec
+encoding/decoding per chunk inside the pipeline.  ``None`` (default)
+keeps the serial single-slab schedule bit-identical to previous
+builds; the planner prices the pipeline and picks ``n`` under
+``moe_backend='auto'`` (:mod:`flashmoe_tpu.planner`).
 """
 
 from __future__ import annotations
@@ -157,21 +170,7 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
         plan = dsp.make_plan(r.expert_idx, cfg, cap)
         xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)  # [E, C, H]
 
-    # exchange expert-major slabs: [E, C, H] -> [D, nLx, C, H] received
-    wire_err = None
-    with trace_span("moe.a2a_dispatch"):
-        send = xbuf.reshape(d, nlx, cap, h)
-        if cfg.collect_stats and wire_disp is not None:
-            # round-trip error proxy on the payload actually shipped —
-            # stats-gated, so the stats-off graph carries no extra pass
-            wire_err = wr.roundtrip_error(send, wire_disp)
-        if skip_exchange:
-            recv = send
-        else:
-            recv = _wired_exchange(send, wire_disp, axis, d, dcn_inner,
-                                   reverse=False)
-            # [D, nLx, C, H] — dim 0 now indexes source rank
-    ybuf_in = recv.transpose(1, 0, 2, 3).reshape(nlx, d * cap, h)
+    from flashmoe_tpu.chaos import inject as chaos_inject
 
     ffn_params = params
     if tp_axis is not None:
@@ -179,40 +178,119 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
         # the psum reconstructs it exactly once
         tp = axis_size(tp_axis)
         ffn_params = dict(params, b_down=params["b_down"] / tp)
-    with trace_span("moe.expert"):
+
+    def ffn(buf, p):
+        """Expert FFN on a [nE, D*C, H] buffer with nE-leading params —
+        one definition for the serial slab and every pipeline chunk."""
         if use_pallas:
-            yloc = exp.capacity_buffer_ffn_ad(ybuf_in, ffn_params, cfg,
-                                              interpret)
+            y = exp.capacity_buffer_ffn_ad(buf, p, cfg, interpret)
         else:
-            yloc = exp.expert_ffn_dense(ybuf_in, ffn_params, cfg)
+            y = exp.expert_ffn_dense(buf, p, cfg)
         if tp_axis is not None:
-            yloc = jax.lax.psum(yloc, tp_axis)
+            y = jax.lax.psum(y, tp_axis)
+        return y
 
-    from flashmoe_tpu.chaos import inject as chaos_inject
+    n_chunks = cfg.a2a_chunks or 1
+    if n_chunks > 1 and nlx % n_chunks:
+        raise ValueError(
+            f"a2a_chunks={n_chunks} does not divide the local-expert "
+            f"axis (num_experts={e} // ep={d} = {nlx}); pick a divisor "
+            f"or leave a2a_chunks=None for the serial schedule")
 
-    if chaos_inject.is_armed("nan_expert"):  # trace-time check only
-        # poison BEFORE the return exchange: the fault originates at the
-        # sick expert's owner and must cross the transport — wire
-        # compression included — before the health mask sees it (the
-        # chaos drill's through-the-wire guarantee, tests/test_chaos.py).
-        # The armed spec names a GLOBAL expert id, exactly as at the
-        # [E, C, H] hook site in ops/moe.py.
-        yloc = chaos_inject.poison_local_expert(yloc, axis, e)
+    # exchange expert-major slabs: [E, C, H] -> [D, nLx, C, H] received
+    wire_err = None
+    send = xbuf.reshape(d, nlx, cap, h)
+    if cfg.collect_stats and wire_disp is not None:
+        # round-trip error proxy on the payload actually shipped —
+        # stats-gated, so the stats-off graph carries no extra pass
+        wire_err = wr.roundtrip_error(send, wire_disp)
 
-    # reverse: [nLx, D*C, H] -> [D, nLx, C, H] -> all_to_all -> [E, C, H]
-    with trace_span("moe.a2a_combine"):
-        ysend = yloc.reshape(nlx, d, cap, h).transpose(1, 0, 2, 3)
-        if cfg.collect_stats and wire_comb is not None:
-            comb_err = wr.roundtrip_error(ysend, wire_comb)
+    if n_chunks > 1:
+        # Chunked double-buffered pipeline (Comet, arXiv 2502.19811):
+        # n independent dispatch-a2a -> FFN -> combine-a2a chains over
+        # local-expert sub-slabs.  Unrolled on purpose — no carried
+        # state between chunks, so the latency-hiding scheduler is free
+        # to run chunk k+1's exchange under chunk k's GEMMs.  Per-chunk
+        # trace spans make pipeline occupancy visible in xprof.
+        ffn_keys = ("w_up", "w_gate", "b_up", "w_down", "b_down")
+        comb_err = None
+        nc = nlx // n_chunks
+        ybacks = []
+        for ck in range(n_chunks):
+            lo = ck * nc
+            with trace_span(f"moe.a2a_dispatch.{ck}"):
+                send_k = send[:, lo:lo + nc]
+                if skip_exchange:
+                    recv_k = send_k
+                else:
+                    recv_k = _wired_exchange(send_k, wire_disp, axis, d,
+                                             dcn_inner, reverse=False)
+            ybuf_k = recv_k.transpose(1, 0, 2, 3).reshape(nc, d * cap, h)
+            p_k = {kk: (v[lo:lo + nc] if kk in ffn_keys else v)
+                   for kk, v in ffn_params.items()}
+            with trace_span(f"moe.expert.{ck}"):
+                yloc_k = ffn(ybuf_k, p_k)
+            if chaos_inject.is_armed("nan_expert"):  # trace-time check
+                # same pre-exchange poisoning as the serial branch; the
+                # chunk covers local experts [lo, lo+nc) of this owner
+                yloc_k = chaos_inject.poison_local_expert(
+                    yloc_k, axis, e, local_offset=lo, local_total=nlx)
+            with trace_span(f"moe.a2a_combine.{ck}"):
+                ysend_k = yloc_k.reshape(nc, d, cap, h).transpose(
+                    1, 0, 2, 3)
+                if cfg.collect_stats and wire_comb is not None:
+                    err_k = wr.roundtrip_error(ysend_k, wire_comb)
+                    comb_err = (err_k if comb_err is None
+                                else jnp.maximum(comb_err, err_k))
+                if skip_exchange:
+                    yback_k = ysend_k
+                else:
+                    yback_k = _wired_exchange(ysend_k, wire_comb, axis,
+                                              d, dcn_inner, reverse=True)
+            ybacks.append(yback_k)
+        # [D, nc, C, H] chunks -> [D, nLx, C, H] -> [E, C, H]: global
+        # expert id = owner_rank * nLx + local index, so chunks stack
+        # along the local-expert axis
+        ybuf = jnp.concatenate(ybacks, axis=1).reshape(e, cap, h)
+        if comb_err is not None:
             wire_err = (comb_err if wire_err is None
                         else jnp.maximum(wire_err, comb_err))
-        if skip_exchange:
-            yback = ysend
-        else:
-            yback = _wired_exchange(ysend, wire_comb, axis, d, dcn_inner,
-                                    reverse=True)
-            # [D, nLx, C, H] — dim 0 indexes expert-owner rank
-    ybuf = yback.reshape(e, cap, h)
+    else:
+        with trace_span("moe.a2a_dispatch"):
+            if skip_exchange:
+                recv = send
+            else:
+                recv = _wired_exchange(send, wire_disp, axis, d,
+                                       dcn_inner, reverse=False)
+                # [D, nLx, C, H] — dim 0 now indexes source rank
+        ybuf_in = recv.transpose(1, 0, 2, 3).reshape(nlx, d * cap, h)
+        with trace_span("moe.expert"):
+            yloc = ffn(ybuf_in, ffn_params)
+
+        if chaos_inject.is_armed("nan_expert"):  # trace-time check only
+            # poison BEFORE the return exchange: the fault originates at
+            # the sick expert's owner and must cross the transport —
+            # wire compression included — before the health mask sees it
+            # (the chaos drill's through-the-wire guarantee,
+            # tests/test_chaos.py).  The armed spec names a GLOBAL
+            # expert id, exactly as at the [E, C, H] hook site in
+            # ops/moe.py.
+            yloc = chaos_inject.poison_local_expert(yloc, axis, e)
+
+        # reverse: [nLx, D*C, H] -> [D, nLx, C, H] -> a2a -> [E, C, H]
+        with trace_span("moe.a2a_combine"):
+            ysend = yloc.reshape(nlx, d, cap, h).transpose(1, 0, 2, 3)
+            if cfg.collect_stats and wire_comb is not None:
+                comb_err = wr.roundtrip_error(ysend, wire_comb)
+                wire_err = (comb_err if wire_err is None
+                            else jnp.maximum(wire_err, comb_err))
+            if skip_exchange:
+                yback = ysend
+            else:
+                yback = _wired_exchange(ysend, wire_comb, axis, d,
+                                        dcn_inner, reverse=True)
+                # [D, nLx, C, H] — dim 0 indexes expert-owner rank
+        ybuf = yback.reshape(e, cap, h)
 
     healthy = None
     combine_w = r.combine_weights
@@ -335,6 +413,31 @@ def resolve_moe_backend(cfg: MoEConfig, mesh: Mesh | None = None) -> str:
     return _resolve(cfg, mesh)
 
 
+def resolve_moe_plan(cfg: MoEConfig, mesh: Mesh | None = None
+                     ) -> tuple[str, int | None]:
+    """(moe_backend, a2a_chunks) an ``moe_backend='auto'`` config should
+    run: the planner's path winner plus its chunked-pipeline pick for
+    the XLA transports (``None`` = serial).  Explicit configs pass
+    through with their own ``cfg.a2a_chunks``."""
+    from flashmoe_tpu.planner.select import resolve_moe_plan as _resolve
+
+    return _resolve(cfg, mesh)
+
+
+def apply_chunk_pick(cfg: MoEConfig, backend: str,
+                     chunks: int | None) -> MoEConfig:
+    """Thread the planner's chunked-pipeline pick into a layer config
+    (the shard bodies read ``cfg.a2a_chunks``).  An explicit
+    ``cfg.a2a_chunks`` — or a backend/shape the pick cannot serve —
+    passes through untouched; the one guard both call sites
+    (``auto_ep_moe_layer``, the transformer's FFN block) must share."""
+    if (chunks and chunks > 1 and cfg.a2a_chunks is None
+            and backend in ("collective", "ragged")
+            and cfg.num_experts // max(cfg.ep, 1) % chunks == 0):
+        return cfg.replace(a2a_chunks=chunks)
+    return cfg
+
+
 def auto_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
                       use_pallas: bool = False,
                       token_axes: tuple[str, ...] = ("ep",),
@@ -343,9 +446,11 @@ def auto_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
     """Expert-parallel MoE layer on the planner-selected path.
 
     Same contract as :func:`ep_moe_layer`; the transport (collective /
-    ragged / fused RDMA) is chosen by :func:`resolve_moe_backend` for
-    this (cfg, mesh) instead of being hard-coded by the caller."""
-    backend = resolve_moe_backend(cfg, mesh)
+    ragged / fused RDMA) — and the chunked-pipeline depth for the XLA
+    transports — is chosen by :func:`resolve_moe_plan` for this
+    (cfg, mesh) instead of being hard-coded by the caller."""
+    backend, chunks = resolve_moe_plan(cfg, mesh)
+    cfg = apply_chunk_pick(cfg, backend, chunks)
     try:
         if backend == "fused":
             from flashmoe_tpu.parallel.fused import fused_ep_moe_layer

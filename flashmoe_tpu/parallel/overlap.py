@@ -40,26 +40,41 @@ from flashmoe_tpu.parallel.ep import ep_moe_layer, local_capacity
 from flashmoe_tpu.parallel.fused import fused_ep_moe_layer
 
 
-def _comm_only(x, cfg: MoEConfig, mesh: Mesh):
-    """Both all-to-alls on dispatch-shaped slabs, no compute between."""
+def _comm_only(x, cfg: MoEConfig, mesh: Mesh, *, path: str = "collective"):
+    """Both all-to-alls on path-shaped slabs, no compute between —
+    capacity slabs for the collective/fused paths, routed-row slabs for
+    the ragged path.  With ``cfg.a2a_chunks = n`` each leg runs as n
+    smaller exchanges (the pipeline's wire schedule, per-message alpha
+    included), so the comm leg measures what the chunked schedule
+    actually pays."""
+    n = cfg.a2a_chunks or 1
 
     def body(x):
         d = axis_size("ep")
         s_loc, h = x.shape
-        nlx = cfg.num_experts // d
-        cap = local_capacity(cfg, s_loc)
-        rows = d * nlx * cap
-        src = (jnp.arange(rows, dtype=jnp.int32) % s_loc)
-        slab = x[src].reshape(d, nlx, cap, h)
-        recv = jax.lax.all_to_all(
-            slab, "ep", split_axis=0, concat_axis=0, tiled=False
-        )
-        back = jax.lax.all_to_all(
-            recv, "ep", split_axis=0, concat_axis=0, tiled=False
-        )
+        if path == "ragged":
+            # uniform-routing expectation: s_loc * k routed rows split
+            # evenly over the d peers
+            r = max(s_loc * cfg.expert_top_k // d, 1)
+        else:
+            r = (cfg.num_experts // d) * local_capacity(cfg, s_loc)
+        rp = -(-r // n) * n  # rows per dest, padded to the chunk count
+        src = (jnp.arange(d * rp, dtype=jnp.int32) % s_loc)
+        slab = x[src].reshape(d, rp, h)
+        outs = []
+        for k in range(n):
+            c = slab[:, k * (rp // n):(k + 1) * (rp // n)]
+            c = jax.lax.all_to_all(
+                c, "ep", split_axis=0, concat_axis=0, tiled=False
+            )
+            c = jax.lax.all_to_all(
+                c, "ep", split_axis=0, concat_axis=0, tiled=False
+            )
+            outs.append(c)
+        back = outs[0] if n == 1 else jnp.concatenate(outs, axis=1)
         # feed the payload back as the next chain input (data dependency —
         # nothing for XLA to dead-code-eliminate)
-        return back.reshape(rows, h)[:s_loc]
+        return back.reshape(d * rp, h)[:s_loc]
 
     return shard_map(
         body, mesh=mesh, in_specs=P("ep", None), out_specs=P("ep", None),
@@ -95,33 +110,55 @@ def _time_chained(fn, x, *, trials: int, chain: int):
 
 def measure_overlap(cfg: MoEConfig, mesh: Mesh, *, path: str = "fused",
                     trials: int = 5, chain: int = 8,
-                    interpret: bool = False, seed: int = 0) -> dict:
+                    interpret: bool = False, seed: int = 0,
+                    a2a_chunks: int | None = None) -> dict:
     """Measure the three legs and the efficiency ratio on ``mesh``.
 
-    ``path``: 'fused' (Pallas RDMA kernel) or 'collective' (XLA layer).
+    ``path``: 'fused' (Pallas RDMA kernel), 'collective' (XLA layer) or
+    'ragged' (dropless row exchanges).  ``a2a_chunks`` overrides
+    ``cfg.a2a_chunks`` for the XLA transports — the chunked pipeline's
+    measured efficiency is then directly comparable against
+    :func:`chunked_overlap_bound`'s analytic one; the fused kernel
+    ignores the knob (in-kernel per-slab overlap), so passing it with
+    ``path='fused'`` is an error.
     Returns {t_overlapped_ms, t_compute_ms, t_comm_ms, overlap_efficiency}.
     """
     ep = mesh.shape["ep"]
     if cfg.num_experts % ep:
         raise ValueError(f"E={cfg.num_experts} not divisible by ep={ep}")
+    if a2a_chunks is not None:
+        if path == "fused":
+            raise ValueError(
+                "a2a_chunks applies to the XLA transports; the fused "
+                "kernel overlaps in-kernel and ignores the knob")
+        cfg = cfg.replace(a2a_chunks=None if a2a_chunks <= 1
+                          else a2a_chunks)
     pk, xk = jax.random.split(jax.random.PRNGKey(seed))
     params = init_moe_params(pk, cfg)
     params = jax.tree_util.tree_map(lambda p: p.astype(cfg.dtype), params)
     x = jax.random.normal(xk, (cfg.tokens, cfg.hidden_size), cfg.dtype)
 
+    if path not in ("fused", "collective", "ragged"):
+        raise ValueError(f"unknown path {path!r}")
+    if path == "ragged":
+        from flashmoe_tpu.parallel.ragged_ep import ragged_ep_moe_layer
+
+        layer = ragged_ep_moe_layer
+    else:
+        layer = ep_moe_layer
+
+    def xla_layer(c, skip=False):
+        return layer(params, c, cfg, mesh, use_pallas=interpret,
+                     interpret=interpret, skip_exchange=skip).out
+
     if path == "fused":
         overlapped = lambda c: fused_ep_moe_layer(
             params, c, cfg, mesh, interpret=interpret).out
-    elif path == "collective":
-        overlapped = lambda c: ep_moe_layer(
-            params, c, cfg, mesh, use_pallas=interpret,
-            interpret=interpret).out
     else:
-        raise ValueError(f"unknown path {path!r}")
-    compute_only = lambda c: ep_moe_layer(
-        params, c, cfg, mesh, use_pallas=interpret, interpret=interpret,
-        skip_exchange=True).out
-    comm_only = lambda c: _comm_only(c, cfg, mesh)
+        overlapped = xla_layer
+    compute_only = lambda c: xla_layer(c, skip=True)
+    comm_path = "ragged" if path == "ragged" else "collective"
+    comm_only = lambda c: _comm_only(c, cfg, mesh, path=comm_path)
 
     t_over = _time_chained(overlapped, x, trials=trials, chain=chain)
     t_comp = _time_chained(compute_only, x, trials=trials, chain=chain)
@@ -133,6 +170,7 @@ def measure_overlap(cfg: MoEConfig, mesh: Mesh, *, path: str = "fused",
         "overlap_efficiency": (t_comp + t_comm) / t_over,
         "path": path,
         "ep": ep,
+        "a2a_chunks": cfg.a2a_chunks or 1,
     }
 
 
@@ -217,4 +255,69 @@ def overlap_bound(cfg: MoEConfig, d: int, gen: str = "v5e", *,
         "t_overlapped_ms": t_over * 1e3,
         "overlap_efficiency_bound": oe,
         "compute_bound": compute_bound,
+    }
+
+
+def chunked_overlap_bound(cfg: MoEConfig, d: int, gen: str = "v5e",
+                          chunks: int = 1, *, links: int = 4,
+                          mxu_fraction: float = 1.0,
+                          path: str = "collective") -> dict:
+    """Analytical expected overlap efficiency of the chunked
+    double-buffered XLA-transport pipeline (``MoEConfig.a2a_chunks``) —
+    the number a ``bench.py --overlap`` measurement of the chunked
+    schedule is judged against, the way :func:`overlap_bound` anchors
+    the fused kernel's measurement.
+
+    Model (per rank, uniform routing): FFN compute ``C`` on the
+    ``s_loc * k`` routed rows at ``mxu_fraction`` of peak; per-leg wire
+    serialization at the leg's wire row size with ``chunks`` messages
+    per peer (alpha x chunks — ``analysis.a2a_transport_cost``'s
+    chunking rule); makespan ``T`` from
+    ``analysis.chunked_pipeline_ms``.  The efficiency mirrors the
+    operational metric exactly:
+
+        OE = (C + E(n)) / T(n)     (serial + both chunked legs over
+                                    the pipelined makespan)
+
+    so ``chunks=1`` gives exactly 1.0 (fully serialized) and the upper
+    bound is ``measure_overlap``'s ``(a+b)/max(a,b)`` shape.  ``path``
+    prices capacity slabs ('collective') or routed rows ('ragged').
+    Returns every intermediate so tests can assert the pieces."""
+    from flashmoe_tpu.analysis import chunked_pipeline_ms, wire_row_bytes
+    from flashmoe_tpu.parallel.topology import _ICI_SPECS, chip_spec
+
+    if chunks < 1:
+        raise ValueError(f"chunks={chunks} must be >= 1")
+    if path not in ("collective", "ragged"):
+        raise ValueError(
+            f"unknown chunked path {path!r}; the fused kernel has its "
+            f"own bound (overlap_bound)")
+    peak_tflops, _ = chip_spec(gen)   # ValueError on unknown gen
+    a_us, gbps = _ICI_SPECS.get(gen, _ICI_SPECS["default"])
+    a_ms = a_us / 1e3
+    bw_ms = gbps * 1e6 * max(links, 1)            # B/ms, striped
+    mxu_fraction = max(min(mxu_fraction, 1.0), 1e-6)
+    s_loc = cfg.tokens // d
+    rows = s_loc * cfg.expert_top_k
+    gemms = 3 if cfg.gated_ffn else 2
+    flops = gemms * 2.0 * rows * cfg.hidden_size * cfg.intermediate_size
+    c_ms = flops / (peak_tflops * 1e9 * mxu_fraction)  # TFLOP/s -> /ms
+    if path == "ragged":
+        slab_rows = rows / d
+    else:
+        slab_rows = (cfg.num_experts // d) * local_capacity(cfg, s_loc)
+    leg = lambda which: (d - 1) * (
+        chunks * a_ms + slab_rows * wire_row_bytes(cfg, which) / bw_ms)
+    e_d, e_c = leg("dispatch"), leg("combine")
+    t = chunked_pipeline_ms(c_ms, e_d, e_c, chunks)
+    serial = c_ms + e_d + e_c
+    return {
+        "chunks": chunks,
+        "path": path,
+        "compute_ms": c_ms,
+        "leg_dispatch_ms": e_d,
+        "leg_combine_ms": e_c,
+        "serial_ms": serial,
+        "t_overlapped_ms": t,
+        "overlap_efficiency_bound": serial / t,
     }

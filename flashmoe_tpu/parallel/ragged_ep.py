@@ -20,6 +20,15 @@ whole dance then runs in reverse.
 
 All shapes are static upper bounds; ``recv_bound`` defaults to the true
 worst case (every token in the ep group routed to one rank).
+
+With ``MoEConfig.a2a_chunks = n`` the exchanges run as a chunked
+software pipeline mirroring :mod:`flashmoe_tpu.parallel.ep`: the
+local-expert axis splits into ``n`` chunks, each with its own
+row-exchange -> regroup -> grouped-FFN -> return-exchange chain over
+the chunk's rows only (offsets/sizes derived per chunk from one
+all-gathered count matrix).  The chains are independent in the graph,
+so chunk ``k+1``'s ragged transfer can overlap chunk ``k``'s FFN.
+``None`` (default) keeps the serial schedule bit-identical.
 """
 
 from __future__ import annotations
@@ -109,9 +118,239 @@ def _wired_row_exchange(arr, wire_dtype, **kw):
     return wr.decode(payload, scales[:, 0], arr.dtype)
 
 
+def _pad_rows(arr, out_rows: int):
+    """Shape ``arr`` ([N, W]) to exactly ``out_rows`` rows (pad with
+    zeros / truncate) — the exchange-elided stand-in for a row transfer
+    on the overlap measurement's compute-only leg (the result is
+    numerically meaningless, the shapes and every other stage are
+    exact)."""
+    n = arr.shape[0]
+    if n >= out_rows:
+        return arr[:out_rows]
+    return jnp.pad(arr, ((0, out_rows - n), (0, 0)))
+
+
+def _regroup_maps(recv_cmat, recv_offsets, recv_sizes, recv_bound: int,
+                  block_m: int):
+    """Src-major -> tile-padded expert-major scatter targets for one
+    (chunk of the) local-expert axis.
+
+    ``recv_cmat`` [D, nE]: rows per (source, local expert in this
+    chunk); ``recv_offsets``/``recv_sizes`` [D]: where each source's
+    block sits in the chunk's src-major receive buffer.  Returns
+    (target [recv_bound], grouped_rows, tile_gid) with the dropped-row
+    sentinel at ``grouped_rows`` (strictly out of range for the
+    scatter's drop mode)."""
+    d, ne = recv_cmat.shape
+    etot = jnp.sum(recv_cmat, axis=0)  # [nE]
+    epad = ((etot + block_m - 1) // block_m) * block_m
+    eseg = (jnp.cumsum(epad) - epad).astype(jnp.int32)  # [nE]
+    pre = (jnp.cumsum(recv_cmat, axis=0) - recv_cmat)  # rows before src s
+    intra = (jnp.cumsum(recv_cmat, axis=1) - recv_cmat)  # within-src starts
+
+    rows = jnp.arange(recv_bound, dtype=jnp.int32)
+    src_of = jnp.clip(
+        jnp.searchsorted(
+            (recv_offsets + recv_sizes).astype(jnp.int32), rows,
+            side="right",
+        ).astype(jnp.int32),
+        0, d - 1,
+    )
+    w = rows - recv_offsets[src_of]  # offset within the src block
+    cum_intra = jnp.cumsum(recv_cmat, axis=1)  # [D, nE] ends
+    e_of = jnp.sum(
+        w[:, None] >= cum_intra[src_of], axis=1
+    ).astype(jnp.int32)
+    e_of = jnp.clip(e_of, 0, ne - 1)
+    i_of = w - intra[src_of, e_of]
+    total_recv = jnp.sum(recv_sizes)
+
+    # grouped buffer: per-expert tile padding can push targets past
+    # recv_bound, so the buffer is recv_bound (tile-rounded) plus one tile
+    # per expert, and the dropped-row sentinel is grouped_rows itself —
+    # strictly out of range for the scatter's drop mode
+    grouped_rows = (
+        ((recv_bound + block_m - 1) // block_m) * block_m
+        + ne * block_m
+    )
+    target = jnp.where(
+        rows < total_recv,
+        eseg[e_of] + pre[src_of, e_of] + i_of,
+        grouped_rows,  # out of range -> dropped
+    )
+    # tile group ids from padded segment ends
+    n_tiles = grouped_rows // block_m
+    tile_starts = jnp.arange(n_tiles, dtype=jnp.int32) * block_m
+    seg_ends = eseg + epad
+    tile_gid = jnp.clip(
+        jnp.sum(tile_starts[:, None] >= seg_ends[None, :], axis=1),
+        0, ne - 1,
+    ).astype(jnp.int32)
+    return target, grouped_rows, tile_gid, total_recv
+
+
+def _grouped_ffn(x_grp, tile_gid, weights, cfg: MoEConfig, *,
+                 use_pallas: bool, interpret: bool, block_m: int):
+    """Grouped expert FFN on a tile-padded expert-major buffer, with
+    ``weights`` = (w_up, b_up, w_down, b_down, w_gate-or-None) covering
+    exactly the experts ``tile_gid`` indexes (the full local shard, or
+    one pipeline chunk's slice)."""
+    w_up, b_up, w_down, b_down, w_gate = weights
+    if use_pallas:
+        # _ad variant: Pallas forward AND Pallas backward (grouped_matmul/
+        # tgmm with saved residuals) — the dropless path trains through
+        # the kernels too
+        return exp.grouped_ffn_ad(
+            x_grp, tile_gid,
+            w_up.astype(cfg.dtype), b_up,
+            w_down.astype(cfg.dtype), b_down,
+            w_gate,
+            cfg.hidden_act, cfg.gated_ffn, block_m,
+            exp.DEFAULT_BLOCK_I, interpret,
+        )
+    # XLA fallback: per-row weight selection via one-hot (test path)
+    ne = w_up.shape[0]
+    sel = jax.nn.one_hot(
+        jnp.repeat(tile_gid, block_m), ne, dtype=x_grp.dtype
+    )  # [rows, nE]
+    up_w = jnp.einsum("rn,nhi->rhi", sel, w_up.astype(x_grp.dtype))
+    up = jnp.einsum("rh,rhi->ri", x_grp, up_w) + sel @ b_up.astype(x_grp.dtype)
+    from flashmoe_tpu.models.reference import activation_fn
+    act = activation_fn(cfg.hidden_act)
+    if cfg.gated_ffn:
+        g_w = jnp.einsum("rn,nhi->rhi", sel,
+                         w_gate.astype(x_grp.dtype))
+        hid = act(jnp.einsum("rh,rhi->ri", x_grp, g_w)) * up
+    else:
+        hid = act(up)
+    dn_w = jnp.einsum("rn,nih->rih", sel,
+                      w_down.astype(x_grp.dtype))
+    return (jnp.einsum("ri,rih->rh", hid, dn_w)
+            + sel @ b_down.astype(x_grp.dtype))
+
+
+def _chunked_ragged_exchange(params, xs, cmat, input_offsets,
+                             cfg: MoEConfig, *, axis: str, d: int,
+                             nlx: int, n_chunks: int, h: int,
+                             n_assign: int, recv_bound: int,
+                             exchange: str, block_m: int,
+                             use_pallas: bool, interpret: bool,
+                             wire_disp, wire_comb, w_gate_p,
+                             skip_exchange: bool):
+    """Chunked double-buffered ragged EP: ``n_chunks`` independent
+    row-exchange -> regroup -> grouped-FFN -> return-exchange chains,
+    one per local-expert sub-range (the :mod:`flashmoe_tpu.parallel.ep`
+    pipeline mirrored onto variable-size transfers).
+
+    One ``all_gather`` of the [dest, local-expert] count matrix replaces
+    the serial path's (send-size gather + count a2a): every chunk's
+    send/recv offsets and sizes derive from it arithmetically, because a
+    chunk's rows are contiguous within each destination block of the
+    expert-sorted staging buffer ``xs``.  Returns (ys [n_assign, H] in
+    the original expert-sorted layout — the disjoint per-chunk returns
+    summed — and the stats-gated combine wire error, or None)."""
+    from flashmoe_tpu.utils.telemetry import trace_span
+
+    nc = nlx // n_chunks
+    my = jax.lax.axis_index(axis)
+    # all ranks' count matrices: all_cmat[s, p, le] = rows s sends to
+    # dest p for p's local expert le
+    all_cmat = jax.lax.all_gather(cmat, axis)  # [D_src, D_dst, nLx]
+    # exclusive prefixes along the local-expert axis: where a chunk
+    # starts inside each (src, dest) block
+    cmat_pre = (jnp.cumsum(cmat, axis=1) - cmat).astype(jnp.int32)
+    all_pre = (jnp.cumsum(all_cmat, axis=2) - all_cmat).astype(jnp.int32)
+    all_send = jnp.sum(all_cmat, axis=2)  # [D_src, D_dst] totals
+    # rank s staged its block for dest p at excl-cumsum over dests
+    dest_pre = (jnp.cumsum(all_send, axis=1)
+                - all_send).astype(jnp.int32)  # [D_src, D_dst]
+    recv_cmat = all_cmat[:, my, :]  # [D_src, nLx] rows sent to me
+
+    ys = jnp.zeros((n_assign, h), xs.dtype)
+    comb_err = None
+    for ck in range(n_chunks):
+        lo = ck * nc
+        # -- per-chunk transfer geometry (all arithmetic, no collective)
+        send_sizes_c = jnp.sum(
+            cmat[:, lo:lo + nc], axis=1).astype(jnp.int32)  # [D]
+        send_offsets_c = (input_offsets + cmat_pre[:, lo]).astype(
+            jnp.int32)
+        all_send_c = jnp.sum(all_cmat[:, :, lo:lo + nc], axis=2)
+        recv_sizes_c = all_send_c[:, my].astype(jnp.int32)
+        recv_offsets_c = (jnp.cumsum(recv_sizes_c)
+                          - recv_sizes_c).astype(jnp.int32)
+        out_offsets_c = (
+            jnp.cumsum(all_send_c, axis=0) - all_send_c
+        )[my].astype(jnp.int32)
+
+        # -- forward rows for this chunk (read straight out of xs: the
+        # chunk's rows are contiguous within each dest block)
+        with trace_span(f"moe.a2a_dispatch.{ck}"):
+            if skip_exchange:
+                x_recv_c = _pad_rows(xs, recv_bound)
+            else:
+                x_recv_c = _wired_row_exchange(
+                    xs, wire_disp, axis=axis, d=d, exchange=exchange,
+                    block_rows=n_assign, out_bound=recv_bound,
+                    send_offsets=send_offsets_c, send_sizes=send_sizes_c,
+                    remote_offsets=out_offsets_c,
+                    recv_sizes=recv_sizes_c,
+                    recv_offsets=recv_offsets_c,
+                )
+
+        # -- regroup + FFN on the chunk's experts only
+        rows = jnp.arange(recv_bound, dtype=jnp.int32)
+        target, grouped_rows, tile_gid, total_recv = _regroup_maps(
+            recv_cmat[:, lo:lo + nc], recv_offsets_c, recv_sizes_c,
+            recv_bound, block_m)
+        x_grp = jnp.zeros((grouped_rows, h), xs.dtype)
+        x_grp = x_grp.at[target].set(x_recv_c, mode="drop")
+        with trace_span(f"moe.expert.{ck}"):
+            y_grp = _grouped_ffn(
+                x_grp, tile_gid,
+                (params["w_up"][lo:lo + nc], params["b_up"][lo:lo + nc],
+                 params["w_down"][lo:lo + nc],
+                 params["b_down"][lo:lo + nc],
+                 None if w_gate_p is None else w_gate_p[lo:lo + nc]),
+                cfg, use_pallas=use_pallas, interpret=interpret,
+                block_m=block_m)
+
+        # -- return: back to each source's original staging slots
+        y_src_major = y_grp[target.clip(0, grouped_rows - 1)]
+        y_src_major = jnp.where(
+            (rows < total_recv)[:, None], y_src_major, 0
+        ).astype(xs.dtype)
+        # rank s staged its chunk-ck rows for me at its dest-block start
+        # plus the chunk's intra-block prefix
+        rev_out_offsets_c = (dest_pre[:, my]
+                             + all_pre[:, my, lo]).astype(jnp.int32)
+        if cfg.collect_stats and wire_comb is not None:
+            err_k = wr.roundtrip_error(y_src_major, wire_comb)
+            comb_err = (err_k if comb_err is None
+                        else jnp.maximum(comb_err, err_k))
+        with trace_span(f"moe.a2a_combine.{ck}"):
+            if skip_exchange:
+                ys_c = _pad_rows(y_src_major, n_assign)
+            else:
+                ys_c = _wired_row_exchange(
+                    y_src_major, wire_comb, axis=axis, d=d,
+                    exchange=exchange,
+                    block_rows=n_assign, out_bound=n_assign,
+                    send_offsets=recv_offsets_c, send_sizes=recv_sizes_c,
+                    remote_offsets=rev_out_offsets_c,
+                    recv_sizes=send_sizes_c,
+                    recv_offsets=send_offsets_c,
+                )
+        # chunks return disjoint row ranges (zeros elsewhere): summing
+        # reassembles the full expert-sorted ys
+        ys = ys + ys_c
+    return ys, comb_err
+
+
 def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
                      use_pallas: bool, interpret: bool, exchange: str,
-                     block_m: int, reduce_axes):
+                     block_m: int, reduce_axes,
+                     skip_exchange: bool = False):
     d = axis_size(axis)
     s_loc, h = x.shape
     e = cfg.num_experts
@@ -120,6 +359,12 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
     recv_bound = d * n_assign  # worst case: everyone routes to me
     wire_disp = wr.resolve(cfg.wire_dtype)
     wire_comb = wr.resolve(cfg.wire_dtype_combine)
+    n_chunks = cfg.a2a_chunks or 1
+    if n_chunks > 1 and nlx % n_chunks:
+        raise ValueError(
+            f"a2a_chunks={n_chunks} does not divide the local-expert "
+            f"axis (num_experts={e} // ep={d} = {nlx}); pick a divisor "
+            f"or leave a2a_chunks=None for the serial schedule")
 
     r = router(x, params["gate_w"], cfg, use_pallas=use_pallas,
                interpret=interpret)
@@ -133,140 +378,95 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
     send_sizes = jnp.sum(cmat, axis=1).astype(jnp.int32)  # [D]
     input_offsets = (jnp.cumsum(send_sizes) - send_sizes).astype(jnp.int32)
 
-    # ---- exchange sizes ----
-    # all ranks' send matrices: S[s, d] = rows s sends to d
-    all_send = jax.lax.all_gather(send_sizes, axis)  # [D, D]
-    my = jax.lax.axis_index(axis)
-    recv_sizes = all_send[:, my].astype(jnp.int32)  # [D] rows from each src
-    recv_offsets = (jnp.cumsum(recv_sizes) - recv_sizes).astype(jnp.int32)
-    # where my block starts on each destination = sum of earlier sources
-    out_offsets = (
-        jnp.cumsum(all_send, axis=0) - all_send
-    )[my].astype(jnp.int32)  # [D]
-    # per-(src, my local expert) counts, for regrouping
-    recv_cmat = jax.lax.all_to_all(
-        cmat.reshape(d, 1, nlx), axis, split_axis=0, concat_axis=0,
-        tiled=False,
-    ).reshape(d, nlx)
-
-    # ---- forward data exchange: src-major ragged layout ----
     wire_err = None
     if cfg.collect_stats and wire_disp is not None:
         wire_err = wr.roundtrip_error(xs, wire_disp)
-    x_recv = _wired_row_exchange(
-        xs, wire_disp, axis=axis, d=d, exchange=exchange,
-        block_rows=n_assign, out_bound=recv_bound,
-        send_offsets=input_offsets, send_sizes=send_sizes,
-        remote_offsets=out_offsets, recv_sizes=recv_sizes,
-        recv_offsets=recv_offsets,
-    )
 
-    # ---- regroup src-major -> tile-padded expert-major (arithmetic) ----
-    # per-expert totals and padded segment starts
-    etot = jnp.sum(recv_cmat, axis=0)  # [nlx]
-    epad = ((etot + block_m - 1) // block_m) * block_m
-    eseg = (jnp.cumsum(epad) - epad).astype(jnp.int32)  # [nlx]
-    pre = (jnp.cumsum(recv_cmat, axis=0) - recv_cmat)  # [D, nlx] rows before src s
-    intra = (jnp.cumsum(recv_cmat, axis=1) - recv_cmat)  # [D, nlx] within-src starts
+    w_gate_p = params.get("w_gate", None) if cfg.gated_ffn else None
 
-    rows = jnp.arange(recv_bound, dtype=jnp.int32)
-    src_of = jnp.clip(
-        jnp.searchsorted(
-            (recv_offsets + recv_sizes).astype(jnp.int32), rows,
-            side="right",
-        ).astype(jnp.int32),
-        0, d - 1,
-    )
-    w = rows - recv_offsets[src_of]  # offset within the src block
-    cum_intra = jnp.cumsum(recv_cmat, axis=1)  # [D, nlx] ends
-    e_of = jnp.sum(
-        w[:, None] >= cum_intra[src_of], axis=1
-    ).astype(jnp.int32)
-    e_of = jnp.clip(e_of, 0, nlx - 1)
-    i_of = w - intra[src_of, e_of]
-    total_recv = jnp.sum(recv_sizes)
-
-    # grouped buffer: per-expert tile padding can push targets past
-    # recv_bound, so the buffer is recv_bound (tile-rounded) plus one tile
-    # per expert, and the dropped-row sentinel is grouped_rows itself —
-    # strictly out of range for the scatter's drop mode
-    grouped_rows = (
-        ((recv_bound + block_m - 1) // block_m) * block_m
-        + nlx * block_m
-    )
-    target = jnp.where(
-        rows < total_recv,
-        eseg[e_of] + pre[src_of, e_of] + i_of,
-        grouped_rows,  # out of range -> dropped
-    )
-    x_grp = jnp.zeros((grouped_rows, h), xs.dtype)
-    x_grp = x_grp.at[target].set(x_recv, mode="drop")
-
-    # tile group ids from padded segment ends
-    n_tiles = grouped_rows // block_m
-    tile_starts = jnp.arange(n_tiles, dtype=jnp.int32) * block_m
-    seg_ends = eseg + epad
-    tile_gid = jnp.clip(
-        jnp.sum(tile_starts[:, None] >= seg_ends[None, :], axis=1),
-        0, nlx - 1,
-    ).astype(jnp.int32)
-
-    # ---- expert FFN on the local shard of weights ----
-    if use_pallas:
-        # _ad variant: Pallas forward AND Pallas backward (grouped_matmul/
-        # tgmm with saved residuals) — the dropless path trains through
-        # the kernels too
-        y_grp = exp.grouped_ffn_ad(
-            x_grp, tile_gid,
-            params["w_up"].astype(cfg.dtype), params["b_up"],
-            params["w_down"].astype(cfg.dtype), params["b_down"],
-            params.get("w_gate", None) if cfg.gated_ffn else None,
-            cfg.hidden_act, cfg.gated_ffn, block_m,
-            exp.DEFAULT_BLOCK_I, interpret,
-        )
+    if n_chunks > 1:
+        ys, comb_err = _chunked_ragged_exchange(
+            params, xs, cmat, input_offsets, cfg,
+            axis=axis, d=d, nlx=nlx, n_chunks=n_chunks, h=h,
+            n_assign=n_assign, recv_bound=recv_bound, exchange=exchange,
+            block_m=block_m, use_pallas=use_pallas, interpret=interpret,
+            wire_disp=wire_disp, wire_comb=wire_comb,
+            w_gate_p=w_gate_p, skip_exchange=skip_exchange)
+        if comb_err is not None:
+            wire_err = (comb_err if wire_err is None
+                        else jnp.maximum(wire_err, comb_err))
     else:
-        # XLA fallback: per-row weight selection via one-hot (test path)
-        sel = jax.nn.one_hot(
-            jnp.repeat(tile_gid, block_m), nlx, dtype=x_grp.dtype
-        )  # [rows, nlx]
-        up_w = jnp.einsum("rn,nhi->rhi", sel, params["w_up"].astype(x_grp.dtype))
-        up = jnp.einsum("rh,rhi->ri", x_grp, up_w) + sel @ params["b_up"].astype(x_grp.dtype)
-        from flashmoe_tpu.models.reference import activation_fn
-        act = activation_fn(cfg.hidden_act)
-        if cfg.gated_ffn:
-            g_w = jnp.einsum("rn,nhi->rhi", sel,
-                             params["w_gate"].astype(x_grp.dtype))
-            hid = act(jnp.einsum("rh,rhi->ri", x_grp, g_w)) * up
+        # ---- exchange sizes ----
+        # all ranks' send matrices: S[s, d] = rows s sends to d
+        all_send = jax.lax.all_gather(send_sizes, axis)  # [D, D]
+        my = jax.lax.axis_index(axis)
+        recv_sizes = all_send[:, my].astype(jnp.int32)  # [D] rows per src
+        recv_offsets = (jnp.cumsum(recv_sizes)
+                        - recv_sizes).astype(jnp.int32)
+        # where my block starts on each destination = earlier sources
+        out_offsets = (
+            jnp.cumsum(all_send, axis=0) - all_send
+        )[my].astype(jnp.int32)  # [D]
+        # per-(src, my local expert) counts, for regrouping
+        recv_cmat = jax.lax.all_to_all(
+            cmat.reshape(d, 1, nlx), axis, split_axis=0, concat_axis=0,
+            tiled=False,
+        ).reshape(d, nlx)
+
+        # ---- forward data exchange: src-major ragged layout ----
+        if skip_exchange:
+            x_recv = _pad_rows(xs, recv_bound)
         else:
-            hid = act(up)
-        dn_w = jnp.einsum("rn,nih->rih", sel,
-                          params["w_down"].astype(x_grp.dtype))
-        y_grp = (jnp.einsum("ri,rih->rh", hid, dn_w)
-                 + sel @ params["b_down"].astype(x_grp.dtype))
+            x_recv = _wired_row_exchange(
+                xs, wire_disp, axis=axis, d=d, exchange=exchange,
+                block_rows=n_assign, out_bound=recv_bound,
+                send_offsets=input_offsets, send_sizes=send_sizes,
+                remote_offsets=out_offsets, recv_sizes=recv_sizes,
+                recv_offsets=recv_offsets,
+            )
 
-    # ---- return path: expert-major -> src-major -> ragged back ----
-    y_src_major = y_grp[target.clip(0, grouped_rows - 1)]
-    y_src_major = jnp.where(
-        (rows < total_recv)[:, None], y_src_major, 0
-    ).astype(xs.dtype)
+        # ---- regroup src-major -> tile-padded expert-major ----
+        rows = jnp.arange(recv_bound, dtype=jnp.int32)
+        target, grouped_rows, tile_gid, total_recv = _regroup_maps(
+            recv_cmat, recv_offsets, recv_sizes, recv_bound, block_m)
+        x_grp = jnp.zeros((grouped_rows, h), xs.dtype)
+        x_grp = x_grp.at[target].set(x_recv, mode="drop")
 
-    # returned rows must land where the source originally staged them:
-    # on rank s that's s's input_offsets[my] = exclusive row-cumsum of
-    # its send sizes — derivable from the gathered send matrix
-    rev_out_offsets = (
-        jnp.cumsum(all_send, axis=1) - all_send
-    )[:, my].astype(jnp.int32)
-    if cfg.collect_stats and wire_comb is not None:
-        comb_err = wr.roundtrip_error(y_src_major, wire_comb)
-        wire_err = (comb_err if wire_err is None
-                    else jnp.maximum(wire_err, comb_err))
-    ys = _wired_row_exchange(
-        y_src_major, wire_comb, axis=axis, d=d, exchange=exchange,
-        block_rows=n_assign, out_bound=n_assign,
-        send_offsets=recv_offsets, send_sizes=recv_sizes,
-        remote_offsets=rev_out_offsets, recv_sizes=send_sizes,
-        recv_offsets=input_offsets,
-    )
+        # ---- expert FFN on the local shard of weights ----
+        y_grp = _grouped_ffn(
+            x_grp, tile_gid,
+            (params["w_up"], params["b_up"], params["w_down"],
+             params["b_down"], w_gate_p),
+            cfg, use_pallas=use_pallas, interpret=interpret,
+            block_m=block_m)
+
+        # ---- return path: expert-major -> src-major -> ragged back ----
+        y_src_major = y_grp[target.clip(0, grouped_rows - 1)]
+        y_src_major = jnp.where(
+            (rows < total_recv)[:, None], y_src_major, 0
+        ).astype(xs.dtype)
+
+        # returned rows must land where the source originally staged
+        # them: on rank s that's s's input_offsets[my] = exclusive
+        # row-cumsum of its send sizes — from the gathered send matrix
+        rev_out_offsets = (
+            jnp.cumsum(all_send, axis=1) - all_send
+        )[:, my].astype(jnp.int32)
+        if cfg.collect_stats and wire_comb is not None:
+            comb_err = wr.roundtrip_error(y_src_major, wire_comb)
+            wire_err = (comb_err if wire_err is None
+                        else jnp.maximum(wire_err, comb_err))
+        if skip_exchange:
+            ys = _pad_rows(y_src_major, n_assign)
+        else:
+            ys = _wired_row_exchange(
+                y_src_major, wire_comb, axis=axis, d=d,
+                exchange=exchange,
+                block_rows=n_assign, out_bound=n_assign,
+                send_offsets=recv_offsets, send_sizes=recv_sizes,
+                remote_offsets=rev_out_offsets, recv_sizes=send_sizes,
+                recv_offsets=input_offsets,
+            )
 
     # ---- combine in the original expert-sorted layout ----
     healthy = None
@@ -305,12 +505,18 @@ def ragged_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
                         use_pallas: bool = False, interpret: bool = False,
                         exchange: str | None = None,
                         block_m: int = BLOCK_M,
-                        token_axes: tuple[str, ...] = ("ep",)) -> MoEOutput:
+                        token_axes: tuple[str, ...] = ("ep",),
+                        skip_exchange: bool = False) -> MoEOutput:
     """Dropless expert-parallel MoE over the ``ep`` axis.
 
     ``exchange``: "ragged" (TPU ``ragged_all_to_all``) or "dense" (padded
     ``all_to_all`` fallback — same layout logic, used on backends without
     the ragged op).  Default picks by backend.
+
+    ``skip_exchange`` elides the row transfers (metadata collectives
+    stay) while keeping every other stage and shape — the compute-only
+    leg of the overlap measurement (:mod:`flashmoe_tpu.parallel.overlap`);
+    the result is numerically meaningless.
     """
     if cfg.num_shared_experts:
         raise NotImplementedError("shared experts stay outside this layer")
@@ -320,7 +526,7 @@ def ragged_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
     body = functools.partial(
         _ragged_ep_shard, cfg=cfg, axis="ep", use_pallas=use_pallas,
         interpret=interpret, exchange=exchange, block_m=block_m,
-        reduce_axes=token_axes,
+        reduce_axes=token_axes, skip_exchange=skip_exchange,
     )
     pspecs = {k: P("ep") if k != "gate_w" else P() for k in params}
     stats_specs = (st.MoEStats(*([P()] * len(st.MoEStats._fields)))

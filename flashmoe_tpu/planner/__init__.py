@@ -19,11 +19,12 @@ Model details: :mod:`flashmoe_tpu.planner.model` docstring and
 """
 
 from flashmoe_tpu.planner.drift import (  # noqa: F401
-    DriftRecord, drift_report, record_drift,
+    DriftRecord, OverlapDriftRecord, drift_report, record_drift,
+    record_overlap_drift,
 )
 from flashmoe_tpu.planner.model import (  # noqa: F401
     BACKEND_OF, PathPrediction, explain_table, predict_paths,
 )
 from flashmoe_tpu.planner.select import (  # noqa: F401
-    Selection, resolve_moe_backend, select_path,
+    Selection, resolve_moe_backend, resolve_moe_plan, select_path,
 )

@@ -49,6 +49,10 @@ def main(argv=None) -> int:
     ap.add_argument("--wire-combine", default=None,
                     help="EP payload wire dtype for the combine leg "
                          "(default off — high-precision returns)")
+    ap.add_argument("--chunks", type=int, default=None,
+                    help="price the chunked double-buffered a2a "
+                         "pipeline at this depth "
+                         "(MoEConfig.a2a_chunks; default serial)")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON document instead of tables")
     ap.add_argument("--write-golden", "--regen-golden",
@@ -69,6 +73,8 @@ def main(argv=None) -> int:
     if args.wire or args.wire_combine:
         cfg = cfg.replace(wire_dtype=args.wire,
                           wire_dtype_combine=args.wire_combine)
+    if args.chunks and args.chunks > 1:
+        cfg = cfg.replace(a2a_chunks=args.chunks)
     gens = args.gen or list(GOLDEN_GENS)
 
     doc = {"config": args.config, "d": args.d, "slices": args.slices,
@@ -93,6 +99,8 @@ def main(argv=None) -> int:
         if cfg.wire_dtype or cfg.wire_dtype_combine:
             wire_tag = (f" wire={cfg.wire_dtype or 'off'}/"
                         f"{cfg.wire_dtype_combine or 'off'}")
+        if cfg.a2a_chunks:
+            wire_tag += f" chunks={cfg.a2a_chunks}"
         print(f"\n# {args.config}: E={cfg.num_experts} "
               f"k={cfg.expert_top_k} H={cfg.hidden_size} "
               f"I={cfg.intermediate_size} S={cfg.tokens} "

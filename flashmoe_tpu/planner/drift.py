@@ -116,6 +116,71 @@ def record_drift(cfg: MoEConfig, path: str, measured_ms: float, *,
     return rec
 
 
+@dataclasses.dataclass(frozen=True)
+class OverlapDriftRecord:
+    """One predicted-vs-measured overlap-fraction comparison (the
+    chunked-pipeline validation loop, ``bench.py --overlap``)."""
+
+    path: str
+    gen: str
+    d: int
+    chunks: int
+    predicted_fraction: float
+    measured_fraction: float
+    rel_error: float            # measured / predicted - 1 (signed)
+    threshold: float
+    exceeded: bool
+
+
+def record_overlap_drift(path: str, measured_fraction: float, *,
+                         predicted_fraction: float, gen: str, d: int,
+                         chunks: int = 1,
+                         threshold: float | None = None,
+                         warn: bool = True) -> OverlapDriftRecord:
+    """Compare a measured overlap efficiency (``measure_overlap``)
+    against the analytic bound for the same schedule
+    (``overlap.chunked_overlap_bound`` for the chunked XLA pipeline,
+    ``overlap.overlap_bound`` for the fused kernel).
+
+    Same contract as :func:`record_drift`, on the dimensionless overlap
+    fraction: a ``planner.overlap_drift`` telemetry decision, an
+    ``planner.overlap_drift_abs_rel_error`` histogram observation, and
+    a RuntimeWarning past the threshold — a chunked schedule whose
+    measured hiding falls far short of the priced hiding means the
+    pipeline model (or the chunk pick it drives) is stale for this
+    shape."""
+    if predicted_fraction <= 0:
+        raise ValueError(
+            f"predicted_fraction must be > 0, got {predicted_fraction}")
+    threshold = drift_threshold() if threshold is None else threshold
+    rel = measured_fraction / predicted_fraction - 1.0
+    exceeded = abs(rel) > threshold
+    rec = OverlapDriftRecord(
+        path=path, gen=gen, d=int(d), chunks=int(chunks),
+        predicted_fraction=float(predicted_fraction),
+        measured_fraction=float(measured_fraction),
+        rel_error=float(rel), threshold=float(threshold),
+        exceeded=exceeded)
+    metrics.decision(
+        "planner.overlap_drift", path=path, gen=gen, d=int(d),
+        chunks=int(chunks),
+        predicted_fraction=round(float(predicted_fraction), 4),
+        measured_fraction=round(float(measured_fraction), 4),
+        rel_error=round(float(rel), 4), threshold=float(threshold),
+        exceeded=exceeded)
+    metrics.histogram("planner.overlap_drift_abs_rel_error", abs(rel))
+    if exceeded and warn:
+        warnings.warn(
+            f"overlap-fraction drift on {path!r} (gen={gen}, d={d}, "
+            f"chunks={chunks}): measured {measured_fraction:.3f} vs "
+            f"predicted {predicted_fraction:.3f} ({rel:+.0%}, threshold "
+            f"±{threshold:.0%}) — the chunked-pipeline model may be "
+            f"stale for this shape; re-sweep a2a_chunks on hardware "
+            f"(tuning_data README) or recalibrate with a measured "
+            f"mxu_fraction", RuntimeWarning, stacklevel=2)
+    return rec
+
+
 def _as_drift_fields(rec: dict) -> dict | None:
     """Normalize a JSONL record to drift fields, or None.
 

@@ -2,13 +2,16 @@
 
 ``golden.json`` (committed next to this module) freezes the planner's
 predicted per-path latencies and winners on the canonical configs at
-d=8 across every supported generation AND every golden wire-dtype
-variant (EP payload compression off / fp8 — the knob dimension added
-with ``MoEConfig.wire_dtype``).  ``tests/test_planner.py`` recomputes
-and compares: any change to the cost model, the kernels' schedule
-resolution, or the spec tables that moves a prediction by more than
-the tolerance — or flips a predicted winner — fails CI and must be
-re-approved by regenerating the table
+d=8 across every supported generation AND every golden knob variant:
+the wire-dtype dimension (EP payload compression off / fp8,
+``MoEConfig.wire_dtype``) crossed with the chunked-pipeline dimension
+(serial / 4-chunk double-buffered a2a, ``MoEConfig.a2a_chunks`` —
+chunk variants whose count does not divide the config's local-expert
+axis are skipped, e.g. mixtral's nLx=1 at d=8).
+``tests/test_planner.py`` recomputes and compares: any change to the
+cost model, the kernels' schedule resolution, or the spec tables that
+moves a prediction by more than the tolerance — or flips a predicted
+winner — fails CI and must be re-approved by regenerating the table
 (``python -m flashmoe_tpu.planner --regen-golden``) in the same PR, so
 the diff shows exactly which numbers moved.
 """
@@ -29,11 +32,29 @@ GOLDEN_D = 8
 # wire (dispatch leg e4m3, combine leg high-precision — the recommended
 # production split, docs/PERF.md).  Keyed by the canonical wire tag.
 GOLDEN_WIRES = {"off": {}, "e4m3": {"wire_dtype": "e4m3"}}
+# the chunked-pipeline dimension (MoEConfig.a2a_chunks): the serial
+# schedule and the 4-chunk double-buffered pipeline.  Keyed by the
+# chunk tag; variants whose count does not divide a config's
+# local-expert axis at GOLDEN_D are skipped for that config
+# (golden_chunk_variants).
+GOLDEN_CHUNKS = {"serial": {}, "c4": {"a2a_chunks": 4}}
 # relative tolerance of the CI gate: generous enough for float noise,
 # far below any modeling change worth reviewing
 GOLDEN_RTOL = 1e-3
 
 _TERMS = ("compute_ms", "hbm_ms", "ici_ms", "dcn_ms", "total_ms")
+
+
+def golden_chunk_variants(cfg) -> dict:
+    """The GOLDEN_CHUNKS variants this config can run at GOLDEN_D: a
+    chunk count must divide the local-expert axis (and the config's
+    own ep-local axis, so ``cfg.replace`` constructs)."""
+    nlx_d = cfg.num_experts // GOLDEN_D
+    nlx_cfg = cfg.num_experts // max(cfg.ep, 1)
+    return {cname: knobs for cname, knobs in GOLDEN_CHUNKS.items()
+            if not knobs
+            or (nlx_d and nlx_d % knobs["a2a_chunks"] == 0
+                and nlx_cfg % knobs["a2a_chunks"] == 0)}
 
 
 def golden_snapshot() -> dict:
@@ -46,19 +67,24 @@ def golden_snapshot() -> dict:
         gens = {}
         for gen in GOLDEN_GENS:
             wires = {}
-            for wname, knobs in GOLDEN_WIRES.items():
-                preds = predict_paths(cfg.replace(**knobs), GOLDEN_D, gen)
-                winner = next(p for p in preds if p.feasible)
-                wires[wname] = {
-                    "winner": winner.path,
-                    "backend": winner.backend,
-                    "paths": {
-                        p.path: dict(
-                            {t: round(getattr(p, t), 6) for t in _TERMS},
-                            feasible=p.feasible)
-                        for p in preds
-                    },
-                }
+            for wname, wknobs in GOLDEN_WIRES.items():
+                chunks = {}
+                for cname, cknobs in golden_chunk_variants(cfg).items():
+                    preds = predict_paths(
+                        cfg.replace(**wknobs, **cknobs), GOLDEN_D, gen)
+                    winner = next(p for p in preds if p.feasible)
+                    chunks[cname] = {
+                        "winner": winner.path,
+                        "backend": winner.backend,
+                        "paths": {
+                            p.path: dict(
+                                {t: round(getattr(p, t), 6)
+                                 for t in _TERMS},
+                                feasible=p.feasible)
+                            for p in preds
+                        },
+                    }
+                wires[wname] = chunks
             gens[gen] = wires
         out["configs"][name] = gens
     return out

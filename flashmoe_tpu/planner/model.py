@@ -38,12 +38,19 @@ ranks, uniform routing):
                per slice pair for the hierarchical path).
   serial_ms    chip_ms + ici_ms + dcn_ms — the no-overlap makespan.
   total_ms     the overlap-adjusted prediction:
-               * collective / ragged / hierarchical: = serial_ms.  The
-                 dispatch exchange must land before the FFN and the
-                 return exchange starts after it, so within one layer
-                 XLA cannot hide either leg (its latency-hiding
-                 scheduler overlaps across surrounding ops, which this
-                 per-layer model conservatively ignores);
+               * collective / ragged / hierarchical, serial schedule
+                 (``a2a_chunks`` off): = serial_ms.  The dispatch
+                 exchange must land before the FFN and the return
+                 exchange starts after it, so within one layer XLA
+                 cannot hide either leg (its latency-hiding scheduler
+                 overlaps across surrounding ops, which this per-layer
+                 model conservatively ignores);
+               * same paths with ``MoEConfig.a2a_chunks = n``: the
+                 chunked-pipeline makespan
+                 (``analysis.chunked_pipeline_ms``) — chunk k's FFN
+                 hides chunk k+1's exchange on both legs, at the price
+                 of n per-peer message alphas per leg
+                 (``a2a_transport_cost(chunks=n)``);
                * fused[schedule]: the kernel's arrival overlap, the
                  same makespan shapes as ``overlap.overlap_bound`` with
                  chip_ms in place of pure compute —
@@ -65,7 +72,9 @@ import dataclasses
 
 import jax.numpy as jnp
 
-from flashmoe_tpu.analysis import PathCost, a2a_transport_cost, path_costs
+from flashmoe_tpu.analysis import (
+    PathCost, a2a_transport_cost, chunked_pipeline_ms, path_costs,
+)
 from flashmoe_tpu.config import MoEConfig
 
 # planner path name -> the moe_backend string that runs it
@@ -103,6 +112,10 @@ class PathPrediction:
     cost: PathCost             # the byte decomposition priced
     wire: str = "off/off"      # wire dtypes priced (dispatch/combine
                                # legs, canonical names; "off/off" = raw)
+    a2a_chunks: int = 1        # chunked-pipeline depth priced (XLA
+                               # transports; 1 = serial schedule; the
+                               # fused rows always carry 1 — their
+                               # in-kernel transport ignores the knob)
 
     @property
     def family(self) -> str:
@@ -182,9 +195,15 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
     wire_tag = (f"{wr.canonical_name(cfg.wire_dtype)}/"
                 f"{wr.canonical_name(cfg.wire_dtype_combine)}")
     wire_on = wire_tag != "off/off"
+    n_chunks = cfg.a2a_chunks or 1
+    if n_chunks > 1 and d > 1 and (cfg.num_experts // d) % n_chunks:
+        raise ValueError(
+            f"a2a_chunks={n_chunks} does not divide the local-expert "
+            f"axis (num_experts={cfg.num_experts} // d={d} = "
+            f"{cfg.num_experts // d})")
 
     def mk(path, cost, ici_ms, dcn_ms, total_ms=None, schedule=None,
-           feasible=True, note="", wire="off/off"):
+           feasible=True, note="", wire="off/off", chunks=1):
         compute_ms = cost.flops / (peak_fs * mxu_fraction) * 1e3
         hbm_ms = cost.total_bytes / hbm_bs * 1e3
         chip_ms = max(compute_ms, hbm_ms)
@@ -194,7 +213,8 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
             compute_ms=compute_ms, hbm_ms=hbm_ms, ici_ms=ici_ms,
             dcn_ms=dcn_ms, serial_ms=serial_ms,
             total_ms=serial_ms if total_ms is None else total_ms,
-            feasible=feasible, note=note, cost=cost, wire=wire))
+            feasible=feasible, note=note, cost=cost, wire=wire,
+            a2a_chunks=chunks))
         return rows[-1]
 
     if d == 1:
@@ -210,49 +230,62 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
 
     inner = d // slices
 
-    def two_leg(slab_by_leg, kind):
-        """(ici_ms, dcn_ms) of both exchange legs, each serialized at
-        its own wire row size — identical to the old symmetric 2x form
-        when both legs share a size (wire off)."""
-        ici = dcn = 0.0
-        for slab in slab_by_leg:
-            if slices > 1:
-                t = a2a_transport_cost(d, inner, slab, gen=gen,
-                                       links=links)[kind]
-                ici += t["ici_ms"]
-                dcn += t["dcn_ms"]
-            else:
-                ici += (d - 1) * (a_ici + slab / (bw_link * links))
-        return ici, dcn
+    def one_leg(slab, kind):
+        """(ici_ms, dcn_ms) of ONE exchange leg at its wire row size,
+        per-message alpha multiplied by the chunk count
+        (``analysis.a2a_transport_cost``)."""
+        if slices > 1:
+            t = a2a_transport_cost(d, inner, slab, gen=gen,
+                                   links=links, chunks=n_chunks)[kind]
+            return t["ici_ms"], t["dcn_ms"]
+        return (d - 1) * (n_chunks * a_ici
+                          + slab / (bw_link * links)), 0.0
+
+    def xla_row(path, cost, slab_by_leg, kind, note):
+        """One XLA-transport row: legs priced separately (each at its
+        own wire row size and chunked alpha), summed for the ici/dcn
+        report; with a2a_chunks > 1 the overlap-adjusted total is the
+        chunked-pipeline makespan (``analysis.chunked_pipeline_ms``)
+        instead of the serial sum — chunk k's FFN hides chunk k+1's
+        exchange on both legs."""
+        legs = [one_leg(slab, kind) for slab in slab_by_leg]
+        ici = sum(l[0] for l in legs)
+        dcn = sum(l[1] for l in legs)
+        total = None
+        if n_chunks > 1:
+            compute_ms = cost.flops / (peak_fs * mxu_fraction) * 1e3
+            chip_ms = max(compute_ms, cost.total_bytes / hbm_bs * 1e3)
+            total = chunked_pipeline_ms(chip_ms, sum(legs[0]),
+                                        sum(legs[1]), n_chunks)
+            note += f" [chunked a2a x{n_chunks} pipeline]"
+        mk(path, cost, ici, dcn, total_ms=total, wire=wire_tag,
+           note=note, chunks=n_chunks)
 
     slab_legs = [_slab_bytes(cfg, d, leg="dispatch"),
                  _slab_bytes(cfg, d, leg="combine")]
     wire_note = f" [wire {wire_tag}]" if wire_on else ""
 
     # --- collective EP: capacity slabs, flat all_to_all ---------------
-    ici, dcn = two_leg(slab_legs, "flat")
-    mk("collective", path_costs(cfg, "explicit", d_world=d), ici, dcn,
-       wire=wire_tag,
-       note="serialized a2a (XLA cannot hide it within the layer)"
-            + wire_note)
+    coll_note = ("capacity slabs" if n_chunks > 1 else
+                 "serialized a2a (XLA cannot hide it within the layer)")
+    xla_row("collective", path_costs(cfg, "explicit", d_world=d),
+            slab_legs, "flat", coll_note + wire_note)
 
     # --- hierarchical two-stage ICI+DCN (multi-slice only) ------------
     if slices > 1:
-        ici, dcn = two_leg(slab_legs, "hierarchical")
-        mk("hierarchical", path_costs(cfg, "explicit", d_world=d),
-           ici, dcn, wire=wire_tag,
-           note="one aggregated DCN message per slice pair" + wire_note)
+        xla_row("hierarchical", path_costs(cfg, "explicit", d_world=d),
+                slab_legs, "hierarchical",
+                "one aggregated DCN message per slice pair" + wire_note)
 
     # --- ragged / dropless EP: routed rows, no capacity padding -------
     from flashmoe_tpu.analysis import wire_row_bytes
 
     rag = path_costs(cfg, "ragged", d_world=d)
     rag_rows = (cfg.tokens // d) * cfg.expert_top_k / d
-    ici, dcn = two_leg([rag_rows * wire_row_bytes(cfg, "dispatch"),
-                        rag_rows * wire_row_bytes(cfg, "combine")],
-                       "flat")
-    mk("ragged", rag, ici, dcn, wire=wire_tag,
-       note="uniform-routing expectation; skew moves more" + wire_note)
+    xla_row("ragged", rag,
+            [rag_rows * wire_row_bytes(cfg, "dispatch"),
+             rag_rows * wire_row_bytes(cfg, "combine")], "flat",
+            "uniform-routing expectation; skew moves more" + wire_note)
 
     # --- fused RDMA: one row per FFN schedule -------------------------
     meta = schedule_metadata(cfg, d)

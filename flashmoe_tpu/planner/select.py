@@ -94,20 +94,27 @@ class Selection:
     measured_ms: float | None   # the winner's measured latency (if any)
     predictions: tuple[PathPrediction, ...]
     measured: dict              # family -> measured ms consulted
+    a2a_chunks: int = 1         # the winner's chunked-pipeline depth
+                                # (1 = serial; >1 only for XLA
+                                # transports when the sweep wins)
+    chunk_sweep: tuple = ()     # ((n, best feasible predicted ms), ...)
+                                # across the candidate chunk counts
 
 
 def _shape_key(cfg: MoEConfig, d: int) -> dict:
-    # wire/wire_combine ride the key so a latency measured with payload
-    # compression on is never applied to an uncompressed run (and vice
-    # versa) — tuning.measured_path_latencies matches them STRICTLY,
-    # with "off" as the implicit default for legacy entries
+    # wire/wire_combine/chunks ride the key so a latency measured with
+    # payload compression (or a chunked pipeline) on is never applied
+    # to a run without it (and vice versa) —
+    # tuning.measured_path_latencies matches them STRICTLY, with
+    # "off" / 1 as the implicit defaults for legacy entries
     from flashmoe_tpu.ops import wire as wr
 
     return dict(h=cfg.hidden_size, i=cfg.intermediate_size,
                 e=cfg.num_experts, k=cfg.expert_top_k, s=cfg.tokens,
                 d=d, dtype=jnp.dtype(cfg.dtype).name,
                 wire=wr.canonical_name(cfg.wire_dtype),
-                wire_combine=wr.canonical_name(cfg.wire_dtype_combine))
+                wire_combine=wr.canonical_name(cfg.wire_dtype_combine),
+                chunks=cfg.a2a_chunks or 1)
 
 
 def _bench_record_latencies(cfg: MoEConfig, d: int) -> dict:
@@ -145,12 +152,16 @@ def _bench_record_latencies(cfg: MoEConfig, d: int) -> dict:
                     continue
                 if int(rec.get("d", 1)) != d:
                     continue
-                # wire knobs are part of the measurement's identity: a
-                # compressed timing never overrides an uncompressed
-                # selection (records without the field are legacy = off)
+                # wire/chunk knobs are part of the measurement's
+                # identity: a compressed or chunk-pipelined timing
+                # never overrides a selection without it (records
+                # without the fields are legacy = off / serial)
                 if (str(rec.get("wire_dtype", "off")),
                         str(rec.get("wire_dtype_combine",
                                     "off"))) != wire_sig:
+                    continue
+                if int(rec.get("a2a_chunks", 1) or 1) != (
+                        cfg.a2a_chunks or 1):
                     continue
                 keep(rec.get("path"), rec.get("value"))
                 keep("xla", rec.get("xla_path_ms"))
@@ -159,51 +170,106 @@ def _bench_record_latencies(cfg: MoEConfig, d: int) -> dict:
     return out
 
 
+#: chunk counts the auto sweep considers (filtered per shape by
+#: local-expert divisibility; 1 = the serial schedule, always present)
+CHUNK_CANDIDATES = (1, 2, 4, 8)
+
+
+def _chunk_candidates(cfg: MoEConfig, d: int) -> list[int]:
+    """Valid ``a2a_chunks`` candidates at (cfg, d): divisors of the
+    local-expert axis at BOTH the queried rank count and the config's
+    own ep (so ``cfg.replace(a2a_chunks=n)`` always constructs)."""
+    if d <= 1 or cfg.num_experts % d:
+        return [1]
+    nlx_d = cfg.num_experts // d
+    nlx_cfg = cfg.num_experts // max(cfg.ep, 1)
+    return [n for n in CHUNK_CANDIDATES
+            if n == 1 or (nlx_d % n == 0 and nlx_cfg % n == 0)]
+
+
 def select_path(cfg: MoEConfig, d: int = 1, gen: str | None = None, *,
                 slices: int = 1, links: int = 4,
                 mxu_fraction: float = 1.0,
                 measured: dict | None = None,
-                record: bool = True) -> Selection:
+                record: bool = True,
+                sweep_chunks: bool = False) -> Selection:
     """Pick the execution path for (cfg, d ranks, gen).
 
     ``measured``: explicit {path_family: ms} overrides (highest
     precedence); the tuning table and ``FLASHMOE_BENCH_RECORDS`` are
     consulted automatically.  ``record=False`` suppresses the telemetry
     decision record (pure queries, e.g. the CLI's golden writer).
-    """
+
+    ``sweep_chunks``: additionally sweep the chunked-pipeline depth
+    (``MoEConfig.a2a_chunks``) over :data:`CHUNK_CANDIDATES` and pick
+    the fastest (path, chunk count) — the ``moe_backend='auto'``
+    resolution uses this; an explicit ``cfg.a2a_chunks`` pins the
+    sweep to that value.  Measurements keep their chunk identity: a
+    timing recorded at chunks=4 only competes inside the chunks=4
+    candidate (tuning/bench ``chunks`` keys)."""
     from flashmoe_tpu import tuning
 
     gen = gen or tuning.generation()
-    preds = predict_paths(cfg, d, gen, slices=slices, links=links,
-                          mxu_fraction=mxu_fraction)
-    feasible = [p for p in preds if p.feasible]
-    if not feasible:
+    if sweep_chunks and cfg.a2a_chunks is None:
+        cands = _chunk_candidates(cfg, d)
+    else:
+        cands = [cfg.a2a_chunks or 1]
+
+    # price every candidate chunk count; measurements are keyed per
+    # candidate (the chunks field rides the shape key)
+    by_n = []
+    for n in cands:
+        cfg_n = (cfg if n == (cfg.a2a_chunks or 1)
+                 else cfg.replace(a2a_chunks=None if n == 1 else n))
+        preds = predict_paths(cfg_n, d, gen, slices=slices, links=links,
+                              mxu_fraction=mxu_fraction)
+        feasible = [p for p in preds if p.feasible]
+        if not feasible:
+            continue
+        pw = min(feasible, key=lambda p: p.total_ms)
+        meas: dict[str, float] = {}
+        meas.update(tuning.measured_path_latencies(
+            gen, **_shape_key(cfg_n, d)))
+        meas.update(_bench_record_latencies(cfg_n, d))
+        if measured:
+            meas.update(measured)
+        runnable = {p.family for p in feasible}
+        usable = {f: ms for f, ms in meas.items() if f in runnable}
+        by_n.append((n, preds, feasible, pw, usable))
+    if not by_n:
         raise ValueError(f"no feasible path at d={d} for this config")
-    pred_win = min(feasible, key=lambda p: p.total_ms)
+    chunk_sweep = tuple((n, round(pw.total_ms, 6))
+                        for n, _, _, pw, _ in by_n)
+    # the predicted winner across candidates (ties -> fewer chunks:
+    # the serial schedule needs no justification)
+    n_win, preds, feasible, pred_win, usable = min(
+        by_n, key=lambda t: (t[3].total_ms, t[0]))
 
-    meas: dict[str, float] = {}
-    meas.update(tuning.measured_path_latencies(gen, **_shape_key(cfg, d)))
-    meas.update(_bench_record_latencies(cfg, d))
-    if measured:
-        meas.update(measured)
-    runnable = {p.family for p in feasible}
-    usable = {f: ms for f, ms in meas.items() if f in runnable}
+    best_meas = None  # (ms, n, family, candidate predictions)
+    for n, preds_n, feasible_n, _, usable_n in by_n:
+        for f, ms in usable_n.items():
+            if best_meas is None or (ms, n) < (best_meas[0],
+                                               best_meas[1]):
+                best_meas = (ms, n, f, preds_n, feasible_n, usable_n)
 
-    if usable:
-        win_family = min(usable, key=usable.get)
-        win_pred = min((p for p in feasible if p.family == win_family),
+    if best_meas is not None:
+        ms, n_m, win_family, preds_m, feasible_m, usable_m = best_meas
+        win_pred = min((p for p in feasible_m
+                        if p.family == win_family),
                        key=lambda p: p.total_ms)
         sel = Selection(
             winner=win_family, backend=win_pred.backend, mode="measured",
             predicted_winner=pred_win.path, predicted_ms=win_pred.total_ms,
-            measured_ms=usable[win_family], predictions=tuple(preds),
-            measured=dict(usable))
+            measured_ms=ms, predictions=tuple(preds_m),
+            measured=dict(usable_m), a2a_chunks=win_pred.a2a_chunks,
+            chunk_sweep=chunk_sweep)
     else:
         sel = Selection(
             winner=pred_win.path, backend=pred_win.backend,
             mode="predicted", predicted_winner=pred_win.path,
             predicted_ms=pred_win.total_ms, measured_ms=None,
-            predictions=tuple(preds), measured={})
+            predictions=tuple(preds), measured={},
+            a2a_chunks=pred_win.a2a_chunks, chunk_sweep=chunk_sweep)
 
     if record:
         metrics.decision(
@@ -214,6 +280,8 @@ def select_path(cfg: MoEConfig, d: int = 1, gen: str | None = None, *,
             measured_ms=(round(sel.measured_ms, 4)
                          if sel.measured_ms is not None else None),
             gen=gen, d=d, slices=slices,
+            a2a_chunks=sel.a2a_chunks,
+            chunk_sweep=[list(t) for t in chunk_sweep],
             config=_shape_key(cfg, d),
             breakdown=[{
                 "path": p.path, "feasible": p.feasible,
@@ -222,18 +290,25 @@ def select_path(cfg: MoEConfig, d: int = 1, gen: str | None = None, *,
                 "ici_ms": round(p.ici_ms, 4),
                 "dcn_ms": round(p.dcn_ms, 4),
                 "total_ms": round(p.total_ms, 4),
-            } for p in preds])
+                "a2a_chunks": p.a2a_chunks,
+            } for p in sel.predictions])
     return sel
 
 
 @functools.lru_cache(maxsize=64)
-def _cached_backend(cfg: MoEConfig, d: int, gen: str, slices: int) -> str:
+def _cached_backend(cfg: MoEConfig, d: int, gen: str, slices: int
+                    ) -> tuple[str, int | None]:
+    """(backend, a2a_chunks) plan for one (cfg, d, gen, slices) point
+    — the chunk count is the planner's sweep pick for the XLA
+    transports (``None`` = serial), kept alongside the backend so
+    ``moe_backend='auto'`` resolves both in one cached decision."""
     # constraint filter first: combinations config.py rejects outright
     # never reach the latency comparison
     if cfg.tp > 1:
-        return "collective"
-    sel = select_path(cfg, d, gen, slices=slices)
+        return "collective", cfg.a2a_chunks
+    sel = select_path(cfg, d, gen, slices=slices, sweep_chunks=True)
     backend = sel.backend
+    chunks = sel.a2a_chunks if sel.a2a_chunks > 1 else None
     if backend in _FAILED_BACKENDS:
         # path fallback: the predicted winner already failed in this
         # process; demote to the fastest feasible prediction on a
@@ -248,6 +323,8 @@ def _cached_backend(cfg: MoEConfig, d: int, gen: str, slices: int) -> str:
             winner=(alt.path if alt is not None else "collective"),
             phase="resolve", d=d, gen=gen)
         backend = new_backend
+        chunks = (alt.a2a_chunks if alt is not None
+                  and alt.a2a_chunks > 1 else None)
     if backend == "ragged" and cfg.num_shared_experts:
         # the ragged layer cannot host shared experts; the demotion is
         # its own telemetry record so the path_select breakdown never
@@ -259,26 +336,31 @@ def _cached_backend(cfg: MoEConfig, d: int, gen: str, slices: int) -> str:
             reason="shared experts need the collective layer")
     if backend == "local":
         backend = "collective"
-    return backend
+    if backend == "fused":
+        chunks = None  # the in-kernel transport ignores the knob
+    return backend, chunks
 
 
-def resolve_moe_backend(cfg: MoEConfig, mesh=None) -> str:
-    """The moe_backend an ``moe_backend='auto'`` config should run.
+def resolve_moe_plan(cfg: MoEConfig, mesh=None) -> tuple[str, int | None]:
+    """(moe_backend, a2a_chunks) an ``moe_backend='auto'`` config
+    should run.
 
-    Non-auto configs pass through untouched.  Auto consults the planner
-    at this mesh's ep width, the trace-time generation pin
+    Non-auto configs pass through untouched (their own
+    ``cfg.a2a_chunks`` stands).  Auto consults the planner at this
+    mesh's ep width, the trace-time generation pin
     (:func:`flashmoe_tpu.tuning.generation` — never touches a possibly
-    wedged backend), and the detected slice structure.  Results are
-    cached per (cfg, d, gen, slices); the decision itself is recorded
-    in telemetry once per cache fill.
+    wedged backend), and the detected slice structure; the chunked-
+    pipeline depth is swept alongside the path.  Results are cached per
+    (cfg, d, gen, slices); the decision itself is recorded in telemetry
+    once per cache fill.
     """
     if cfg.moe_backend != "auto":
-        return cfg.moe_backend
+        return cfg.moe_backend, cfg.a2a_chunks
     from flashmoe_tpu import tuning
 
     d = int(mesh.shape.get("ep", cfg.ep)) if mesh is not None else cfg.ep
     if d <= 1:
-        return "collective"
+        return "collective", None
     slices = 1
     try:
         from flashmoe_tpu.parallel.topology import slice_structure
@@ -289,3 +371,9 @@ def resolve_moe_backend(cfg: MoEConfig, mesh=None) -> str:
     except Exception:  # noqa: BLE001 — detection must never block trace
         slices = 1
     return _cached_backend(cfg, d, tuning.generation(), slices)
+
+
+def resolve_moe_backend(cfg: MoEConfig, mesh=None) -> str:
+    """The moe_backend an ``moe_backend='auto'`` config should run —
+    :func:`resolve_moe_plan` without the chunk component."""
+    return resolve_moe_plan(cfg, mesh)[0]

@@ -95,10 +95,12 @@ def measured_path_latencies(gen: str | None = None, **shape) -> dict:
          "measured_ms": 2.71}
 
     The ``wire`` / ``wire_combine`` keys (EP payload compression,
-    ``MoEConfig.wire_dtype``) are matched STRICTLY with an implicit
-    ``"off"`` default on both sides: a latency measured with
-    compression on is never applied to an uncompressed run — and a
-    legacy entry without the keys never applies to a compressed one.
+    ``MoEConfig.wire_dtype``) and the ``chunks`` key (chunked a2a
+    pipeline depth, ``MoEConfig.a2a_chunks``) are matched STRICTLY
+    with implicit ``"off"`` / ``1`` defaults on both sides: a latency
+    measured with compression or chunking on is never applied to a run
+    without it — and a legacy entry without the keys never applies to
+    a compressed/chunked one.
 
     The planner's measured-winner override
     (:mod:`flashmoe_tpu.planner.select`) consults this: a committed
@@ -115,8 +117,9 @@ def measured_path_latencies(gen: str | None = None, **shape) -> dict:
         ms = ent.get("measured_ms", ent.get("set", {}).get("measured_ms"))
         if path is None or ms is None:
             continue
-        if any(str(m.pop(wk, "off")) != str(shape.get(wk, "off"))
-               for wk in ("wire", "wire_combine")):
+        if any(str(m.pop(wk, dv)) != str(shape.get(wk, dv))
+               for wk, dv in (("wire", "off"), ("wire_combine", "off"),
+                              ("chunks", 1))):
             continue
         if all(shape.get(kk) == v for kk, v in m.items()):
             if path not in best or len(m) > best[path][0]:

@@ -214,6 +214,37 @@ def test_degrade_masks_nan_expert_through_fp8_wire(devices):
 
 
 @pytest.mark.slow
+def test_degrade_masks_nan_expert_through_chunked_fp8_pipeline(devices):
+    """Tier-0 masking through the chunked double-buffered pipeline
+    (MoEConfig.a2a_chunks) with fp8 on both legs: the poisoned expert
+    lives in a NON-ZERO chunk of its owner (global expert 5 -> owner
+    rank 2, local row 1, chunk 1 of 2), so the injection's chunk-offset
+    arithmetic (inject.poison_local_expert local_offset/local_total)
+    is exercised, and the NaN crosses the per-chunk fp8 combine wire
+    before the health mask sees it."""
+    from flashmoe_tpu.parallel.ep import ep_moe_layer
+
+    cfg = MoEConfig(num_experts=16, expert_top_k=2, hidden_size=64,
+                    intermediate_size=128, sequence_len=256, ep=8,
+                    a2a_chunks=2, wire_dtype="e4m3",
+                    wire_dtype_combine="e4m3", collect_stats=True,
+                    **F32)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:8])
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (cfg.tokens, 64),
+                          jnp.float32)
+    inject.arm("nan_expert", expert=5)
+    sick_off = ep_moe_layer(params, x, cfg, mesh)
+    assert not bool(np.isfinite(np.asarray(sick_off.out)).all())
+    on = cfg.replace(degrade_unhealthy_experts=True)
+    sick_on = ep_moe_layer(params, x, on, mesh)
+    assert bool(np.isfinite(np.asarray(sick_on.out)).all())
+    # every rank masks exactly its own exposure to the one armed expert
+    assert float(sick_on.stats.masked_experts) == 8.0
+    assert float(sick_on.stats.masked_fraction) > 0.0
+
+
+@pytest.mark.slow
 def test_degrade_ragged_ep_layer(devices):
     from flashmoe_tpu.parallel.ragged_ep import ragged_ep_moe_layer
 

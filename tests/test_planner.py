@@ -49,32 +49,35 @@ def _hermetic(monkeypatch):
 def test_golden_tables_match_model():
     """Recompute every golden prediction and compare: terms within
     GOLDEN_RTOL, winners and feasibility exactly — across every
-    (config, generation, wire-dtype) point."""
+    (config, generation, wire-dtype, chunk-count) point."""
     live, frozen = golden_snapshot(), load_golden()
     assert live["d"] == frozen["d"] == GOLDEN_D
     assert set(live["configs"]) == set(frozen["configs"])
     for cname, gens in frozen["configs"].items():
         for gen, wires in gens.items():
-            for wname, g in wires.items():
-                l = live["configs"][cname][gen][wname]
-                assert l["winner"] == g["winner"], (
-                    f"predicted winner flipped for {cname}@{gen}"
-                    f"[wire={wname}]: {g['winner']} -> {l['winner']}; "
-                    f"if intentional, regenerate with python -m "
-                    f"flashmoe_tpu.planner --regen-golden and justify "
-                    f"in the PR")
-                assert l["backend"] == g["backend"]
-                assert set(l["paths"]) == set(g["paths"])
-                for pname, terms in g["paths"].items():
-                    lt = l["paths"][pname]
-                    assert lt["feasible"] == terms["feasible"], (
-                        cname, gen, wname, pname)
-                    for term, want in terms.items():
-                        if term == "feasible":
-                            continue
-                        assert lt[term] == pytest.approx(
-                            want, rel=GOLDEN_RTOL, abs=1e-9), (
-                            f"{cname}@{gen}[{wname}]/{pname}.{term}")
+            for wname, chunks in wires.items():
+                for chname, g in chunks.items():
+                    l = live["configs"][cname][gen][wname][chname]
+                    assert l["winner"] == g["winner"], (
+                        f"predicted winner flipped for {cname}@{gen}"
+                        f"[wire={wname},chunks={chname}]: "
+                        f"{g['winner']} -> {l['winner']}; "
+                        f"if intentional, regenerate with python -m "
+                        f"flashmoe_tpu.planner --regen-golden and "
+                        f"justify in the PR")
+                    assert l["backend"] == g["backend"]
+                    assert set(l["paths"]) == set(g["paths"])
+                    for pname, terms in g["paths"].items():
+                        lt = l["paths"][pname]
+                        assert lt["feasible"] == terms["feasible"], (
+                            cname, gen, wname, chname, pname)
+                        for term, want in terms.items():
+                            if term == "feasible":
+                                continue
+                            assert lt[term] == pytest.approx(
+                                want, rel=GOLDEN_RTOL, abs=1e-9), (
+                                f"{cname}@{gen}[{wname},{chname}]"
+                                f"/{pname}.{term}")
 
 
 def test_golden_tables_cover_wire_dimension():
@@ -89,14 +92,57 @@ def test_golden_tables_cover_wire_dimension():
     for cname, gens in frozen["configs"].items():
         for gen, wires in gens.items():
             assert set(wires) == set(GOLDEN_WIRES), (cname, gen)
-            off = wires["off"]["paths"]["collective"]
-            on = wires["e4m3"]["paths"]["collective"]
+            off = wires["off"]["serial"]["paths"]["collective"]
+            on = wires["e4m3"]["serial"]["paths"]["collective"]
             assert on["ici_ms"] < off["ici_ms"], (cname, gen)
             assert on["hbm_ms"] < off["hbm_ms"], (cname, gen)
             # the fused rows are disqualified under compression
-            for pname, terms in wires["e4m3"]["paths"].items():
+            for pname, terms in \
+                    wires["e4m3"]["serial"]["paths"].items():
                 if pname.startswith("fused"):
                     assert not terms["feasible"], (cname, gen, pname)
+
+
+def test_golden_tables_cover_chunk_dimension():
+    """CI gate for the chunked-pipeline dimension: every golden
+    (config, gen, wire) point carries exactly the chunk variants the
+    config supports (golden_chunk_variants — mixtral's nLx=1 at d=8
+    cannot chunk), and on the multi-chip golden configs the chunked
+    overlap-adjusted prediction must beat the serial one (the
+    acceptance bar for the schedule's pricing)."""
+    from flashmoe_tpu.config import BENCH_CONFIGS
+    from flashmoe_tpu.planner.golden import (
+        GOLDEN_CHUNKS, golden_chunk_variants,
+    )
+
+    frozen = load_golden()
+    assert set(GOLDEN_CHUNKS) >= {"serial", "c4"}
+    for cname, gens in frozen["configs"].items():
+        want = set(golden_chunk_variants(BENCH_CONFIGS[cname]))
+        for gen, wires in gens.items():
+            for wname, chunks in wires.items():
+                assert set(chunks) == want, (cname, gen, wname)
+                if "c4" not in chunks:
+                    continue
+                ser = chunks["serial"]["paths"]
+                c4 = chunks["c4"]["paths"]
+                for pname in ("collective", "ragged"):
+                    # chunking pays n x alpha on the wire but hides the
+                    # exchange behind the FFN: total drops, ici rises
+                    assert c4[pname]["total_ms"] < \
+                        ser[pname]["total_ms"], (cname, gen, wname,
+                                                 pname)
+                    assert c4[pname]["ici_ms"] > \
+                        ser[pname]["ici_ms"], (cname, gen, wname, pname)
+                # fused rows are chunk-independent: identical pricing
+                for pname, terms in ser.items():
+                    if pname.startswith("fused"):
+                        assert c4[pname] == terms, (cname, gen, wname,
+                                                    pname)
+    # mixtral (nLx=1 at d=8) must be the config that skips c4 — the
+    # skip rule is exercised, not vacuous
+    assert "c4" not in frozen["configs"]["mixtral"]["v5e"]["off"]
+    assert "c4" in frozen["configs"]["reference"]["v5e"]["off"]
 
 
 def test_d8_canonical_breakdown_all_generations():
