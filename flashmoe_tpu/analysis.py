@@ -69,10 +69,8 @@ def wire_row_bytes(cfg: MoEConfig, leg: str = "dispatch") -> float:
         raise ValueError(f"unknown wire leg {leg!r}")
     name = cfg.wire_dtype if leg == "dispatch" else cfg.wire_dtype_combine
     wd = wr.resolve(name)
-    h = cfg.hidden_size
-    if wd is None:
-        return float(h * jnp.dtype(cfg.dtype).itemsize)
-    return float(h * jnp.dtype(wd).itemsize + wr.scale_bytes(wd))
+    return (wr.payload_row_bytes(wd, cfg.hidden_size, cfg.dtype)
+            + wr.scale_bytes(wd))
 
 
 def layer_flops(cfg: MoEConfig, tokens: int | None = None) -> float:
@@ -149,8 +147,8 @@ def _geom(cfg: MoEConfig, d_world: int, fuse_combine: bool = False,
         resolved = schedule
     n_row_tiles = cap_pad // cm
     n_i_chunks = i // bi
-    return dict(s_loc=s_loc, h=h, i=i, dt=dt, cap=cap_pad, cm=cm, bi=bi,
-                gated=gated, schedule=resolved,
+    return dict(s_loc=s_loc, h=h, i=i, dt=dt, cap=cap_pad, cap_raw=cap,
+                cm=cm, bi=bi, gated=gated, schedule=resolved,
                 n_row_tiles=n_row_tiles, n_i_chunks=n_i_chunks)
 
 
@@ -230,8 +228,15 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1,
     if path == "explicit":
         dispatch = s * h * dt + slots * h * dt
         combine = rows * h * dt + s * h * 4
-        # both a2a legs move full capacity slabs (ep._ep_moe_shard)
-        comm = 2 * slots * a2a_row
+        # both a2a legs move full capacity slabs (ep._ep_moe_shard) —
+        # at the layer's UNPADDED capacity: the XLA transport exchanges
+        # the [E, C, H] buffer as-is; only the fused kernel RDMAs
+        # 32-padded slabs.  This term used to charge the padded
+        # capacity, overpricing e.g. deepseek's C=60 exchange by 64/60
+        # — caught by the collective census
+        # (flashmoe_tpu/staticcheck/census.py) reconciling this model
+        # against the planner's slab_bytes and the lowered graph.
+        comm = 2 * (d_world * nlx * g["cap_raw"]) * a2a_row
         return PathCost(path, w_once,
                         gate_bytes + slots * h * dt + slots * h * dt,
                         dispatch, comm, combine, combine, flops)
@@ -349,6 +354,114 @@ def a2a_transport_cost(d: int, inner: int, slab_bytes: float,
     for c in (flat, hier):
         c["total_ms"] = c["dcn_ms"] + c["ici_ms"]
     return {"flat": flat, "hierarchical": hier}
+
+
+def comm_census(cfg: MoEConfig, d: int, path: str) -> dict:
+    """Expected *lowered-graph* collective census of one XLA-transport
+    MoE layer at ``(cfg, d ranks)`` — the statically-checkable
+    counterpart of :func:`path_costs`'s HBM comm model, consumed by
+    :mod:`flashmoe_tpu.staticcheck.census` which reconciles it against
+    the jaxpr the layer actually traces to.
+
+    Two model sources are deliberately combined and cross-checked
+    against each other here: per-leg wire bytes come from the planner's
+    slab accounting (``planner.model.slab_bytes``, the quantity the
+    ici/dcn terms serialize) while the total is asserted against this
+    module's :func:`path_costs` ``comm_bytes`` (the read+write HBM
+    convention: exactly 2x the one-sided wire bytes).  A change that
+    moves one model but not the other — the class of drift that
+    once under-charged the fused_combine table 4x — fails here before
+    any graph is even traced.
+
+    Paths: ``collective`` (flat a2a), ``hierarchical`` (two-stage
+    exchange — each stage moves the full local buffer, so the graph
+    carries 2x the flat leg bytes: the documented staging cost of
+    aggregating DCN messages), ``ragged`` (dense fallback arm — the CPU
+    trace pads every transfer to the worst-case bound, so graph bytes
+    are exactly ``d x chunks`` times the uniform-routing expectation
+    ``path_costs`` prices; the TPU ``ragged_all_to_all`` arm moves the
+    data-dependent exact rows instead).
+
+    Returns per-rank expectations::
+
+        legs          {dispatch: bytes, combine: bytes}  wire payload
+                      + fp8 scale sidecar per leg, as traced
+        a2a_eqns      all_to_all count (payload + sidecar + metadata)
+        gather_eqns   all_gather count (ragged count-matrix machinery)
+        meta_bytes    metadata collective bytes per primitive
+                      (counts/sizes, not token rows)
+        psum_eqns     loss/count reductions (EXPECTED_PSUMS contract)
+        bound_factor  graph-bytes / model-expectation per leg (1 for
+                      the capacity paths; d x chunks for ragged-dense)
+        model_comm_bytes   path_costs(...).comm_bytes, for reference
+    """
+    from flashmoe_tpu.ops import wire as wr
+    from flashmoe_tpu.parallel.ep import EXPECTED_PSUMS
+    from flashmoe_tpu.planner.model import slab_bytes
+
+    if path not in ("collective", "hierarchical", "ragged"):
+        raise ValueError(
+            f"comm_census covers the XLA transports only, not {path!r} "
+            f"(the fused RDMA kernel is a custom call the jaxpr census "
+            f"cannot see into; its traffic is modeled in path_costs)")
+    chunks = cfg.a2a_chunks or 1
+    stages = 2 if path == "hierarchical" else 1
+    wires = {"dispatch": wr.resolve(cfg.wire_dtype),
+             "combine": wr.resolve(cfg.wire_dtype_combine)}
+    cost = path_costs(cfg, "ragged" if path == "ragged" else "explicit",
+                      d_world=d)
+
+    legs: dict[str, float] = {}
+    a2a = 0
+    if path == "ragged":
+        n_assign = (cfg.tokens // d) * cfg.expert_top_k
+        bound_factor = float(d * chunks)
+        for leg, wd in wires.items():
+            legs[leg] = bound_factor * n_assign * (
+                wr.payload_row_bytes(wd, cfg.hidden_size, cfg.dtype)
+                + wr.scale_bytes(wd))
+            a2a += chunks * (1 + (1 if wr.is_fp8(wd) else 0))
+        nlx = cfg.num_experts // d
+        if chunks > 1:
+            # one all_gather of the [dest, nLx] count matrix
+            # (ragged_ep._chunked_ragged_exchange) derives every chunk's
+            # offsets; no metadata a2a
+            gather_eqns, meta_a2a = 1, 0
+            meta_bytes = {"all_gather": float(d * nlx * 4),
+                          "all_to_all": 0.0}
+        else:
+            # serial: all_gather of the [D] send sizes + one
+            # count-matrix a2a (ragged_ep._ragged_ep_shard)
+            gather_eqns, meta_a2a = 1, 1
+            meta_bytes = {"all_gather": float(d * 4),
+                          "all_to_all": float(d * nlx * 4)}
+        a2a += meta_a2a
+    else:
+        bound_factor = 1.0
+        gather_eqns = 0
+        meta_bytes = {"all_gather": 0.0, "all_to_all": 0.0}
+        for leg, wd in wires.items():
+            legs[leg] = stages * d * slab_bytes(cfg, d, leg=leg)
+            a2a += stages * chunks * (1 + (1 if wr.is_fp8(wd) else 0))
+
+    # cross-check the two model sources against each other: the graph
+    # legs must equal the HBM model's one-sided bytes times the
+    # documented structural multipliers
+    want = cost.comm_bytes / 2.0 * stages * bound_factor
+    got = sum(legs.values())
+    if abs(got - want) > 1e-6 * max(want, 1.0):
+        raise AssertionError(
+            f"analysis/planner byte models disagree for {path!r} at "
+            f"d={d}: planner slabs give {got:.1f} B of graph wire "
+            f"bytes, path_costs.comm_bytes implies {want:.1f} B — one "
+            f"model moved without the other")
+    return {
+        "path": path, "chunks": chunks, "stages": stages, "legs": legs,
+        "a2a_eqns": a2a, "gather_eqns": gather_eqns,
+        "meta_bytes": meta_bytes, "psum_eqns": EXPECTED_PSUMS,
+        "bound_factor": bound_factor,
+        "model_comm_bytes": cost.comm_bytes,
+    }
 
 
 def chunked_pipeline_ms(chip_ms: float, dispatch_leg_ms: float,

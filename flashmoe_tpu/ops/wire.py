@@ -123,6 +123,17 @@ def scale_bytes(wire_dtype) -> int:
     return 4 if is_fp8(wire_dtype) else 0
 
 
+def payload_row_bytes(wire_dtype, h: int, compute_dtype) -> float:
+    """Bytes of ONE token row's wire *payload* (scale sidecar excluded):
+    ``H x wire itemsize``, or ``H x compute itemsize`` when the wire is
+    off.  ``analysis.wire_row_bytes`` adds :func:`scale_bytes` on top;
+    the collective census (:mod:`flashmoe_tpu.staticcheck.census`) needs
+    the two terms separately because payload and sidecar ride separate
+    ``all_to_all`` eqns in the lowered graph."""
+    dt = compute_dtype if wire_dtype is None else wire_dtype
+    return float(h * jnp.dtype(dt).itemsize)
+
+
 def encode(x, wire_dtype):
     """Quantize ``x`` (``[..., H]``, rows on the last axis) for the wire.
 

@@ -66,6 +66,16 @@ from flashmoe_tpu.ops.moe import MoEOutput, dense_ffn
 from flashmoe_tpu.utils.telemetry import trace_span
 
 
+#: reduction collectives one EP-layer forward traces to, knobs off: the
+#: aux-loss pmean, the z-loss pmean, and the expert-count psum (pmean
+#: lowers to psum + div).  A contract constant, not documentation:
+#: ``analysis.comm_census`` expects exactly this many psum eqns and the
+#: collective census (:mod:`flashmoe_tpu.staticcheck.census`) fails CI
+#: when the traced graph disagrees — add a reduction, update this, and
+#: the census diff shows the new collective was priced on purpose.
+EXPECTED_PSUMS = 3
+
+
 def local_capacity(cfg: MoEConfig, s_local: int) -> int:
     """Per-(rank, expert) capacity over a local token shard (EC formula of
     ``types.cuh:497-499`` applied shard-locally)."""

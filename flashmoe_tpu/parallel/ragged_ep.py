@@ -49,6 +49,16 @@ from flashmoe_tpu.ops.gate import router
 from flashmoe_tpu.ops.moe import MoEOutput
 
 
+#: metadata collectives the dense-arm layouts trade beyond the payload
+#: exchanges — contract constants the collective census
+#: (``analysis.comm_census`` / :mod:`flashmoe_tpu.staticcheck.census`)
+#: reconciles against the traced graph: the serial schedule gathers the
+#: [D] send sizes and all-to-alls the [D, nLx] count matrix; the chunked
+#: schedule replaces both with ONE all_gather of the count matrix.
+META_COLLECTIVES_SERIAL = {"all_gather": 1, "all_to_all": 1}
+META_COLLECTIVES_CHUNKED = {"all_gather": 1, "all_to_all": 0}
+
+
 def _row_exchange(arr, *, axis: str, d: int, exchange: str,
                   block_rows: int, out_bound: int,
                   send_offsets, send_sizes, remote_offsets,

@@ -142,9 +142,14 @@ def _ici_link(gen: str) -> tuple[float, float]:
     return lat_us / 1e3, gbps * 1e6
 
 
-def _slab_bytes(cfg: MoEConfig, d: int, *, padded: bool = False,
-                leg: str = "dispatch") -> float:
+def slab_bytes(cfg: MoEConfig, d: int, *, padded: bool = False,
+               leg: str = "dispatch") -> float:
     """One (dest-rank) capacity slab: the unit both exchanges move.
+    Public because the collective census
+    (:mod:`flashmoe_tpu.staticcheck.census` via ``analysis.comm_census``)
+    reconciles the lowered graph's all_to_all operand bytes against
+    exactly ``d x slab_bytes`` per exchange leg — the planner's pricing
+    unit is statically checked against what the layer actually sends.
 
     ``padded``: the fused kernel RDMAs capacity padded to a 32-multiple
     (the same padding ``analysis._geom`` prices); the collective layer
@@ -261,8 +266,8 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
         mk(path, cost, ici, dcn, total_ms=total, wire=wire_tag,
            note=note, chunks=n_chunks)
 
-    slab_legs = [_slab_bytes(cfg, d, leg="dispatch"),
-                 _slab_bytes(cfg, d, leg="combine")]
+    slab_legs = [slab_bytes(cfg, d, leg="dispatch"),
+                 slab_bytes(cfg, d, leg="combine")]
     wire_note = f" [wire {wire_tag}]" if wire_on else ""
 
     # --- collective EP: capacity slabs, flat all_to_all ---------------
@@ -291,7 +296,7 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
     meta = schedule_metadata(cfg, d)
     nlx = max(cfg.num_experts // d, 1)
     # the fused kernel RDMAs 32-padded slabs (analysis._geom pricing)
-    pslab = _slab_bytes(cfg, d, padded=True)
+    pslab = slab_bytes(cfg, d, padded=True)
     t_x = (d - 1) * (a_ici + pslab / (bw_link * links))
 
     def fused_total(cost, sched):

@@ -1,0 +1,72 @@
+"""Static-analysis subsystem: prove the knob matrix safe without silicon.
+
+The paper's single-kernel design is safe because one kernel has one code
+path.  This reproduction instead has a combinatorial knob matrix
+(backend x wire_dtype x a2a_chunks x collect_stats x degrade x ...)
+whose safety used to rest on per-PR one-off assertions scattered across
+the test suite — and, with ``tuning_data/`` still empty (every hardware
+bench window hung), comm-cost claims that nothing statically checked
+against the code.  Like Comet's tile-level dependency analysis
+(arXiv 2502.19811) and in the spirit of SonicMoE's IO accounting
+(arXiv 2512.14080), this package verifies structure by *tracing*, never
+executing:
+
+* :mod:`flashmoe_tpu.staticcheck.invariants` — the jaxpr invariant
+  engine: traces every registered (backend, knob) combination of the
+  MoE layer under an abstract mesh and asserts structural invariants
+  (default-off knobs yield the baseline jaxpr, wire off => no fp8
+  dtypes, collect_stats off => no extra collectives, degrade off => no
+  extra health ops, tracer hygiene);
+* :mod:`flashmoe_tpu.staticcheck.census` — the collective census
+  cross-check: counts the collectives (and the bytes they move) in the
+  lowered graph of every golden config variant and reconciles them
+  against ``analysis.comm_census`` / the planner's per-leg slabs — a
+  CI-runnable drift detector between the analytical model and the code;
+* :mod:`flashmoe_tpu.staticcheck.lint` — the AST lint pass: forbidden
+  host-side patterns inside traced code, the central decision-name
+  registry (:mod:`flashmoe_tpu.utils.telemetry`), doc sync, and the
+  generalized slow-mark budget guard migrated from
+  ``tests/test_collection.py``.
+
+CLI: ``python -m flashmoe_tpu.staticcheck --all`` (exits nonzero on any
+violation).  Registration of new knobs/backends/census rows is
+declarative — :mod:`flashmoe_tpu.staticcheck.registry`.
+"""
+
+from flashmoe_tpu.staticcheck.registry import (  # noqa: F401
+    BACKENDS,
+    KNOBS,
+    STRUCTURAL_FIELDS,
+    Violation,
+    check_knob_coverage,
+)
+
+__all__ = [
+    "BACKENDS",
+    "KNOBS",
+    "STRUCTURAL_FIELDS",
+    "Violation",
+    "check_knob_coverage",
+    "run_invariants",
+    "run_census",
+    "run_lint",
+]
+
+
+def run_invariants(**kw):
+    """Lazy re-export (tracing imports jax; keep the lint path light)."""
+    from flashmoe_tpu.staticcheck.invariants import run_invariants as f
+
+    return f(**kw)
+
+
+def run_census(**kw):
+    from flashmoe_tpu.staticcheck.census import run_census as f
+
+    return f(**kw)
+
+
+def run_lint(**kw):
+    from flashmoe_tpu.staticcheck.lint import run_lint as f
+
+    return f(**kw)

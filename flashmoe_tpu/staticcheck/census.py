@@ -1,0 +1,219 @@
+"""Collective census cross-check: reconcile the collectives a lowered
+MoE graph actually contains — counts and operand bytes — against the
+analytical comm model, for every golden planner config and knob variant.
+
+With ``tuning_data/`` still empty (every hardware bench window hung),
+the planner's comm claims are model-derived with nothing checking the
+model against the code.  Real silicon would expose drift as wrong
+timings; this engine exposes it *statically*: trace the layer under an
+abstract mesh (``jax.eval_shape`` parameter shapes — no allocation, no
+execution), walk the jaxpr, and require
+
+* every ``all_to_all`` / ``all_gather`` byte to be explained by
+  ``analysis.comm_census`` (which itself cross-checks the planner's
+  ``slab_bytes`` against ``path_costs.comm_bytes``, so the graph, the
+  planner, and the HBM model must all agree);
+* the eqn *counts* to match the chunk/stage/sidecar structure the
+  planner charges alphas for;
+* no other collective (a ppermute, an extra psum, an unregistered
+  gather) to appear at all — an unpriced collective is a violation, not
+  noise.
+
+Reconciliation rules with documented slack (docs/STATIC_ANALYSIS.md):
+
+* read+write convention: graph bytes are one-sided (what a rank hands
+  the transport); ``path_costs.comm_bytes`` counts read+write — exact
+  factor 2;
+* hierarchical staging: each two-stage exchange moves the full local
+  buffer twice — exact factor 2 per leg vs flat;
+* ragged dense fallback: the CPU arm pads every transfer to the
+  worst-case bound — exact factor ``d x chunks`` vs the uniform-routing
+  expectation the model prices (the TPU ``ragged_all_to_all`` arm moves
+  the data-dependent exact rows instead).
+
+Every factor is exact, so the gate runs at ``rtol=1e-6`` — there is no
+tolerance band for drift to hide in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from flashmoe_tpu.staticcheck import graph as g
+from flashmoe_tpu.staticcheck.registry import Violation
+
+#: relative tolerance of the byte reconciliation: float roundoff only —
+#: every structural factor is exact
+RTOL = 1e-6
+
+#: the census matrix: every golden.json config x wire variant x chunk
+#: variant x XLA transport path (flat / hierarchical / ragged).  Skips
+#: are explicit and reasoned, never silent (mixtral's nLx=1 has no
+#: chunk axis; the ragged layer rejects shared experts at config time).
+CENSUS_PATHS = ("collective", "hierarchical", "ragged")
+CENSUS_D = 8              # golden.GOLDEN_D: the 8-rank virtual mesh
+CENSUS_DCN_INNER = 4      # hierarchical blocking: 2 slices of 4 ranks
+
+
+@dataclasses.dataclass(frozen=True)
+class CensusRow:
+    """One reconciled (config, wire, chunks, path) point."""
+
+    config: str
+    path: str
+    wire: str
+    chunks: str
+    a2a_eqns: int
+    a2a_bytes: float
+    expected_a2a_bytes: float
+    gather_eqns: int
+    psum_eqns: int
+    model_comm_bytes: float     # path_costs read+write HBM model
+    bound_factor: float         # graph/model per-leg ratio (documented)
+    ok: bool
+    note: str = ""
+
+
+def census_matrix():
+    """Yield (config_name, cfg_with_knobs, wire_tag, chunk_tag, path,
+    skip_reason) over the golden matrix.  ``skip_reason`` is non-empty
+    for declared, documented skips."""
+    from flashmoe_tpu.config import BENCH_CONFIGS
+    from flashmoe_tpu.planner.golden import (
+        GOLDEN_CONFIGS, GOLDEN_WIRES, golden_chunk_variants,
+    )
+
+    for name in GOLDEN_CONFIGS:
+        base = BENCH_CONFIGS[name]
+        for wtag, wknobs in GOLDEN_WIRES.items():
+            for ctag, cknobs in golden_chunk_variants(base).items():
+                cfg = base.replace(ep=CENSUS_D, **wknobs, **cknobs)
+                for path in CENSUS_PATHS:
+                    skip = ""
+                    if path == "ragged" and cfg.num_shared_experts:
+                        skip = ("ragged layer rejects shared experts "
+                                "(config.py); collective covers this "
+                                "config")
+                    yield name, cfg, wtag, ctag, path, skip
+
+
+def _trace(cfg, path, devices):
+    from flashmoe_tpu.staticcheck.invariants import trace_backend
+
+    backend = "hierarchical" if path == "hierarchical" else path
+    return trace_backend(
+        backend, cfg, devices,
+        dcn_inner=CENSUS_DCN_INNER if path == "hierarchical" else None)
+
+
+def run_census(configs=None, wires=None, chunks=None, paths=None,
+               devices=None):
+    """Run the census matrix.  Optional ``configs`` / ``wires`` /
+    ``chunks`` / ``paths`` restrict to named subsets (tests plant
+    violations on one cell).  Returns ``(violations, rows)`` — rows
+    include the reconciled numbers for the CLI report."""
+    from flashmoe_tpu.analysis import comm_census
+
+    out: list[Violation] = []
+    rows: list[CensusRow] = []
+    for name, cfg, wtag, ctag, path, skip in census_matrix():
+        if configs and name not in configs:
+            continue
+        if wires and wtag not in wires:
+            continue
+        if chunks and ctag not in chunks:
+            continue
+        if paths and path not in paths:
+            continue
+        subject = f"{name}/{path}/wire={wtag}/chunks={ctag}"
+        if skip:
+            rows.append(CensusRow(name, path, wtag, ctag, 0, 0.0, 0.0,
+                                  0, 0, 0.0, 0.0, True,
+                                  note=f"skipped: {skip}"))
+            continue
+        try:
+            want = comm_census(cfg, CENSUS_D, path)
+        except AssertionError as e:
+            # pre-trace model-vs-model drift (planner slabs moved
+            # without path_costs, or vice versa): report it through
+            # the violations contract so `--all --json` stays a
+            # well-formed document instead of a traceback
+            out.append(Violation("census", "model-cross-check",
+                                 subject, str(e)))
+            rows.append(CensusRow(name, path, wtag, ctag, 0, 0.0, 0.0,
+                                  0, 0, 0.0, 0.0, False,
+                                  note="model cross-check failed"))
+            continue
+        jx = _trace(cfg, path, devices)
+        got = g.collective_census(jx)
+
+        a2a_n, a2a_b = got.pop("all_to_all", (0, 0))
+        gat_n, gat_b = got.pop("all_gather", (0, 0))
+        psum_n, _psum_b = got.pop("psum", (0, 0))
+
+        exp_a2a_b = (sum(want["legs"].values())
+                     + want["meta_bytes"]["all_to_all"])
+        exp_gat_b = want["meta_bytes"]["all_gather"]
+        ok = True
+
+        def flag(rule, detail):
+            nonlocal ok
+            ok = False
+            out.append(Violation("census", rule, subject, detail))
+
+        if a2a_n != want["a2a_eqns"]:
+            flag("a2a-count",
+                 f"traced {a2a_n} all_to_all eqns, model structure "
+                 f"expects {want['a2a_eqns']} (stages x legs x chunks "
+                 f"+ fp8 sidecars + metadata)")
+        if abs(a2a_b - exp_a2a_b) > RTOL * max(exp_a2a_b, 1.0):
+            flag("a2a-bytes",
+                 f"traced {a2a_b:.0f} B of all_to_all operands, the "
+                 f"planner/analysis models price {exp_a2a_b:.0f} B "
+                 f"(x{want['bound_factor']:.0f} documented bound "
+                 f"factor) — an unpriced or mispriced exchange")
+        if gat_n != want["gather_eqns"]:
+            flag("gather-count",
+                 f"traced {gat_n} all_gather eqns, expected "
+                 f"{want['gather_eqns']}")
+        if abs(gat_b - exp_gat_b) > RTOL * max(exp_gat_b, 1.0):
+            flag("gather-bytes",
+                 f"traced {gat_b:.0f} B of all_gather operands, "
+                 f"expected {exp_gat_b:.0f} B")
+        if psum_n != want["psum_eqns"]:
+            flag("psum-count",
+                 f"traced {psum_n} psum eqns, the EP layer contract "
+                 f"(parallel/ep.py EXPECTED_PSUMS) is "
+                 f"{want['psum_eqns']}")
+        for prim, (n, b) in sorted(got.items()):
+            flag("unpriced-collective",
+                 f"{n} {prim} eqn(s) moving {b} B appear in the graph "
+                 f"but no pricing rule covers {prim} on this path")
+
+        rows.append(CensusRow(
+            name, path, wtag, ctag, a2a_n, float(a2a_b),
+            float(exp_a2a_b), gat_n, psum_n,
+            float(want["model_comm_bytes"]), float(want["bound_factor"]),
+            ok))
+    return out, rows
+
+
+def report_table(rows) -> str:
+    """Markdown rendering of the census rows (the CLI report)."""
+    lines = [
+        "| config | path | wire | chunks | a2a eqns | a2a MB (traced) "
+        "| a2a MB (model) | bound | ok |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        if r.note.startswith("skipped"):
+            lines.append(
+                f"| {r.config} | {r.path} | {r.wire} | {r.chunks} | "
+                f"- | - | - | - | {r.note} |")
+            continue
+        lines.append(
+            f"| {r.config} | {r.path} | {r.wire} | {r.chunks} | "
+            f"{r.a2a_eqns} | {r.a2a_bytes / 2**20:.2f} | "
+            f"{r.expected_a2a_bytes / 2**20:.2f} | "
+            f"x{r.bound_factor:.0f} | {'yes' if r.ok else 'NO'} |")
+    return "\n".join(lines)

@@ -1,0 +1,94 @@
+"""Jaxpr-walking utilities shared by the invariant and census engines.
+
+Everything here operates on traced (never executed) jaxprs, descending
+into sub-jaxprs carried by eqn params (shard_map bodies, scan/cond
+branches, custom-vjp closures), so a collective hidden three levels deep
+in a pipeline chunk counts the same as one at top level —
+``tests/test_observe.py`` pioneered the recursion; this module is its
+generalization.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+
+def iter_eqns(jaxpr):
+    """Yield every eqn of ``jaxpr`` and of every nested sub-jaxpr."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (list, tuple)) else (v,)
+            for item in vs:
+                inner = getattr(item, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    yield from iter_eqns(inner)
+                elif hasattr(item, "eqns"):
+                    yield from iter_eqns(item)
+
+
+def prim_counts(jaxpr) -> Counter:
+    """Multiset of primitive names over the whole (nested) jaxpr."""
+    return Counter(eqn.primitive.name for eqn in iter_eqns(jaxpr))
+
+
+#: collective primitives the census accounts for — anything from this
+#: set appearing in a graph must be explained by a pricing rule
+COLLECTIVE_PRIMS = (
+    "all_to_all", "ragged_all_to_all", "all_gather", "psum", "pmean",
+    "ppermute", "psum_scatter", "reduce_scatter",
+)
+
+
+def _eqn_operand_bytes(eqn) -> int:
+    """Total bytes of an eqn's array operands (the payload a collective
+    moves; index/axis params are not operands)."""
+    total = 0
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is not None and hasattr(aval, "size") and \
+                hasattr(aval, "dtype"):
+            total += int(aval.size) * aval.dtype.itemsize
+    return total
+
+
+def collective_census(jaxpr) -> dict:
+    """``{prim_name: (count, operand_bytes)}`` over every collective in
+    the (nested) jaxpr.  Bytes are the operand sizes — what one rank
+    hands the transport, the same per-rank convention
+    ``analysis.comm_census`` prices."""
+    out: dict[str, tuple[int, int]] = {}
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name not in COLLECTIVE_PRIMS:
+            continue
+        c, b = out.get(name, (0, 0))
+        out[name] = (c + 1, b + _eqn_operand_bytes(eqn))
+    return out
+
+
+def dtype_names(jaxpr) -> set:
+    """Every aval dtype name appearing anywhere in the (nested) jaxpr —
+    eqn inputs and outputs, so a cast *to* a dtype counts even when
+    nothing reads the result."""
+    names = set()
+    for eqn in iter_eqns(jaxpr):
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if aval is not None and hasattr(aval, "dtype"):
+                names.add(aval.dtype.name)
+    return names
+
+
+def has_fp8(jaxpr) -> bool:
+    """True when any float8 dtype appears in the graph (the
+    wire-off => fp8-free invariant's subject)."""
+    return any(n.startswith("float8") for n in dtype_names(jaxpr))
+
+
+def jaxpr_text(jaxpr) -> str:
+    """Canonical text rendering used for identity comparison.  Two
+    configs that are equal frozen dataclasses share a jit cache entry by
+    construction; comparing the *text* of independent traces additionally
+    catches trace-time nondeterminism and Python branching leaks."""
+    return str(jaxpr)

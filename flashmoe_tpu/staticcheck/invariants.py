@@ -1,0 +1,322 @@
+"""Jaxpr invariant engine: trace every registered (backend, knob)
+combination under an abstract mesh and assert structural invariants —
+nothing executes, no silicon needed.
+
+One generic engine replaces the per-PR one-off assertions that used to
+live in tests/test_wire.py (wire off => fp8-free), tests/test_chunked.py
+(chunks None == serial), and tests/test_observe.py (stats off => no
+extra collectives):
+
+* **config identity** — every off value of a knob is either the
+  dataclass default (an EQUAL frozen config: one jit cache entry, same
+  executable, bit-identical by construction — the convention every knob
+  PR asserted by hand) or traces to the byte-identical jaxpr (e.g.
+  ``a2a_chunks=1`` vs ``None``);
+* **graph predicates** — wire off => no float8 dtype anywhere in the
+  graph; collect_stats / degrade on => no extra exchange collectives;
+  degrade on => health ops added; chunked => the payload all_to_all
+  count scales exactly with the chunk count;
+* **tracer hygiene** — every on-config is hashable (stable ``jit``
+  cache keys) and round-trips through ``replace``; tracing the same
+  (config, backend) twice yields the identical jaxpr (no trace-time
+  Python branching or nondeterminism leaking into the graph).
+
+Traces use ``jax.eval_shape``-derived parameter shapes, so even
+Mixtral-width configs cost kilobytes, not gigabytes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from flashmoe_tpu.staticcheck import graph as g
+from flashmoe_tpu.staticcheck.registry import (
+    BACKENDS, BACKENDS_BY_NAME, KNOBS, KNOBS_BY_NAME, Violation,
+    check_knob_coverage,
+)
+
+
+def small_config(ep: int = 1, **over):
+    """The invariant matrix's trace point: small enough that a full
+    knob-matrix sweep stays well under the tier-1 budget, shaped so
+    every engine feature (multi-expert routing, chunkable local-expert
+    axis, dropless ragged layout) is exercised.  f32 keeps the fp8-free
+    predicate meaningful on CPU."""
+    import jax.numpy as jnp
+
+    from flashmoe_tpu.config import MoEConfig
+
+    base = dict(num_experts=8, expert_top_k=2, hidden_size=64,
+                intermediate_size=128, sequence_len=64 * max(ep, 1),
+                drop_tokens=False, ep=ep,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+    base.update(over)
+    return MoEConfig(**base)
+
+
+@functools.lru_cache(maxsize=None)
+def _abstract_inputs(cfg):
+    """(param ShapeDtypeStructs, token ShapeDtypeStruct) — abstract, no
+    allocation (cached: the engine traces many knob points of the same
+    shape)."""
+    import jax
+
+    from flashmoe_tpu.models.reference import init_moe_params
+
+    params = jax.eval_shape(
+        lambda k: init_moe_params(k, cfg), jax.random.PRNGKey(0))
+    x = jax.ShapeDtypeStruct((cfg.tokens, cfg.hidden_size), cfg.dtype)
+    return params, x
+
+
+def trace_backend(backend: str, cfg, devices=None, *,
+                  dcn_inner: int | None = None):
+    """Trace one (backend, config) point to a closed jaxpr.
+
+    ``backend`` is a :data:`~flashmoe_tpu.staticcheck.registry.BACKENDS`
+    name; ``devices`` default to ``jax.devices()`` (the CLI forces an
+    8-way virtual CPU mesh, the test suite inherits conftest's).
+    ``dcn_inner`` overrides the hierarchical blocking (census use)."""
+    import jax
+
+    spec = BACKENDS_BY_NAME[backend]
+    params, x = _abstract_inputs(cfg)
+    if backend == "local":
+        from flashmoe_tpu.ops.moe import moe_layer
+
+        return jax.make_jaxpr(
+            lambda p, xx: moe_layer(p, xx, cfg, use_pallas=False).out
+        )(params, x)
+
+    from flashmoe_tpu.parallel.mesh import make_mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    width = max(spec.ep, cfg.ep)  # census traces golden configs at d=8
+    if len(devices) < width:
+        raise RuntimeError(
+            f"staticcheck needs >= {width} devices to trace "
+            f"{backend!r}; run via `python -m flashmoe_tpu.staticcheck` "
+            f"(which forces a virtual 8-device CPU mesh) or set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    mesh = make_mesh(cfg, dp=1, devices=devices[:width])
+    inner = dcn_inner if dcn_inner is not None else spec.dcn_inner
+    if backend in ("collective", "hierarchical"):
+        from flashmoe_tpu.parallel.ep import ep_moe_layer
+
+        return jax.make_jaxpr(
+            lambda p, xx: ep_moe_layer(
+                p, xx, cfg, mesh, dcn_inner=(inner or 0)).out
+        )(params, x)
+    if backend == "ragged":
+        from flashmoe_tpu.parallel.ragged_ep import ragged_ep_moe_layer
+
+        return jax.make_jaxpr(
+            lambda p, xx: ragged_ep_moe_layer(
+                p, xx, cfg, mesh, exchange="dense").out
+        )(params, x)
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+def _exchange_count(jaxpr) -> int:
+    """Data-exchange collectives (the ones a knob must never add)."""
+    pc = g.prim_counts(jaxpr)
+    return (pc.get("all_to_all", 0) + pc.get("ragged_all_to_all", 0)
+            + pc.get("ppermute", 0) + pc.get("all_gather", 0))
+
+
+# ----------------------------------------------------------------------
+# Named predicates (KnobSpec.off_rules / on_rules reference these)
+# ----------------------------------------------------------------------
+
+def _pred_fp8_free(base, on, ctx):
+    # off-rule: runs on the BASELINE trace of each backend — the
+    # generalized "wire off => no f8 anywhere" assertion
+    if g.has_fp8(base):
+        bad = sorted(n for n in g.dtype_names(base)
+                     if n.startswith("float8"))
+        return (f"knob off but the graph carries fp8 dtypes {bad} — "
+                f"compression is leaking outside the wire codec")
+    return None
+
+
+def _pred_fp8_present(base, on, ctx):
+    # on-rule sanity: proves the off-rule has teeth on this backend
+    if not g.has_fp8(on):
+        return "fp8 wire enabled but no float8 dtype in the graph"
+    return None
+
+
+def _pred_no_extra_exchange(base, on, ctx):
+    nb, no = _exchange_count(base), _exchange_count(on)
+    if no != nb:
+        return (f"exchange-collective count changed {nb} -> {no}; this "
+                f"knob must never add (or drop) an exchange")
+    return None
+
+
+def _pred_health_ops_added(base, on, ctx):
+    pb = g.prim_counts(base).get("is_finite", 0)
+    po = g.prim_counts(on).get("is_finite", 0)
+    if po <= pb:
+        return (f"degrade on but is_finite count did not grow "
+                f"({pb} -> {po}); the health mask is not in the graph")
+    return None
+
+
+def _pred_chunked_a2a_count(base, on, ctx):
+    from flashmoe_tpu.ops import wire as wr
+
+    spec: object = ctx["backend_spec"]
+    chunks = ctx["on_cfg"].a2a_chunks or 1
+    fp8_legs = sum(1 for wd in (ctx["on_cfg"].wire_dtype,
+                                ctx["on_cfg"].wire_dtype_combine)
+                   if wr.is_fp8(wr.resolve(wd)))
+    want = spec.stages * (2 + fp8_legs) * chunks + spec.meta_a2a_chunked
+    got = g.prim_counts(on).get("all_to_all", 0)
+    if got != want:
+        return (f"chunked pipeline at n={chunks}: expected {want} "
+                f"all_to_all eqns (stages={spec.stages} x legs x n + "
+                f"meta={spec.meta_a2a_chunked}), traced {got}")
+    return None
+
+
+_PREDICATES = {
+    "fp8_free": _pred_fp8_free,
+    "fp8_present": _pred_fp8_present,
+    "no_extra_exchange": _pred_no_extra_exchange,
+    "health_ops_added": _pred_health_ops_added,
+    "chunked_a2a_count": _pred_chunked_a2a_count,
+}
+
+
+# ----------------------------------------------------------------------
+# Engine
+# ----------------------------------------------------------------------
+
+def run_invariants(knobs=None, backends=None, devices=None,
+                   include_coverage: bool = True) -> list[Violation]:
+    """Run the (backend x knob) invariant matrix.  ``knobs`` /
+    ``backends`` restrict to named subsets (tests plant violations on a
+    single cell); default is the full registered matrix.  Returns the
+    violations (empty = safe)."""
+    import dataclasses as dc
+
+    from flashmoe_tpu.config import MoEConfig
+
+    out: list[Violation] = []
+    if include_coverage:
+        out.extend(check_knob_coverage())
+    knob_specs = [KNOBS_BY_NAME[k] for k in knobs] if knobs else KNOBS
+    if backends:
+        backend_specs = [BACKENDS_BY_NAME[b] for b in backends]
+    else:
+        # only trace baselines a requested knob will actually compare
+        # against (a wire-only run never needs the 'local' trace)
+        needed = {b for k in knob_specs for b in k.backends}
+        backend_specs = [b for b in BACKENDS if b.name in needed]
+    wanted = {b.name for b in backend_specs}
+
+    defaults = {f.name: f.default for f in dc.fields(MoEConfig)}
+
+    # --- baselines: one trace per backend, re-traced for determinism --
+    base_jaxprs: dict[str, object] = {}
+    base_cfgs: dict[str, object] = {}
+    for spec in backend_specs:
+        cfg = small_config(ep=spec.ep)
+        base_cfgs[spec.name] = cfg
+        jx = trace_backend(spec.name, cfg, devices)
+        base_jaxprs[spec.name] = jx
+        jx2 = trace_backend(spec.name, cfg, devices)
+        if g.jaxpr_text(jx) != g.jaxpr_text(jx2):
+            out.append(Violation(
+                "invariants", "trace-determinism", spec.name,
+                "tracing the identical (config, backend) twice yielded "
+                "different jaxprs — trace-time nondeterminism (host "
+                "randomness / time / mutable global) is leaking into "
+                "the graph"))
+
+    for knob in knob_specs:
+        # ---- config identity + hashability (backend-independent) -----
+        if knob.off_values[0] != defaults[knob.name]:
+            out.append(Violation(
+                "invariants", "off-default", knob.name,
+                f"registered off value {knob.off_values[0]!r} is not "
+                f"the dataclass default {defaults[knob.name]!r}"))
+        probe = small_config(ep=1)
+        if probe.replace(**{knob.name: knob.off_values[0]}) != probe or \
+                hash(probe.replace(**{knob.name: knob.off_values[0]})) \
+                != hash(probe):
+            out.append(Violation(
+                "invariants", "config-identity", knob.name,
+                "replace(knob=off) is not an equal/equal-hash frozen "
+                "config — off no longer shares the baseline jit cache "
+                "entry, so bit-identity-by-construction is broken"))
+        try:
+            on_probe = probe.replace(**knob.on)
+            hash(on_probe)
+            if on_probe.replace() != on_probe:
+                raise ValueError("replace() round-trip changed the config")
+        except (TypeError, ValueError) as e:
+            out.append(Violation(
+                "invariants", "static-hygiene", knob.name,
+                f"on-config is not a stable jit static arg: {e}"))
+            continue
+
+        # ---- per-backend traces --------------------------------------
+        for bname in knob.backends:
+            if bname not in wanted:
+                continue
+            spec = BACKENDS_BY_NAME[bname]
+            base_cfg = base_cfgs[bname]
+            base = base_jaxprs[bname]
+
+            # off values beyond the default must trace IDENTICALLY
+            for off in knob.off_values[1:]:
+                jx = trace_backend(
+                    bname, base_cfg.replace(**{knob.name: off}), devices)
+                if g.jaxpr_text(jx) != g.jaxpr_text(base):
+                    out.append(Violation(
+                        "invariants", "off-identity",
+                        f"{bname}.{knob.name}={off!r}",
+                        "off-equivalent value traces to a DIFFERENT "
+                        "jaxpr than the default — Python branching on "
+                        "the knob leaks into the off graph"))
+
+            ctx = {"backend_spec": spec, "base_cfg": base_cfg}
+            for rule in knob.off_rules:
+                detail = _PREDICATES[rule](base, None, ctx)
+                if detail:
+                    out.append(Violation(
+                        "invariants", rule,
+                        f"{bname}.{knob.name}=off", detail))
+
+            try:
+                on_cfg = base_cfg.replace(**knob.on)
+            except ValueError as e:
+                out.append(Violation(
+                    "invariants", "on-trace", f"{bname}.{knob.name}",
+                    f"canonical on point rejected at config time: {e}"))
+                continue
+            on = trace_backend(bname, on_cfg, devices)
+            ctx["on_cfg"] = on_cfg
+            changed = g.jaxpr_text(on) != g.jaxpr_text(base)
+            if knob.changes_graph and not changed:
+                out.append(Violation(
+                    "invariants", "on-changes-graph",
+                    f"{bname}.{knob.name}",
+                    "enabling the knob left the jaxpr identical — the "
+                    "knob is dead on this backend (or the trace ignores "
+                    "it)"))
+            if not knob.changes_graph and changed:
+                out.append(Violation(
+                    "invariants", "on-changes-graph",
+                    f"{bname}.{knob.name}",
+                    "knob is declared graph-neutral here but the jaxpr "
+                    "changed"))
+            for rule in knob.on_rules:
+                detail = _PREDICATES[rule](base, on, ctx)
+                if detail:
+                    out.append(Violation(
+                        "invariants", rule,
+                        f"{bname}.{knob.name}=on", detail))
+    return out

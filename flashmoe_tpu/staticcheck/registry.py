@@ -1,0 +1,199 @@
+"""Declarative registry: which knobs exist, what each guarantees, and
+which backends the invariant engine traces them on.
+
+Adding a knob to :class:`~flashmoe_tpu.config.MoEConfig` REQUIRES adding
+a row here (or classifying the field as structural) — the matrix-
+coverage check (:func:`check_knob_coverage`, CI-gated by
+``tests/test_staticcheck.py``) fails otherwise, so a PR 8+ knob (serving
+paths, row-windowed fused, ...) gets invariant coverage by adding one
+table row, not by writing another one-off jaxpr assertion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One static-analysis finding.  ``engine`` is the subsystem that
+    found it (invariants / census / lint), ``rule`` the check that
+    fired, ``subject`` what it fired on (a knob, a config point, a
+    file:line), ``detail`` the human-readable explanation."""
+
+    engine: str
+    rule: str
+    subject: str
+    detail: str
+
+    def __str__(self) -> str:
+        return f"[{self.engine}:{self.rule}] {self.subject}: {self.detail}"
+
+
+# ----------------------------------------------------------------------
+# Backends the invariant engine traces
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class BackendSpec:
+    """One traceable MoE execution path.
+
+    ``ep``: mesh width the trace needs (1 = single-chip layer);
+    ``dcn_inner``: two-stage exchange blocking (hierarchical only);
+    ``stages``: all_to_all hops per exchange leg (flat 1, hierarchical
+    2 — each stage moves the full local buffer, the staging cost
+    ``analysis.comm_census`` documents); ``meta_a2a_serial`` /
+    ``meta_a2a_chunked``: metadata all_to_alls beyond the payload legs
+    (the ragged layer's count-matrix exchange); ``meta_gather_*``: the
+    same for all_gather."""
+
+    name: str
+    ep: int = 2
+    dcn_inner: int | None = None
+    stages: int = 1
+    meta_a2a_serial: int = 0
+    meta_a2a_chunked: int = 0
+    meta_gather_serial: int = 0
+    meta_gather_chunked: int = 0
+
+
+BACKENDS: tuple[BackendSpec, ...] = (
+    # single-chip dispatch (ops/moe.py) — no exchange, XLA oracle path
+    BackendSpec("local", ep=1),
+    # flat XLA all-to-all EP (parallel/ep.py)
+    BackendSpec("collective", ep=2),
+    # two-stage ICI+DCN exchange (parallel/ep.py _hierarchical_a2a)
+    BackendSpec("hierarchical", ep=4, dcn_inner=2, stages=2),
+    # dropless ragged EP, dense fallback arm (parallel/ragged_ep.py):
+    # serial trades one [D,D] size gather + one count-matrix a2a;
+    # chunked derives everything from one [D, D, nLx] gather
+    BackendSpec("ragged", ep=2, meta_a2a_serial=1, meta_gather_serial=1,
+                meta_gather_chunked=1),
+)
+
+BACKENDS_BY_NAME = {b.name: b for b in BACKENDS}
+
+
+# ----------------------------------------------------------------------
+# Knobs and their invariants
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class KnobSpec:
+    """One behavior knob of :class:`MoEConfig` and its guarantees.
+
+    ``off_values``: every value equivalent to "off" — the first must be
+    the dataclass default (config-identity check: ``replace`` with it is
+    an EQUAL frozen dataclass, one jit cache entry, bit-identical by
+    construction); every further value must trace to the IDENTICAL
+    jaxpr (e.g. ``a2a_chunks=1`` is the serial schedule, ``gather_fused
+    =False`` the env-off default).  ``on``: the canonical enabled point
+    the on-trace uses.  ``off_rules`` / ``on_rules``: named predicates
+    (:mod:`flashmoe_tpu.staticcheck.invariants`) run on the baseline
+    trace / the on-trace vs baseline.  ``changes_graph``: whether the
+    on point must alter the traced graph at all (``gather_fused`` is a
+    kernel-entry selector that leaves the XLA oracle path untouched)."""
+
+    name: str
+    off_values: tuple
+    on: Any  # mapping of config overrides for the canonical on point
+    backends: tuple = ("local", "collective", "hierarchical", "ragged")
+    changes_graph: bool = True
+    off_rules: tuple = ()
+    on_rules: tuple = ()
+    doc: str = ""
+
+
+KNOBS: tuple[KnobSpec, ...] = (
+    KnobSpec(
+        "wire_dtype", off_values=(None,), on={"wire_dtype": "e4m3"},
+        backends=("collective", "hierarchical", "ragged"),
+        off_rules=("fp8_free",), on_rules=("fp8_present",),
+        doc="EP dispatch-leg payload compression (ops/wire.py); off = "
+            "bit-identical, fp8-free graph"),
+    KnobSpec(
+        "wire_dtype_combine", off_values=(None,),
+        on={"wire_dtype_combine": "e5m2"},
+        backends=("collective", "hierarchical", "ragged"),
+        off_rules=("fp8_free",), on_rules=("fp8_present",),
+        doc="EP combine-leg payload compression; off = bit-identical, "
+            "fp8-free graph"),
+    KnobSpec(
+        "a2a_chunks", off_values=(None, 1), on={"a2a_chunks": 2},
+        backends=("collective", "hierarchical", "ragged"),
+        on_rules=("chunked_a2a_count",),
+        doc="chunked double-buffered EP pipeline; None and 1 are both "
+            "the serial schedule (identical jaxpr)"),
+    KnobSpec(
+        "collect_stats", off_values=(False,), on={"collect_stats": True},
+        on_rules=("no_extra_exchange",),
+        doc="in-graph MoEStats; off = bit-identical, on adds reductions "
+            "but never an exchange"),
+    KnobSpec(
+        "degrade_unhealthy_experts", off_values=(False,),
+        on={"degrade_unhealthy_experts": True},
+        on_rules=("health_ops_added", "no_extra_exchange"),
+        doc="tier-0 expert-health masking; off = bit-identical (no "
+            "extra is_finite beyond the router's logsumexp), on is "
+            "jnp.where-only — no collectives"),
+    KnobSpec(
+        "gather_fused", off_values=(None, False), on={"gather_fused": True},
+        backends=("local",), changes_graph=False,
+        doc="inference kernel-entry selector; on the XLA oracle path "
+            "(use_pallas=False) every value traces to the identical "
+            "graph — the knob only swaps Pallas kernel entries"),
+)
+
+KNOBS_BY_NAME = {k.name: k for k in KNOBS}
+
+#: fields that select among registered execution paths rather than
+#: toggling graph content; their safety story is config-time validation
+#: (config.py __post_init__) + planner selection tests
+SELECTOR_FIELDS = {
+    "moe_backend": "execution-path selector (collective / fused / "
+                   "ragged / auto); invalid combinations rejected at "
+                   "config time, auto resolution covered by "
+                   "tests/test_planner.py",
+}
+
+#: model/job *shape* fields: changing one changes the problem, not a
+#: default-off code path, so no identity invariant applies
+STRUCTURAL_FIELDS = frozenset({
+    "num_experts", "expert_top_k", "hidden_size", "intermediate_size",
+    "sequence_len", "mini_batch", "global_batch", "capacity_factor",
+    "drop_tokens", "is_training", "hidden_act",
+    "num_layers", "moe_frequency", "vocab_size",
+    "num_shared_experts", "num_heads", "num_kv_heads", "head_dim",
+    "gated_ffn", "router_jitter", "aux_loss_coef", "router_z_loss_coef",
+    "rope_theta",
+    "dtype", "param_dtype", "accum_dtype",
+    "dp", "ep", "tp", "sp", "pp",
+})
+
+
+def check_knob_coverage(field_names=None) -> list[Violation]:
+    """Every MoEConfig field must be classified: structural, selector,
+    or a registered knob.  ``field_names`` defaults to the live
+    dataclass — tests pass a synthetic list to prove an unclassified
+    knob fails the matrix."""
+    if field_names is None:
+        from flashmoe_tpu.config import MoEConfig
+
+        field_names = [f.name for f in dataclasses.fields(MoEConfig)]
+    known = STRUCTURAL_FIELDS | set(SELECTOR_FIELDS) | set(KNOBS_BY_NAME)
+    out = []
+    for name in field_names:
+        if name not in known:
+            out.append(Violation(
+                "invariants", "knob-coverage", name,
+                "MoEConfig field has no registered invariant: add a "
+                "KnobSpec row (or classify it in STRUCTURAL_FIELDS / "
+                "SELECTOR_FIELDS) in staticcheck/registry.py"))
+    for name in sorted((set(KNOBS_BY_NAME) | set(SELECTOR_FIELDS))
+                       - set(field_names)):
+        out.append(Violation(
+            "invariants", "knob-coverage", name,
+            "registered knob is not a MoEConfig field (stale registry "
+            "row?)"))
+    return out

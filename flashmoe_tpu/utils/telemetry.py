@@ -31,9 +31,63 @@ import math
 import os
 import re
 import time
+import warnings
 from collections import defaultdict, deque
 
 import jax
+
+#: Central decision-name registry.  Every ``Metrics.decision("x.y",
+#: ...)`` literal in the codebase must be declared here with a one-line
+#: meaning — a typo'd name used to vanish silently into the JSONL
+#: stream.  Enforced two ways: :meth:`Metrics.decision` warns (and
+#: counts ``decision.unregistered``) at runtime, and the static lint
+#: pass (``python -m flashmoe_tpu.staticcheck --lint``) fails CI on any
+#: unregistered literal.  The table in docs/OBSERVABILITY.md is
+#: generated from this dict (:func:`decision_table_markdown`) and the
+#: lint's doc-sync rule keeps the two aligned.
+DECISION_NAMES: dict[str, str] = {
+    "checkpoint.async_error":
+        "a background async save failed (surfaced, not raised)",
+    "checkpoint.emergency_save":
+        "last good state persisted on an abort path",
+    "checkpoint.fallback":
+        "restore demoted a corrupt step to an older intact one",
+    "planner.backend_constraint":
+        "auto pick demoted to a backend the config can actually run",
+    "planner.drift":
+        "measured latency compared against the analytical prediction",
+    "planner.fallback":
+        "a failed execution path was demoted for the process",
+    "planner.overlap_drift":
+        "measured overlap fraction compared against the chunked bound",
+    "planner.path_select":
+        "moe_backend='auto' resolved a path (full latency breakdown)",
+    "preempt.drain":
+        "graceful drain completed: final step, remaining grace",
+    "preempt.notice":
+        "a preemption notice arrived (signal source, grace budget)",
+    "supervisor.resume":
+        "a restart resumed: incarnation, step, world size, ep x dp",
+    "trainer.grad_skip":
+        "tier 1 skipped an anomalous update in-graph",
+}
+
+
+def register_decision(name: str, meaning: str) -> None:
+    """Declare a decision name at runtime (plugins / experiments).
+    Repo code should add to :data:`DECISION_NAMES` directly so the
+    static lint and the docs table see it."""
+    DECISION_NAMES[name] = meaning
+
+
+def decision_table_markdown() -> str:
+    """The docs/OBSERVABILITY.md decision table, generated from the
+    registry (single source of truth; the staticcheck doc-sync rule
+    verifies the doc carries every name)."""
+    lines = ["| decision | meaning |", "|----------|---------|"]
+    for name in sorted(DECISION_NAMES):
+        lines.append(f"| `{name}` | {DECISION_NAMES[name]} |")
+    return "\n".join(lines)
 
 
 @contextlib.contextmanager
@@ -199,7 +253,19 @@ class Metrics:
         """Record a structured decision (e.g. the planner's path choice
         with its full latency breakdown).  Kept as a list so repeated
         decisions (one per layer/config) are all visible; ``summary()``
-        reports the count per decision name."""
+        reports the count per decision name.
+
+        Unregistered names (not in :data:`DECISION_NAMES`) are recorded
+        anyway — losing the record would be worse — but warn and count
+        ``decision.unregistered``, so a typo is visible instead of
+        silently forking the JSONL stream."""
+        if name not in DECISION_NAMES:
+            self.counters["decision.unregistered"] += 1
+            warnings.warn(
+                f"unregistered decision name {name!r}: declare it in "
+                f"flashmoe_tpu/utils/telemetry.py:DECISION_NAMES (the "
+                f"staticcheck lint gates literals in-repo)",
+                RuntimeWarning, stacklevel=2)
         rec = {"decision": name, **fields}
         self.decisions.append(rec)
         self.counters[f"decision.{name}"] += 1

@@ -55,9 +55,6 @@ def test_config_validates_chunk_counts():
     # valid counts construct and stay hashable (jit static args)
     hash(MoEConfig(num_experts=8, ep=2, a2a_chunks=4, **F32))
     hash(MoEConfig(num_experts=8, ep=2, a2a_chunks=1, **F32))
-    # default None == serial: equal frozen dataclasses, one jit entry
-    cfg = MoEConfig(**F32)
-    assert cfg.replace(a2a_chunks=None) == cfg
 
 
 # ----------------------------------------------------------------------
@@ -76,17 +73,22 @@ def _setup(ep=2, **over):
     return cfg, params, x
 
 
-def test_ep_chunked_bit_identical_flat(devices):
-    """The chunked pipeline re-orders the schedule, not the math: same
-    rows meet the same experts with the same weights, so outputs are
-    bit-identical to the serial exchange."""
-    cfg, params, x = _setup()
-    mesh = make_mesh(cfg, dp=1, devices=devices[:2])
-    off = ep_moe_layer(params, x, cfg, mesh)
-    on = ep_moe_layer(params, x, cfg.replace(a2a_chunks=2), mesh)
-    np.testing.assert_array_equal(np.asarray(off.out), np.asarray(on.out))
-    np.testing.assert_array_equal(np.asarray(off.expert_counts),
-                                  np.asarray(on.expert_counts))
+def test_chunked_serial_invariants_via_staticcheck(devices):
+    """Serial-schedule identity for the chunk knob across EVERY
+    registered EP backend (flat / hierarchical / ragged) — delegated to
+    the staticcheck invariant engine, which replaced the hand-rolled
+    per-layer assertions this file used to carry: ``a2a_chunks=None``
+    is the dataclass default (equal frozen config => one jit cache
+    entry => same bits by construction) and ``a2a_chunks=1`` traces to
+    the byte-identical jaxpr, while the on-trace's all_to_all count
+    scales exactly with the chunk count.  The chunked-ON numeric
+    equality against the serial schedule stays execution-tested below
+    (slow): a re-ordered schedule being bit-exact is a claim about
+    arithmetic, not structure."""
+    from flashmoe_tpu.staticcheck.invariants import run_invariants
+
+    assert run_invariants(knobs=["a2a_chunks"], devices=devices,
+                          include_coverage=False) == []
 
 
 @pytest.mark.slow

@@ -5,94 +5,39 @@ budget (ROADMAP.md).  The expensive test classes — end-to-end chaos
 drills (full training jobs per fault) and multi-device shard_map
 *executions* (trace-only jaxpr inspection is cheap; running the
 collectives is not) — are required to carry ``@pytest.mark.slow`` so a
-new drill can never silently land in the fast lane.  AST-based: no
-pytest-in-pytest, no imports of the heavy modules.
-"""
+new drill can never silently land in the fast lane.
 
-import ast
-import os
+The AST rule itself lives in the static-analysis subsystem
+(``flashmoe_tpu/staticcheck/lint.py`` — where ``python -m
+flashmoe_tpu.staticcheck --lint`` runs it alongside the other rules);
+this file is the thin tier-1 wrapper that keeps the historical gate
+names and coverage."""
 
-TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+from flashmoe_tpu.staticcheck.lint import (
+    DRILL_CALLS, SHARD_MAP_CALLS, check_slow_marks, slow_mark_selfcheck,
+)
 
-#: calls that make a test a chaos DRILL (a full resilient training job)
-DRILL_CALLS = {"run_drill", "run_matrix"}
-
-#: calls that EXECUTE a shard_map'd MoE layer on the virtual mesh
-#: (jax.make_jaxpr over the same layer is trace-only and stays fast)
-SHARD_MAP_CALLS = {"ep_moe_layer", "ragged_ep_moe_layer",
-                   "fused_ep_moe_layer"}
-
-
-def _called_names(node: ast.AST) -> set:
-    names = set()
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Call):
-            f = sub.func
-            if isinstance(f, ast.Name):
-                names.add(f.id)
-            elif isinstance(f, ast.Attribute):
-                names.add(f.attr)
-    return names
-
-
-def _is_slow_marked(fn: ast.FunctionDef) -> bool:
-    for dec in fn.decorator_list:
-        text = ast.unparse(dec)
-        if "mark.slow" in text:
-            return True
-    return False
-
-
-def _test_functions(path: str):
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    for node in tree.body:
-        if isinstance(node, ast.FunctionDef) and \
-                node.name.startswith("test_"):
-            yield node
+assert DRILL_CALLS and SHARD_MAP_CALLS  # engine still exports the rule
 
 
 def test_every_chaos_drill_test_is_slow_marked():
     """Any test in any file that runs a chaos drill must be slow: one
     drill is a whole resilient training job (compile + steps + restore),
     ~5-10s each on CPU."""
-    offenders = []
-    for name in sorted(os.listdir(TESTS_DIR)):
-        if not (name.startswith("test_") and name.endswith(".py")):
-            continue
-        for fn in _test_functions(os.path.join(TESTS_DIR, name)):
-            if _called_names(fn) & DRILL_CALLS and not _is_slow_marked(fn):
-                offenders.append(f"{name}::{fn.name}")
-    assert not offenders, (
-        f"chaos drill tests missing @pytest.mark.slow: {offenders} — "
-        f"drills are full training jobs and belong outside the fast "
-        f"gate (ROADMAP.md tier-1 budget)")
+    offenders = [str(v) for v in check_slow_marks()
+                 if "chaos drill" in v.detail]
+    assert not offenders, offenders
 
 
 def test_chaos_shard_map_executions_are_slow_marked():
     """test_chaos.py may TRACE the ep layers cheaply (jax.make_jaxpr)
     but must not EXECUTE them in the fast lane."""
-    offenders = []
-    path = os.path.join(TESTS_DIR, "test_chaos.py")
-    for fn in _test_functions(path):
-        called = _called_names(fn)
-        if called & SHARD_MAP_CALLS and "make_jaxpr" not in called \
-                and not _is_slow_marked(fn):
-            offenders.append(fn.name)
-    assert not offenders, (
-        f"test_chaos.py tests executing shard_map layers without "
-        f"@pytest.mark.slow: {offenders}")
+    offenders = [str(v) for v in check_slow_marks()
+                 if "shard_map" in v.detail]
+    assert not offenders, offenders
 
 
 def test_collection_guard_sees_the_known_slow_tests():
     """Self-check: the AST scan actually finds the known drill/execution
     tests — an empty scan would make the guards vacuously green."""
-    path = os.path.join(TESTS_DIR, "test_chaos.py")
-    drills = [fn.name for fn in _test_functions(path)
-              if _called_names(fn) & DRILL_CALLS]
-    execs = [fn.name for fn in _test_functions(path)
-             if _called_names(fn) & SHARD_MAP_CALLS
-             and "make_jaxpr" not in _called_names(fn)]
-    assert "test_drill_matrix" in drills
-    assert "test_drill_preempt_drains_with_zero_lost_steps" in drills
-    assert "test_degrade_ep_layer_masks_and_counts" in execs
+    assert slow_mark_selfcheck() == []

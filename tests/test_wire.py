@@ -132,25 +132,19 @@ def _ep_setup(ep=2, **over):
     return cfg, params, x
 
 
-def test_ep_wire_off_is_bit_identical_and_fp8_free(devices):
-    """Bit-identical-when-off, by construction and by graph: a default
-    config and an explicit wire_dtype=None config are EQUAL frozen
-    dataclasses — one jit cache entry, one executable, same bits — and
-    the wire-off jaxpr carries no f8 conversions at all (the
-    collect_stats convention applied to the wire knobs).  Trace-only:
-    the wire-off EXECUTION accuracy is test_ep.py's existing oracle
-    coverage."""
-    cfg, params, x = _ep_setup()
-    mesh = make_mesh(cfg, dp=1, devices=devices[:2])
-    assert cfg.replace(wire_dtype=None, wire_dtype_combine=None) == cfg
-    assert hash(cfg.replace(wire_dtype=None)) == hash(cfg)
+def test_wire_off_invariants_via_staticcheck(devices):
+    """Bit-identical-when-off + fp8-free graphs for BOTH wire knobs
+    across every registered EP backend (flat / hierarchical / ragged) —
+    delegated to the staticcheck invariant engine, which replaced the
+    hand-rolled per-layer jaxpr assertions this file used to carry
+    (config identity => one jit cache entry => same bits by
+    construction; plus the fp8-present sanity on the on-trace).
+    Trace-only: wire-off EXECUTION accuracy is test_ep.py /
+    test_ragged_ep.py's existing oracle coverage."""
+    from flashmoe_tpu.staticcheck.invariants import run_invariants
 
-    def jaxpr_of(c):
-        return str(jax.make_jaxpr(
-            lambda p, xx: ep_moe_layer(p, xx, c, mesh).out)(params, x))
-
-    assert "f8" not in jaxpr_of(cfg)
-    assert "f8" in jaxpr_of(cfg.replace(wire_dtype="e4m3"))
+    assert run_invariants(knobs=["wire_dtype", "wire_dtype_combine"],
+                          devices=devices, include_coverage=False) == []
 
 
 @pytest.mark.parametrize("wd,wc", [("bf16", None), ("e4m3", "e5m2")])
@@ -190,23 +184,16 @@ def test_hierarchical_a2a_wire_roundtrip_matches_flat(devices):
                                   np.asarray(hier.out))
 
 
-def test_ragged_wire_off_bit_identical_on_accurate(devices):
-    # bit-identical-when-off for the ragged layer: a default config and
-    # an explicit wire_dtype=None config are EQUAL frozen dataclasses,
-    # so they share one jit cache entry — same compiled executable, same
-    # bits by construction (the oracle accuracy of that wire-off build
-    # is test_ragged_ep.py's existing coverage); one trace confirms the
-    # wire-off graph is fp8-free.  The single expensive compile this
-    # test pays for is the wire-ON dense-arm exchange (fp8 payload +
-    # scale sidecar; the combine-wire variant shares the identical
-    # _wired_row_exchange path, exercised on the ep layer above).
+def test_ragged_wire_on_accurate(devices):
+    # Wire-off identity and the fp8-free ragged graph are the invariant
+    # engine's job now (test_wire_off_invariants_via_staticcheck covers
+    # the ragged backend in the same matrix).  The single expensive
+    # compile this test pays for is the wire-ON dense-arm exchange (fp8
+    # payload + scale sidecar; the combine-wire variant shares the
+    # identical _wired_row_exchange path, exercised on the ep layer
+    # above).
     cfg, params, x = _ep_setup(sequence_len=64)
     mesh = make_mesh(cfg, dp=1, devices=devices[:2])
-    assert cfg.replace(wire_dtype=None, wire_dtype_combine=None) == cfg
-    assert "f8" not in str(jax.make_jaxpr(
-        lambda p, xx: ragged_ep_moe_layer(p, xx, cfg, mesh,
-                                          exchange="dense").out
-    )(params, x))
     want, _ = reference_moe(params, x, cfg)
     on = ragged_ep_moe_layer(
         params, x, cfg.replace(wire_dtype="e4m3"), mesh,
