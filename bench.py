@@ -365,6 +365,33 @@ def _bench_checkpoint(trials: int):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _bench_profile(obs_dir: str | None, *, steps: int = 1,
+                   quick: bool = False):
+    """Phase-level profile + cost ledger (``--profile``): run the
+    flat/hierarchical/ragged x {serial, chunked} x {wire off, e4m3}
+    matrix with ``profile_phases=True`` on the virtual CPU mesh (or real
+    chips when FLASHMOE_OVERLAP_TPU=1), joining every measured phase
+    against the planner's per-phase prediction.  One JSON record per
+    matrix point; with ``--obs-dir`` the artifacts land there —
+    ``ledger.jsonl`` + ``trace.json`` (open in ui.perfetto.dev) +
+    ``flight.jsonl`` — and ``python -m flashmoe_tpu.observe --ledger``
+    renders the drift table."""
+    from flashmoe_tpu.profiler.ledger import run_ledger_matrix
+
+    on_tpu = os.environ.get("FLASHMOE_OVERLAP_TPU") == "1"
+    if not on_tpu:
+        from __graft_entry__ import _force_cpu_devices
+        _force_cpu_devices(8)
+        devices = jax.devices("cpu")[:8]
+    else:
+        devices = jax.devices()
+    records = run_ledger_matrix(obs_dir, quick=quick, steps=steps,
+                                devices=devices)
+    for rec in records:
+        print(json.dumps(rec), flush=True)
+        _flush_observability(rec)
+
+
 def _bench_overlap(ep: int, trials: int, *, path: str | None = None,
                    wire_dtype: str | None = None,
                    wire_combine: str | None = None,
@@ -681,6 +708,17 @@ def main():
                     help="measure step-loop checkpoint blocking time, "
                          "sync vs async save, instead of the latency "
                          "bench (host-side; no backend probe)")
+    ap.add_argument("--profile", action="store_true",
+                    help="phase-level profile + predicted-vs-actual "
+                         "cost ledger over the path x chunks x wire "
+                         "matrix (virtual CPU mesh; artifacts into "
+                         "--obs-dir, summarized by "
+                         "`observe --ledger`)")
+    ap.add_argument("--profile-quick", action="store_true",
+                    help="--profile restricted to the first matrix "
+                         "point (CI smoke)")
+    ap.add_argument("--profile-steps", type=int, default=1,
+                    help="profiled steps per matrix point")
     ap.add_argument("--deadline", type=int, default=720,
                     help="wall-clock watchdog (s) for the measurement "
                          "itself, armed AFTER the backend probe succeeds; "
@@ -766,6 +804,26 @@ def main():
                  "not --ckpt")
     if args.a2a_chunks is not None and args.a2a_chunks < 1:
         ap.error("--a2a-chunks must be >= 1")
+    if args.profile or args.profile_quick:
+        # --profile runs its own fixed path x chunks x wire matrix;
+        # refuse knobs/modes it would silently ignore rather than let
+        # the user believe they profiled a shape they named (the same
+        # fail-fast contract --ckpt applies to the wire knobs)
+        if args.wire_dtype or args.wire_combine or args.a2a_chunks:
+            ap.error("--profile ledgers its own path x chunks x wire "
+                     "matrix; --wire-dtype/--wire-combine/--a2a-chunks "
+                     "do not apply")
+        if args.overlap or args.ckpt or args.sweep:
+            ap.error("--profile is its own mode; drop "
+                     "--overlap/--ckpt/--sweep")
+        if args.deadline > 0:
+            signal.alarm(args.deadline)  # virtual-mesh path: no probe leg
+        _bench_profile(args.obs_dir, steps=args.profile_steps,
+                       quick=args.profile_quick)
+        return
+    if args.profile_steps != 1:
+        ap.error("--profile-steps only applies with "
+                 "--profile/--profile-quick")
     if args.ckpt:
         if args.deadline > 0:
             signal.alarm(args.deadline)  # host-side path: no probe leg

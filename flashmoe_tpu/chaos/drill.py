@@ -126,6 +126,7 @@ def _run_supervised_drill(fault: str, *, num_steps: int,
     clear()
     tmp = workdir or tempfile.mkdtemp(prefix=f"chaos_{fault}_")
     ckpt_dir = os.path.join(tmp, "ckpt")
+    pm_dir = os.path.join(tmp, "postmortem")
     cfg = drill_config()
     token_path = _token_file(tmp, cfg, seed)
 
@@ -172,7 +173,7 @@ def _run_supervised_drill(fault: str, *, num_steps: int,
         final, history = supervise(
             cfg, data_factory, num_steps, rcfg, guard=guard,
             metrics=metrics, preempt=preempt, devices_fn=devices_fn,
-            fail_injector=injector, seed=seed)
+            fail_injector=injector, seed=seed, postmortem_dir=pm_dir)
         final_step = int(final.step)
     except Exception as e:  # noqa: BLE001 — a drill reports, never dies
         error, final_step, history = f"{type(e).__name__}: {e}", -1, []
@@ -200,6 +201,10 @@ def _run_supervised_drill(fault: str, *, num_steps: int,
     evidence["loader_state_present"] = (
         last is not None
         and ckpt_mod.load_loader_state(ckpt_dir, last) is not None)
+    from flashmoe_tpu.profiler import postmortem as pm
+
+    bundles = pm.find_bundles(pm_dir)
+    evidence["postmortem_bundles"] = bundles
 
     ok, why = True, []
 
@@ -223,10 +228,17 @@ def _run_supervised_drill(fault: str, *, num_steps: int,
         need(steps_rerun == 0,
              f"drain lost work: {steps_rerun} steps re-run")
         need(c.get("failures", 0) == 0, "drain path counted failures")
+        # a graceful drain is not a death: no forensics bundle
+        need(not bundles,
+             f"graceful drain left postmortem bundle(s): {bundles}")
     else:  # device_loss
         need(c.get("supervisor_restarts", 0) >= 1,
              "process death did not reach the supervisor")
         need(c.get("restores", 0) >= 1, "no checkpoint restore")
+        # the restart-forcing death must leave its forensics behind
+        need(len(bundles) >= 1,
+             "process death left no postmortem bundle")
+        need("postmortem.saved" in names, "no postmortem.saved decision")
         if world0 >= 2:
             worlds = [w for w in evidence["worlds"] if w]
             need(worlds and min(worlds) < world0,
@@ -264,6 +276,7 @@ def run_drill(fault: str, *, num_steps: int = 6, checkpoint_every: int = 2,
 
     tmp = workdir or tempfile.mkdtemp(prefix=f"chaos_{fault}_")
     ckpt_dir = os.path.join(tmp, "ckpt")
+    pm_dir = os.path.join(tmp, "postmortem")
     cfg = drill_config()
     # the drill mesh is a single device: deterministic, CLI-runnable on
     # any host; the multi-device tiers are covered by tests/test_chaos.py
@@ -306,12 +319,16 @@ def run_drill(fault: str, *, num_steps: int = 6, checkpoint_every: int = 2,
     try:
         final, history = resilient_train(
             state, wrapped, data_stream(cfg, batch, seed), num_steps,
-            rcfg=rcfg, metrics=metrics, fail_injector=injector)
+            rcfg=rcfg, metrics=metrics, fail_injector=injector,
+            postmortem_dir=pm_dir, cfg=cfg)
         final_step = int(final.step)
     except Exception as e:  # noqa: BLE001 — a drill reports, never dies
         error, final_step, history = f"{type(e).__name__}: {e}", -1, []
     wall = time.perf_counter() - t0
 
+    from flashmoe_tpu.profiler import postmortem as pm
+
+    bundles = pm.find_bundles(pm_dir)
     decisions = metrics.decisions + global_metrics.decisions[g0:]
     c = metrics.counters
     evidence: dict = {
@@ -323,6 +340,7 @@ def run_drill(fault: str, *, num_steps: int = 6, checkpoint_every: int = 2,
         "finite_history": bool(history) and all(
             np.isfinite(h["loss"]) for h in history if "loss" in h),
         "decision_names": sorted({d["decision"] for d in decisions}),
+        "postmortem_bundles": bundles,
     }
 
     # ---- per-fault verdict: did the INTENDED tier absorb it? ----
@@ -384,6 +402,11 @@ def run_drill(fault: str, *, num_steps: int = 6, checkpoint_every: int = 2,
              f"{bound * max(1, retries)}")
     else:
         need(steps_rerun == 0, "in-graph tier re-ran steps")
+    # every in-job fault recovers below the process-death line: a
+    # postmortem bundle here would mean recovery gave up (the forensics
+    # loop of docs/OBSERVABILITY.md — bundles are for deaths only)
+    need(not bundles,
+         f"recovered fault left postmortem bundle(s): {bundles}")
 
     clear()
     return DrillResult(

@@ -180,6 +180,18 @@ class MoEConfig:
     # (asserted by tests/test_chaos.py).
     degrade_unhealthy_experts: bool = False
 
+    # Phase-level profiling (flashmoe_tpu/profiler/): when True, the
+    # MoE layer bodies fence each phase (gate, dispatch, a2a legs,
+    # expert FFN, combine) with block_until_ready so a host-armed
+    # PhaseTimeline measures real per-phase wall time on EAGER
+    # executions — the xprof-free phase timeline the cost ledger joins.
+    # Host-side only: fences block on concrete values and no-op on
+    # tracers, so the traced graph is byte-identical with the knob on
+    # or off (registered as a graph-neutral knob in the staticcheck
+    # registry and proven by the invariant engine).  Default False:
+    # the bodies contain no fence calls at all.
+    profile_phases: bool = False
+
     # Inference-only: fuse the dispatch gather into the FFN kernel
     # (ops/expert.py:grouped_ffn_tokens — no [E, C, H] HBM buffer).
     # None = auto: follow the FLASHMOE_GATHER_FUSED env var, else stay on

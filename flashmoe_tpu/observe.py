@@ -20,6 +20,13 @@ Usage::
 
     python -m flashmoe_tpu.observe flight.jsonl [decisions.jsonl ...]
     python -m flashmoe_tpu.observe --json flight.jsonl
+    python -m flashmoe_tpu.observe --ledger obs/ledger.jsonl
+    python -m flashmoe_tpu.observe --postmortem /path/to/bundles
+
+``--ledger`` renders the per-phase predicted-vs-measured cost ledger
+(:mod:`flashmoe_tpu.profiler.ledger` artifacts / ``planner.phase_drift``
+decision dumps); ``--postmortem`` renders a triage report of the crash
+bundle(s) written by :mod:`flashmoe_tpu.profiler.postmortem`.
 """
 
 from __future__ import annotations
@@ -189,7 +196,8 @@ def resilience_report(records: list[dict]) -> dict:
     interesting = ("trainer.grad_skip", "checkpoint.fallback",
                    "checkpoint.emergency_save", "checkpoint.async_error",
                    "planner.fallback", "preempt.notice", "preempt.drain",
-                   "supervisor.resume")
+                   "supervisor.resume", "slo.breach", "slo.recovered",
+                   "postmortem.saved")
     return {
         "events": {k: by_name[k] for k in interesting if k in by_name},
         "drains": drains,
@@ -237,6 +245,161 @@ def summarize(records: list[dict]) -> dict:
         "decisions": sorted({r["decision"] for r in records
                              if isinstance(r.get("decision"), str)}),
     }
+
+
+def ledger_report(records: list[dict]) -> dict:
+    """The cost-ledger view: per-(path, chunks, wire) per-phase
+    measured-vs-predicted drift, from ``ledger.jsonl`` rows
+    (:func:`flashmoe_tpu.profiler.ledger.run_ledger_matrix`) and/or
+    ``planner.phase_drift`` decision records — the per-phase answer to
+    "which term of the cost model is lying".  Overlap cross-check rows
+    (``record == "overlap"``) are summarized separately."""
+    points: dict[tuple, dict] = {}
+    overlaps = []
+    for rec in records:
+        if rec.get("record") == "overlap" or (
+                "measured_fraction" in rec and "chunks" in rec):
+            overlaps.append({
+                "path": rec.get("point") or rec.get("path"),
+                "d": rec.get("d"),
+                "chunks": rec.get("chunks"), "wire": rec.get("wire"),
+                "measured_fraction": rec.get("measured_fraction"),
+                "predicted_fraction": rec.get("predicted_fraction"),
+                "exceeded": rec.get("exceeded"),
+            })
+            continue
+        phase = rec.get("phase")
+        if not isinstance(phase, str) or "measured_ms" not in rec:
+            continue
+        # ledger.jsonl rows carry both the matrix point name ("flat")
+        # and the planner path ("collective"); group/display by the
+        # point name when present (decision records only have the path)
+        key = (rec.get("point") or rec.get("path"),
+               rec.get("chunks", 1), rec.get("wire", "off"))
+        pt = points.setdefault(key, {
+            "point": key[0], "path": rec.get("path"),
+            "chunks": key[1], "wire": key[2], "phases": {}})
+        pt["phases"][phase] = {
+            "measured_ms": rec.get("measured_ms"),
+            "predicted_ms": rec.get("predicted_ms"),
+            "rel_error": rec.get("rel_error"),
+            "exceeded": bool(rec.get("exceeded")),
+        }
+    phase_names = sorted({ph for pt in points.values()
+                          for ph in pt["phases"]})
+    n = sum(len(pt["phases"]) for pt in points.values())
+    return {
+        "n": n,
+        "points": [points[k] for k in sorted(
+            points, key=lambda k: (str(k[0]), k[1], str(k[2])))],
+        "phases": phase_names,
+        "exceeded": sum(1 for pt in points.values()
+                        for p in pt["phases"].values() if p["exceeded"]),
+        "overlap": overlaps,
+    }
+
+
+def render_ledger_text(led: dict) -> str:
+    if not led["n"] and not led["overlap"]:
+        return "no phase-ledger rows found (run `bench.py --profile` " \
+               "or profiler.ledger.run_ledger_matrix first)"
+    lines = []
+    if led["n"]:
+        lines += [f"cost ledger: {led['n']} phase comparisons over "
+                  f"{len(led['points'])} config points, "
+                  f"{led['exceeded']} over the drift threshold", ""]
+        head = f"{'point':<34s}" + "".join(
+            f"{ph.removeprefix('moe.'):>16s}" for ph in led["phases"])
+        lines.append(head + "   (rel err, measured/predicted - 1)")
+        for pt in led["points"]:
+            label = (f"{pt.get('point') or pt['path']} "
+                     f"c={pt['chunks']} wire={pt['wire']}")
+            cells = []
+            for ph in led["phases"]:
+                p = pt["phases"].get(ph)
+                if p is None:
+                    cells.append(f"{'-':>16s}")
+                else:
+                    mark = "**" if p["exceeded"] else "  "
+                    cells.append(f"{p['rel_error']:>+13.1%}{mark} ")
+            lines.append(f"{label:<34s}" + "".join(cells))
+    if led["overlap"]:
+        lines.append("")
+        lines.append("overlap cross-check (fenced serial phase sum / "
+                     "jitted step):")
+        for o in led["overlap"]:
+            lines.append(
+                f"  {o['path']} d={o['d']} chunks={o['chunks']} "
+                f"wire={o['wire']}: measured {o['measured_fraction']} "
+                f"vs bound {o['predicted_fraction']}"
+                f"{'  ** DRIFTING' if o['exceeded'] else ''}")
+    return "\n".join(lines)
+
+
+def postmortem_report(bundle: dict) -> dict:
+    """Triage view of one loaded postmortem bundle
+    (:func:`flashmoe_tpu.profiler.postmortem.load_bundle`)."""
+    man = bundle.get("manifest") or {}
+    decisions = bundle.get("decisions") or []
+    by_name: dict[str, int] = {}
+    for d in decisions:
+        name = d.get("decision")
+        if isinstance(name, str):
+            by_name[name] = by_name.get(name, 0) + 1
+    tb = bundle.get("traceback") or ""
+    cfg = bundle.get("config") or {}
+    env = bundle.get("env") or {}
+    planner = bundle.get("planner") or {}
+    flight = bundle.get("flight") or []
+    losses = [r.get("loss") for r in flight
+              if isinstance(r.get("loss"), (int, float))]
+    return {
+        "path": bundle.get("path"),
+        "error": man.get("error"),
+        "step": man.get("step"),
+        "files": man.get("files", []),
+        "traceback_tail": tb.strip().splitlines()[-12:],
+        "decision_counts": by_name,
+        "last_decisions": decisions[-8:],
+        "flight_records": len(flight),
+        "last_losses": [round(v, 4) for v in losses[-5:]],
+        "config": {k: cfg[k] for k in (
+            "num_experts", "expert_top_k", "hidden_size",
+            "intermediate_size", "moe_backend", "wire_dtype",
+            "a2a_chunks", "ep", "dp") if k in cfg},
+        "backend": env.get("backend"),
+        "jax": env.get("jax"),
+        "last_path_select": planner.get("last_path_select"),
+        "extra": man.get("extra"),
+    }
+
+
+def render_postmortem_text(rep: dict) -> str:
+    lines = [f"postmortem bundle: {rep['path']}",
+             f"  error: {rep['error']}",
+             f"  step:  {rep['step']}    files: "
+             f"{', '.join(rep['files'])}"]
+    if rep.get("extra"):
+        lines.append(f"  extra: {rep['extra']}")
+    if rep.get("config"):
+        lines.append("  config: " + ", ".join(
+            f"{k}={v}" for k, v in rep["config"].items()))
+    if rep.get("backend") or rep.get("jax"):
+        lines.append(f"  env: jax {rep['jax']} on {rep['backend']}")
+    if rep["decision_counts"]:
+        lines.append("  decisions: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(rep["decision_counts"].items())))
+    if rep["flight_records"]:
+        lines.append(f"  flight: {rep['flight_records']} records, last "
+                     f"losses {rep['last_losses']}")
+    sel = rep.get("last_path_select")
+    if sel:
+        lines.append(f"  last path select: {sel.get('backend') or sel}")
+    if rep["traceback_tail"]:
+        lines.append("  traceback (tail):")
+        for tline in rep["traceback_tail"]:
+            lines.append(f"    {tline}")
+    return "\n".join(lines)
 
 
 def _bar(value: float, peak: float, width: int = 40) -> str:
@@ -325,15 +488,47 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m flashmoe_tpu.observe",
         description="Summarize flight-recorder / telemetry JSONL dumps")
-    ap.add_argument("files", nargs="+", help="JSONL files to analyze")
+    ap.add_argument("files", nargs="*", help="JSONL files to analyze")
     ap.add_argument("--json", action="store_true",
                     help="emit one machine-readable JSON document")
+    ap.add_argument("--ledger", action="store_true",
+                    help="render the per-phase cost-ledger report "
+                         "(ledger.jsonl / phase_drift decision files)")
+    ap.add_argument("--postmortem", metavar="DIR",
+                    help="render a triage report of the crash postmortem "
+                         "bundle(s) under DIR")
     args = ap.parse_args(argv)
 
+    if args.postmortem:
+        from flashmoe_tpu.profiler import postmortem as pm
+
+        bundles = pm.find_bundles(args.postmortem)
+        if not bundles:
+            print(f"no postmortem bundles under {args.postmortem!r}",
+                  file=sys.stderr)
+            return 2
+        reports = [postmortem_report(pm.load_bundle(b)) for b in bundles]
+        if args.json:
+            json.dump({"bundles": reports}, sys.stdout)
+            print()
+        else:
+            print("\n\n".join(render_postmortem_text(r) for r in reports))
+        return 0
+
+    if not args.files:
+        ap.error("JSONL files required (or use --postmortem DIR)")
     records = load_jsonl(args.files)
     if not records:
         print("no parseable records found", file=sys.stderr)
         return 2
+    if args.ledger:
+        led = ledger_report(records)
+        if args.json:
+            json.dump(led, sys.stdout)
+            print()
+        else:
+            print(render_ledger_text(led))
+        return 0 if led["n"] or led["overlap"] else 2
     s = summarize(records)
     if args.json:
         json.dump(s, sys.stdout)

@@ -63,6 +63,7 @@ from flashmoe_tpu.ops import stats as st
 from flashmoe_tpu.ops import wire as wr
 from flashmoe_tpu.ops.gate import router
 from flashmoe_tpu.ops.moe import MoEOutput, dense_ffn
+from flashmoe_tpu.profiler import spans as prof
 from flashmoe_tpu.utils.telemetry import trace_span
 
 
@@ -172,13 +173,21 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
     # phase spans mirror the reference's NVTX "Flashmoe" domain
     # (telemetry.cuh): named HLO scopes so xprof traces show gate /
     # dispatch / a2a / expert / combine as distinct phases.  Pure
-    # metadata — no ops added, the stats-off graph is unchanged.
+    # metadata — no ops added, the stats-off graph is unchanged.  With
+    # cfg.profile_phases the spans additionally fence (prof.fence:
+    # block_until_ready on concrete eager values, a no-op on tracers),
+    # so a host-armed PhaseTimeline measures real per-phase wall time
+    # — the xprof-free phase timeline of flashmoe_tpu/profiler.
     with trace_span("moe.gate"):
         r = router(x, params["gate_w"], cfg, use_pallas=use_pallas,
                    interpret=interpret)
+        if cfg.profile_phases:
+            prof.fence(r)
     with trace_span("moe.dispatch"):
         plan = dsp.make_plan(r.expert_idx, cfg, cap)
         xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)  # [E, C, H]
+        if cfg.profile_phases:
+            prof.fence(xbuf)
 
     from flashmoe_tpu.chaos import inject as chaos_inject
 
@@ -235,11 +244,16 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
                 else:
                     recv_k = _wired_exchange(send_k, wire_disp, axis, d,
                                              dcn_inner, reverse=False)
-            ybuf_k = recv_k.transpose(1, 0, 2, 3).reshape(nc, d * cap, h)
+                if cfg.profile_phases:
+                    prof.fence(recv_k)
             p_k = {kk: (v[lo:lo + nc] if kk in ffn_keys else v)
                    for kk, v in ffn_params.items()}
             with trace_span(f"moe.expert.{ck}"):
+                ybuf_k = recv_k.transpose(1, 0, 2, 3).reshape(
+                    nc, d * cap, h)
                 yloc_k = ffn(ybuf_k, p_k)
+                if cfg.profile_phases:
+                    prof.fence(yloc_k)
             if chaos_inject.is_armed("nan_expert"):  # trace-time check
                 # same pre-exchange poisoning as the serial branch; the
                 # chunk covers local experts [lo, lo+nc) of this owner
@@ -257,6 +271,8 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
                 else:
                     yback_k = _wired_exchange(ysend_k, wire_comb, axis,
                                               d, dcn_inner, reverse=True)
+                if cfg.profile_phases:
+                    prof.fence(yback_k)
             ybacks.append(yback_k)
         # [D, nc, C, H] chunks -> [D, nLx, C, H] -> [E, C, H]: global
         # expert id = owner_rank * nLx + local index, so chunks stack
@@ -273,9 +289,13 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
                 recv = _wired_exchange(send, wire_disp, axis, d,
                                        dcn_inner, reverse=False)
                 # [D, nLx, C, H] — dim 0 now indexes source rank
-        ybuf_in = recv.transpose(1, 0, 2, 3).reshape(nlx, d * cap, h)
+            if cfg.profile_phases:
+                prof.fence(recv)
         with trace_span("moe.expert"):
+            ybuf_in = recv.transpose(1, 0, 2, 3).reshape(nlx, d * cap, h)
             yloc = ffn(ybuf_in, ffn_params)
+            if cfg.profile_phases:
+                prof.fence(yloc)
 
         if chaos_inject.is_armed("nan_expert"):  # trace-time check only
             # poison BEFORE the return exchange: the fault originates at
@@ -300,6 +320,8 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
                 yback = _wired_exchange(ysend, wire_comb, axis, d,
                                         dcn_inner, reverse=True)
                 # [D, nLx, C, H] — dim 0 indexes expert-owner rank
+            if cfg.profile_phases:
+                prof.fence(yback)
         ybuf = yback.reshape(e, cap, h)
 
     healthy = None
@@ -319,6 +341,8 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
             out = out + shared_expert_ffn(
                 x.astype(cfg.dtype), params, cfg
             ).astype(out.dtype)
+        if cfg.profile_phases:
+            prof.fence(out)
 
     aux = jax.lax.pmean(r.aux_loss, reduce_axes) * cfg.aux_loss_coef
     z = jax.lax.pmean(r.z_loss, reduce_axes)

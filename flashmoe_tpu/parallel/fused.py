@@ -100,6 +100,7 @@ from flashmoe_tpu.ops import stats as st
 from flashmoe_tpu.ops.gate import router
 from flashmoe_tpu.ops.moe import MoEOutput
 from flashmoe_tpu.parallel.ep import local_capacity
+from flashmoe_tpu.profiler import spans as prof
 from flashmoe_tpu.utils.telemetry import trace_span
 
 
@@ -1442,16 +1443,22 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
             else (interpret or jax.default_backend() == "tpu")
         )
         # phase spans (telemetry.trace_span): the xprof counterpart of the
-        # reference's NVTX "Flashmoe" domain — metadata only, no ops
+        # reference's NVTX "Flashmoe" domain — metadata only, no ops.
+        # With cfg.profile_phases the spans also fence (prof.fence no-ops
+        # on tracers) so the host phase timeline sees real durations.
         with trace_span("moe.gate"):
             r = router(x, params["gate_w"], cfg, use_pallas=use_gate_pallas,
                        interpret=interpret)
+            if cfg.profile_phases:
+                prof.fence(r)
         with trace_span("moe.dispatch"):
             plan = dsp.make_plan(r.expert_idx, cfg, cap)
             xbuf = dsp.dispatch(x.astype(cfg.dtype), plan, cfg, cap)
             if cap_pad != cap:
                 xbuf = jnp.pad(xbuf, ((0, 0), (0, cap_pad - cap), (0, 0)))
             x_send = xbuf.reshape(d, nlx, cap_pad, h)
+            if cfg.profile_phases:
+                prof.fence(x_send)
 
         # routed-count matrices: what I send each (dest, expert) and what
         # each source sends my experts — shared knowledge on both ends, so
@@ -1498,12 +1505,16 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
                     w_sorted[:, None], x_send, *w_args,
                     cfg, "ep", interpret, collective_id, detect_races, cu,
                 )[:s_loc]
+                if cfg.profile_phases:
+                    prof.fence(out)
         else:
             with trace_span("moe.fused_kernel"):
                 y_recv = _fused_core(
                     send_cnt, recv_cnt, src_order, x_send, *w_args,
                     cfg, "ep", interpret, collective_id, detect_races,
                 )
+                if cfg.profile_phases:
+                    prof.fence(y_recv)
             with trace_span("moe.combine"):
                 ybuf = y_recv.reshape(cfg.num_experts, cap_pad, h)
                 combine_w = r.combine_weights
@@ -1517,6 +1528,8 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
                     ybuf, combine_w = hlt.degrade_outputs(
                         ybuf, combine_w, r.expert_idx, healthy)
                 out = dsp.combine(ybuf, plan, combine_w, cfg, cap_pad)
+                if cfg.profile_phases:
+                    prof.fence(out)
         if cfg.num_shared_experts:
             out = out + shared_expert_ffn(
                 x.astype(cfg.dtype), params, cfg

@@ -47,6 +47,8 @@ from flashmoe_tpu.ops import stats as st
 from flashmoe_tpu.ops import wire as wr
 from flashmoe_tpu.ops.gate import router
 from flashmoe_tpu.ops.moe import MoEOutput
+from flashmoe_tpu.profiler import spans as prof
+from flashmoe_tpu.utils.telemetry import trace_span
 
 
 #: metadata collectives the dense-arm layouts trade beyond the payload
@@ -259,8 +261,6 @@ def _chunked_ragged_exchange(params, xs, cmat, input_offsets,
     expert-sorted staging buffer ``xs``.  Returns (ys [n_assign, H] in
     the original expert-sorted layout — the disjoint per-chunk returns
     summed — and the stats-gated combine wire error, or None)."""
-    from flashmoe_tpu.utils.telemetry import trace_span
-
     nc = nlx // n_chunks
     my = jax.lax.axis_index(axis)
     # all ranks' count matrices: all_cmat[s, p, le] = rows s sends to
@@ -307,6 +307,8 @@ def _chunked_ragged_exchange(params, xs, cmat, input_offsets,
                     recv_sizes=recv_sizes_c,
                     recv_offsets=recv_offsets_c,
                 )
+            if cfg.profile_phases:
+                prof.fence(x_recv_c)
 
         # -- regroup + FFN on the chunk's experts only
         rows = jnp.arange(recv_bound, dtype=jnp.int32)
@@ -324,6 +326,8 @@ def _chunked_ragged_exchange(params, xs, cmat, input_offsets,
                  None if w_gate_p is None else w_gate_p[lo:lo + nc]),
                 cfg, use_pallas=use_pallas, interpret=interpret,
                 block_m=block_m)
+            if cfg.profile_phases:
+                prof.fence(y_grp)
 
         # -- return: back to each source's original staging slots
         y_src_major = y_grp[target.clip(0, grouped_rows - 1)]
@@ -351,6 +355,8 @@ def _chunked_ragged_exchange(params, xs, cmat, input_offsets,
                     recv_sizes=send_sizes_c,
                     recv_offsets=send_offsets_c,
                 )
+            if cfg.profile_phases:
+                prof.fence(ys_c)
         # chunks return disjoint row ranges (zeros elsewhere): summing
         # reassembles the full expert-sorted ys
         ys = ys + ys_c
@@ -376,13 +382,23 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
             f"axis (num_experts={e} // ep={d} = {nlx}); pick a divisor "
             f"or leave a2a_chunks=None for the serial schedule")
 
-    r = router(x, params["gate_w"], cfg, use_pallas=use_pallas,
-               interpret=interpret)
+    # phase spans mirror parallel/ep.py: named HLO scopes for xprof, and
+    # — with cfg.profile_phases — fenced boundaries for the host-side
+    # phase timeline (flashmoe_tpu/profiler; fences no-op on tracers,
+    # so the traced graph is identical with the knob on or off)
+    with trace_span("moe.gate"):
+        r = router(x, params["gate_w"], cfg, use_pallas=use_pallas,
+                   interpret=interpret)
+        if cfg.profile_phases:
+            prof.fence(r)
 
     # ---- local expert-sorted layout (contiguous, unpadded: block "1") ----
-    plan = rag.make_ragged_plan(r.expert_idx, cfg, 1)
-    xs = rag.ragged_dispatch(x.astype(cfg.dtype), plan, cfg, 1)  # [nA+, H]
-    xs = xs[:n_assign]  # block_m=1 upper bound equals exact total
+    with trace_span("moe.dispatch"):
+        plan = rag.make_ragged_plan(r.expert_idx, cfg, 1)
+        xs = rag.ragged_dispatch(x.astype(cfg.dtype), plan, cfg, 1)
+        xs = xs[:n_assign]  # block_m=1 upper bound equals exact total
+        if cfg.profile_phases:
+            prof.fence(xs)
     counts = plan.counts  # [E] rows per global expert
     cmat = counts.reshape(d, nlx)  # [dest, local expert]
     send_sizes = jnp.sum(cmat, axis=1).astype(jnp.int32)  # [D]
@@ -406,92 +422,105 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
             wire_err = (comb_err if wire_err is None
                         else jnp.maximum(wire_err, comb_err))
     else:
-        # ---- exchange sizes ----
-        # all ranks' send matrices: S[s, d] = rows s sends to d
-        all_send = jax.lax.all_gather(send_sizes, axis)  # [D, D]
-        my = jax.lax.axis_index(axis)
-        recv_sizes = all_send[:, my].astype(jnp.int32)  # [D] rows per src
-        recv_offsets = (jnp.cumsum(recv_sizes)
-                        - recv_sizes).astype(jnp.int32)
-        # where my block starts on each destination = earlier sources
-        out_offsets = (
-            jnp.cumsum(all_send, axis=0) - all_send
-        )[my].astype(jnp.int32)  # [D]
-        # per-(src, my local expert) counts, for regrouping
-        recv_cmat = jax.lax.all_to_all(
-            cmat.reshape(d, 1, nlx), axis, split_axis=0, concat_axis=0,
-            tiled=False,
-        ).reshape(d, nlx)
+        with trace_span("moe.a2a_dispatch"):
+            # ---- exchange sizes ----
+            # all ranks' send matrices: S[s, d] = rows s sends to d
+            all_send = jax.lax.all_gather(send_sizes, axis)  # [D, D]
+            my = jax.lax.axis_index(axis)
+            recv_sizes = all_send[:, my].astype(jnp.int32)  # [D] per src
+            recv_offsets = (jnp.cumsum(recv_sizes)
+                            - recv_sizes).astype(jnp.int32)
+            # where my block starts on each destination = earlier sources
+            out_offsets = (
+                jnp.cumsum(all_send, axis=0) - all_send
+            )[my].astype(jnp.int32)  # [D]
+            # per-(src, my local expert) counts, for regrouping
+            recv_cmat = jax.lax.all_to_all(
+                cmat.reshape(d, 1, nlx), axis, split_axis=0,
+                concat_axis=0, tiled=False,
+            ).reshape(d, nlx)
 
-        # ---- forward data exchange: src-major ragged layout ----
-        if skip_exchange:
-            x_recv = _pad_rows(xs, recv_bound)
-        else:
-            x_recv = _wired_row_exchange(
-                xs, wire_disp, axis=axis, d=d, exchange=exchange,
-                block_rows=n_assign, out_bound=recv_bound,
-                send_offsets=input_offsets, send_sizes=send_sizes,
-                remote_offsets=out_offsets, recv_sizes=recv_sizes,
-                recv_offsets=recv_offsets,
-            )
+            # ---- forward data exchange: src-major ragged layout ----
+            if skip_exchange:
+                x_recv = _pad_rows(xs, recv_bound)
+            else:
+                x_recv = _wired_row_exchange(
+                    xs, wire_disp, axis=axis, d=d, exchange=exchange,
+                    block_rows=n_assign, out_bound=recv_bound,
+                    send_offsets=input_offsets, send_sizes=send_sizes,
+                    remote_offsets=out_offsets, recv_sizes=recv_sizes,
+                    recv_offsets=recv_offsets,
+                )
+            if cfg.profile_phases:
+                prof.fence(x_recv)
 
-        # ---- regroup src-major -> tile-padded expert-major ----
-        rows = jnp.arange(recv_bound, dtype=jnp.int32)
-        target, grouped_rows, tile_gid, total_recv = _regroup_maps(
-            recv_cmat, recv_offsets, recv_sizes, recv_bound, block_m)
-        x_grp = jnp.zeros((grouped_rows, h), xs.dtype)
-        x_grp = x_grp.at[target].set(x_recv, mode="drop")
+        with trace_span("moe.expert"):
+            # ---- regroup src-major -> tile-padded expert-major ----
+            rows = jnp.arange(recv_bound, dtype=jnp.int32)
+            target, grouped_rows, tile_gid, total_recv = _regroup_maps(
+                recv_cmat, recv_offsets, recv_sizes, recv_bound, block_m)
+            x_grp = jnp.zeros((grouped_rows, h), xs.dtype)
+            x_grp = x_grp.at[target].set(x_recv, mode="drop")
 
-        # ---- expert FFN on the local shard of weights ----
-        y_grp = _grouped_ffn(
-            x_grp, tile_gid,
-            (params["w_up"], params["b_up"], params["w_down"],
-             params["b_down"], w_gate_p),
-            cfg, use_pallas=use_pallas, interpret=interpret,
-            block_m=block_m)
+            # ---- expert FFN on the local shard of weights ----
+            y_grp = _grouped_ffn(
+                x_grp, tile_gid,
+                (params["w_up"], params["b_up"], params["w_down"],
+                 params["b_down"], w_gate_p),
+                cfg, use_pallas=use_pallas, interpret=interpret,
+                block_m=block_m)
+            if cfg.profile_phases:
+                prof.fence(y_grp)
 
-        # ---- return path: expert-major -> src-major -> ragged back ----
-        y_src_major = y_grp[target.clip(0, grouped_rows - 1)]
-        y_src_major = jnp.where(
-            (rows < total_recv)[:, None], y_src_major, 0
-        ).astype(xs.dtype)
+        with trace_span("moe.a2a_combine"):
+            # ---- return path: expert-major -> src-major -> ragged back
+            y_src_major = y_grp[target.clip(0, grouped_rows - 1)]
+            y_src_major = jnp.where(
+                (rows < total_recv)[:, None], y_src_major, 0
+            ).astype(xs.dtype)
 
-        # returned rows must land where the source originally staged
-        # them: on rank s that's s's input_offsets[my] = exclusive
-        # row-cumsum of its send sizes — from the gathered send matrix
-        rev_out_offsets = (
-            jnp.cumsum(all_send, axis=1) - all_send
-        )[:, my].astype(jnp.int32)
-        if cfg.collect_stats and wire_comb is not None:
-            comb_err = wr.roundtrip_error(y_src_major, wire_comb)
-            wire_err = (comb_err if wire_err is None
-                        else jnp.maximum(wire_err, comb_err))
-        if skip_exchange:
-            ys = _pad_rows(y_src_major, n_assign)
-        else:
-            ys = _wired_row_exchange(
-                y_src_major, wire_comb, axis=axis, d=d,
-                exchange=exchange,
-                block_rows=n_assign, out_bound=n_assign,
-                send_offsets=recv_offsets, send_sizes=recv_sizes,
-                remote_offsets=rev_out_offsets, recv_sizes=send_sizes,
-                recv_offsets=input_offsets,
-            )
+            # returned rows must land where the source originally staged
+            # them: on rank s that's s's input_offsets[my] = exclusive
+            # row-cumsum of its send sizes — from the gathered matrix
+            rev_out_offsets = (
+                jnp.cumsum(all_send, axis=1) - all_send
+            )[:, my].astype(jnp.int32)
+            if cfg.collect_stats and wire_comb is not None:
+                comb_err = wr.roundtrip_error(y_src_major, wire_comb)
+                wire_err = (comb_err if wire_err is None
+                            else jnp.maximum(wire_err, comb_err))
+            if skip_exchange:
+                ys = _pad_rows(y_src_major, n_assign)
+            else:
+                ys = _wired_row_exchange(
+                    y_src_major, wire_comb, axis=axis, d=d,
+                    exchange=exchange,
+                    block_rows=n_assign, out_bound=n_assign,
+                    send_offsets=recv_offsets, send_sizes=recv_sizes,
+                    remote_offsets=rev_out_offsets, recv_sizes=send_sizes,
+                    recv_offsets=input_offsets,
+                )
+            if cfg.profile_phases:
+                prof.fence(ys)
 
     # ---- combine in the original expert-sorted layout ----
-    healthy = None
-    combine_w = r.combine_weights
-    if cfg.degrade_unhealthy_experts:
-        # tier-0 (ops/health.py): ys is expert-sorted by GLOBAL expert
-        # with per-expert row counts in plan.counts (block-1 layout:
-        # padded == exact), so segment health maps rows -> experts; the
-        # ragged combine does not renormalize, so the mask does
-        from flashmoe_tpu.ops import health as hlt
+    with trace_span("moe.combine"):
+        healthy = None
+        combine_w = r.combine_weights
+        if cfg.degrade_unhealthy_experts:
+            # tier-0 (ops/health.py): ys is expert-sorted by GLOBAL
+            # expert with per-expert row counts in plan.counts (block-1
+            # layout: padded == exact), so segment health maps rows ->
+            # experts; the ragged combine does not renormalize, so the
+            # mask does
+            from flashmoe_tpu.ops import health as hlt
 
-        healthy = hlt.expert_health_segments(ys, plan.counts)
-        ys, combine_w = hlt.degrade_outputs(
-            ys, combine_w, r.expert_idx, healthy, renormalize=True)
-    out = rag.ragged_combine(ys, plan, combine_w, cfg)
+            healthy = hlt.expert_health_segments(ys, plan.counts)
+            ys, combine_w = hlt.degrade_outputs(
+                ys, combine_w, r.expert_idx, healthy, renormalize=True)
+        out = rag.ragged_combine(ys, plan, combine_w, cfg)
+        if cfg.profile_phases:
+            prof.fence(out)
 
     aux = jax.lax.pmean(r.aux_loss, reduce_axes) * cfg.aux_loss_coef
     z = jax.lax.pmean(r.z_loss, reduce_axes)

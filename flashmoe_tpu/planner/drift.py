@@ -117,6 +117,80 @@ def record_drift(cfg: MoEConfig, path: str, measured_ms: float, *,
 
 
 @dataclasses.dataclass(frozen=True)
+class PhaseDriftRecord:
+    """One per-phase predicted-vs-measured comparison (the cost ledger,
+    :mod:`flashmoe_tpu.profiler.ledger`)."""
+
+    path: str
+    phase: str
+    gen: str
+    d: int
+    chunks: int
+    wire: str
+    predicted_ms: float
+    measured_ms: float
+    rel_error: float            # measured / predicted - 1 (signed)
+    threshold: float
+    exceeded: bool
+
+
+def record_phase_drift(cfg: MoEConfig, path: str, phase: str,
+                       measured_ms: float, *, predicted_ms: float,
+                       d: int = 1, gen: str | None = None,
+                       threshold: float | None = None,
+                       warn: bool = True) -> PhaseDriftRecord:
+    """Compare one measured MoE *phase* time (gate / dispatch a2a /
+    expert FFN / combine a2a — the profiler's timeline,
+    :mod:`flashmoe_tpu.profiler.spans`) against the analytical model's
+    prediction of that same phase.
+
+    This is :func:`record_drift` at phase granularity: where the
+    end-to-end monitor can only say "the layer is slower than priced",
+    per-phase drift says WHICH term of the cost model is wrong — an
+    a2a leg drifting alone points at the transport model (or a sick
+    link), the expert phase drifting alone at the roofline's
+    mxu_fraction.  Recorded as a ``planner.phase_drift`` decision plus
+    the ``planner.phase_drift_abs_rel_error`` histogram; warns past the
+    threshold like its end-to-end sibling."""
+    from flashmoe_tpu import tuning
+    from flashmoe_tpu.ops import wire as wr
+
+    gen = gen or tuning.generation()
+    if predicted_ms <= 0:
+        raise ValueError(f"predicted_ms must be > 0, got {predicted_ms}")
+    threshold = drift_threshold() if threshold is None else threshold
+    rel = measured_ms / predicted_ms - 1.0
+    exceeded = abs(rel) > threshold
+    wire_tag = (f"{wr.canonical_name(cfg.wire_dtype)}/"
+                f"{wr.canonical_name(cfg.wire_dtype_combine)}")
+    rec = PhaseDriftRecord(
+        path=path, phase=phase, gen=gen, d=int(d),
+        chunks=int(cfg.a2a_chunks or 1), wire=wire_tag,
+        predicted_ms=float(predicted_ms), measured_ms=float(measured_ms),
+        rel_error=float(rel), threshold=float(threshold),
+        exceeded=exceeded)
+    metrics.decision(
+        "planner.phase_drift", path=path, phase=phase, gen=gen,
+        d=int(d), chunks=rec.chunks, wire=wire_tag,
+        predicted_ms=round(float(predicted_ms), 6),
+        measured_ms=round(float(measured_ms), 6),
+        rel_error=round(float(rel), 4), threshold=float(threshold),
+        exceeded=exceeded,
+        config=dict(e=cfg.num_experts, k=cfg.expert_top_k,
+                    h=cfg.hidden_size, i=cfg.intermediate_size,
+                    s=cfg.tokens))
+    metrics.histogram("planner.phase_drift_abs_rel_error", abs(rel))
+    if exceeded and warn:
+        warnings.warn(
+            f"phase drift on {path!r}/{phase} (gen={gen}, d={d}): "
+            f"measured {measured_ms:.4f} ms vs predicted "
+            f"{predicted_ms:.4f} ms ({rel:+.0%}, threshold "
+            f"±{threshold:.0%}) — this phase's cost-model term is "
+            f"stale for this shape", RuntimeWarning, stacklevel=2)
+    return rec
+
+
+@dataclasses.dataclass(frozen=True)
 class OverlapDriftRecord:
     """One predicted-vs-measured overlap-fraction comparison (the
     chunked-pipeline validation loop, ``bench.py --overlap``)."""

@@ -142,6 +142,27 @@ def _ici_link(gen: str) -> tuple[float, float]:
     return lat_us / 1e3, gbps * 1e6
 
 
+def a2a_leg_ms(slab: float, kind: str, *, d: int, gen: str,
+               slices: int = 1, links: int = 4,
+               chunks: int = 1) -> tuple[float, float]:
+    """(ici_ms, dcn_ms) of ONE exchange leg moving a ``slab`` of bytes
+    at its wire row size, per-message alpha multiplied by the chunk
+    count (``analysis.a2a_transport_cost``).  Public because it is THE
+    per-leg pricing formula: ``predict_paths`` prices every XLA row
+    through it and the profiler's cost ledger
+    (:func:`flashmoe_tpu.profiler.ledger.predicted_phase_ms`) prices
+    each measured a2a phase through the same call, so planner and
+    ledger can never price the same bytes differently.  ``kind``
+    selects the ``a2a_transport_cost`` row when the exchange spans
+    slices (> 1); single-slice legs use the closed flat form."""
+    a_ici, bw_link = _ici_link(gen)
+    if slices > 1:
+        t = a2a_transport_cost(d, d // slices, slab, gen=gen,
+                               links=links, chunks=chunks)[kind]
+        return t["ici_ms"], t["dcn_ms"]
+    return (d - 1) * (chunks * a_ici + slab / (bw_link * links)), 0.0
+
+
 def slab_bytes(cfg: MoEConfig, d: int, *, padded: bool = False,
                leg: str = "dispatch") -> float:
     """One (dest-rank) capacity slab: the unit both exchanges move.
@@ -233,18 +254,9 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
 
     from flashmoe_tpu.parallel.fused import schedule_metadata
 
-    inner = d // slices
-
     def one_leg(slab, kind):
-        """(ici_ms, dcn_ms) of ONE exchange leg at its wire row size,
-        per-message alpha multiplied by the chunk count
-        (``analysis.a2a_transport_cost``)."""
-        if slices > 1:
-            t = a2a_transport_cost(d, inner, slab, gen=gen,
-                                   links=links, chunks=n_chunks)[kind]
-            return t["ici_ms"], t["dcn_ms"]
-        return (d - 1) * (n_chunks * a_ici
-                          + slab / (bw_link * links)), 0.0
+        return a2a_leg_ms(slab, kind, d=d, gen=gen, slices=slices,
+                          links=links, chunks=n_chunks)
 
     def xla_row(path, cost, slab_by_leg, kind, note):
         """One XLA-transport row: legs priced separately (each at its

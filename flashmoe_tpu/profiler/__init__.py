@@ -1,0 +1,34 @@
+"""Phase-level profiler: the xprof-free measurement substrate.
+
+The reference kernel's thesis is that an MoE layer's time decomposes
+into four phases — gate, dispatch a2a, expert FFN, combine a2a — yet
+until this package the framework could only *model* that decomposition
+(the analytical planner) or capture it with xprof on real TPUs it has
+never had.  This package measures it on any backend, CPU included:
+
+* :mod:`flashmoe_tpu.profiler.spans` — a host-side span clock riding
+  the existing ``trace_span`` sites: when a :class:`PhaseTimeline` is
+  armed and the layer executes *eagerly* (no ``jit``), every phase is
+  fenced with ``block_until_ready`` at its boundary, so per-step
+  per-phase wall durations are real device time, not trace time;
+* :mod:`flashmoe_tpu.profiler.ledger` — the predicted-vs-actual cost
+  ledger: joins each measured phase against the planner's prediction
+  for that same phase (``planner.phase_drift`` decisions), plus a
+  measured overlap fraction per chunk cross-checked against
+  ``overlap.chunked_overlap_bound``;
+* :mod:`flashmoe_tpu.profiler.export` — Chrome-trace / Perfetto
+  ``trace.json`` export (open in ``ui.perfetto.dev`` with zero TPU
+  tooling);
+* :mod:`flashmoe_tpu.profiler.slo` — step/phase-time SLO watchdog
+  (``slo.breach`` / ``slo.recovered`` decisions, consecutive-breach
+  escalation into the planner's path-demotion machinery);
+* :mod:`flashmoe_tpu.profiler.postmortem` — crash postmortem bundles
+  (flight ring + decisions + timeline + config + env + traceback),
+  rendered by ``python -m flashmoe_tpu.observe --postmortem <dir>``.
+
+Import the submodules directly — this ``__init__`` stays import-light
+because the hot-path layers (:mod:`flashmoe_tpu.parallel.ep`) import
+:mod:`~flashmoe_tpu.profiler.spans` at module load.
+"""
+
+from flashmoe_tpu.profiler import spans  # noqa: F401  (import-light)

@@ -1,0 +1,240 @@
+"""SLO watchdog: step/phase-time budgets with breach escalation.
+
+An SRE story for the training loop: declare a wall-time budget for the
+step (and optionally per MoE phase), and the watchdog turns sustained
+violations into the framework's existing recovery machinery —
+
+* every budget violation is a ``slo.breach`` decision (target, measured
+  vs budget, consecutive count) and a ``slo.breaches`` counter;
+* the first in-budget observation after a breach run is a
+  ``slo.recovered`` decision, so the JSONL stream reads as breach
+  *episodes*, not noise;
+* ``consecutive`` breaches of the STEP budget escalate: when
+  ``demote_backend`` names an execution path, the watchdog calls
+  :func:`flashmoe_tpu.planner.select.report_path_failure` — the PR 3
+  demotion machinery — so a sustained a2a regression on a specialized
+  transport (fused / ragged) demotes the job back onto the collective
+  baseline at the next path resolution instead of missing its SLO
+  forever.  Escalation fires once per breach episode.
+
+Budgets come from an :class:`SLOConfig` built in code or loaded from a
+YAML sidecar (``SLOConfig.from_yaml``; PyYAML when available, with a
+dependency-free fallback parser for the flat schema below)::
+
+    step_ms: 250          # budget for one train step
+    consecutive: 3        # breaches before escalation
+    demote_backend: ragged
+    phase_ms:
+      moe.expert: 120
+      moe.a2a_dispatch: 40
+
+Wiring: ``runtime.trainer.train(..., slo=...)`` and
+``runtime.resilient.resilient_train(..., slo=...)`` feed the watchdog
+every step's wall time; profiled runs can feed per-phase times too.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from flashmoe_tpu.utils.telemetry import Metrics, metrics as _global
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """Budgets and escalation policy (immutable; the watchdog carries
+    the mutable episode state)."""
+
+    step_ms: float | None = None
+    phase_ms: tuple = ()            # ((phase, budget_ms), ...)
+    consecutive: int = 3            # step breaches before escalation
+    demote_backend: str | None = None
+
+    def __post_init__(self):
+        if self.step_ms is not None and self.step_ms <= 0:
+            raise ValueError(f"step_ms budget must be > 0, "
+                             f"got {self.step_ms}")
+        if self.consecutive < 1:
+            raise ValueError("consecutive must be >= 1")
+        for ph, ms in self.phase_ms:
+            if ms <= 0:
+                raise ValueError(f"phase budget {ph!r} must be > 0")
+
+    @property
+    def phase_budgets(self) -> dict:
+        return dict(self.phase_ms)
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "SLOConfig":
+        known = {"step_ms", "consecutive", "demote_backend", "phase_ms"}
+        unknown = set(raw) - known
+        if unknown:
+            raise ValueError(f"unknown SLO keys {sorted(unknown)}; "
+                             f"known: {sorted(known)}")
+        phases = raw.get("phase_ms") or {}
+        if not isinstance(phases, dict):
+            raise ValueError("phase_ms must be a mapping of "
+                             "phase -> budget ms")
+        phase_ms = []
+        for k, v in sorted(phases.items()):
+            try:
+                phase_ms.append((str(k), float(v)))
+            except (TypeError, ValueError):
+                raise ValueError(f"phase_ms[{k!r}] must be a number, "
+                                 f"got {v!r}") from None
+        cons = raw.get("consecutive")
+        try:
+            return cls(
+                step_ms=(float(raw["step_ms"])
+                         if raw.get("step_ms") is not None else None),
+                consecutive=int(cons) if cons is not None else 3,
+                demote_backend=raw.get("demote_backend") or None,
+                phase_ms=tuple(phase_ms),
+            )
+        except TypeError as e:
+            # a null/list where a scalar belongs: surface the documented
+            # ValueError instead of a bare TypeError
+            raise ValueError(f"bad SLO sidecar value: {e}") from None
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "SLOConfig":
+        """Load the YAML sidecar.  PyYAML when importable; otherwise a
+        minimal parser for the documented flat two-level schema (maps
+        of scalars, one nested ``phase_ms`` map)."""
+        with open(path) as f:
+            text = f.read()
+        try:
+            import yaml  # noqa: PLC0415 — optional dependency
+
+            raw = yaml.safe_load(text) or {}
+        except ImportError:
+            raw = _parse_flat_yaml(text)
+        if not isinstance(raw, dict):
+            raise ValueError(f"SLO sidecar {path!r} must be a mapping")
+        return cls.from_dict(raw)
+
+
+def _parse_flat_yaml(text: str) -> dict:
+    """Dependency-free subset parser: ``key: value`` lines, one level
+    of nesting for mapping values, ``#`` comments.  A bare ``key:``
+    with no indented children is YAML null (PyYAML parity), not an
+    empty mapping."""
+    out: dict = {}
+    current: tuple[str, dict] | None = None  # open (key, mapping)
+
+    def _close():
+        # a "key:" that gathered no children parses as null, exactly
+        # as PyYAML's safe_load would
+        nonlocal current
+        if current is not None and not current[1]:
+            out[current[0]] = None
+        current = None
+
+    for line in text.splitlines():
+        stripped = line.split("#", 1)[0].rstrip()
+        if not stripped.strip():
+            continue
+        indented = stripped.startswith((" ", "\t"))
+        key, sep, val = stripped.strip().partition(":")
+        if not sep:
+            raise ValueError(f"unparseable SLO line: {line!r}")
+        val = val.strip()
+        if indented:
+            if current is None:
+                raise ValueError(f"indented line outside a mapping: "
+                                 f"{line!r}")
+            current[1][key] = _scalar(val)
+        elif val == "":
+            _close()
+            current = (key, out.setdefault(key, {}))
+        else:
+            _close()
+            out[key] = _scalar(val)
+    _close()
+    return out
+
+
+def _scalar(v: str):
+    if v.lower() in ("null", "none", "~", ""):
+        return None
+    for cast in (int, float):
+        try:
+            return cast(v)
+        except ValueError:
+            pass
+    return v.strip("'\"")
+
+
+class SLOWatchdog:
+    """Feed it every step; it narrates budget compliance and escalates
+    sustained step-budget breaches into path demotion."""
+
+    def __init__(self, slo: SLOConfig, metrics: Metrics | None = None):
+        self.slo = slo
+        self.metrics = metrics if metrics is not None else _global
+        self._consecutive = 0           # step-budget breach run length
+        self._breached: set = set()     # targets currently in breach
+        self._escalated = False         # once per breach episode
+
+    @property
+    def consecutive_breaches(self) -> int:
+        return self._consecutive
+
+    def observe_step(self, step: int, step_ms: float,
+                     phases: dict | None = None) -> list[dict]:
+        """Compare one step (and optionally its phase breakdown)
+        against the budgets.  Returns the breach records raised this
+        step (empty = within budget)."""
+        events: list[dict] = []
+        targets: list[tuple[str, float, float]] = []
+        if self.slo.step_ms is not None:
+            targets.append(("step", float(step_ms), self.slo.step_ms))
+        if phases:
+            for ph, budget in self.slo.phase_budgets.items():
+                if ph in phases:
+                    targets.append((ph, float(phases[ph]), budget))
+
+        for target, measured, budget in targets:
+            if measured > budget:
+                if target == "step":
+                    self._consecutive += 1
+                self._breached.add(target)
+                self.metrics.count("slo.breaches")
+                rec = self.metrics.decision(
+                    "slo.breach", target=target, step=int(step),
+                    measured_ms=round(measured, 3),
+                    budget_ms=float(budget),
+                    consecutive=(self._consecutive
+                                 if target == "step" else None))
+                events.append(rec)
+            elif target in self._breached:
+                self._breached.discard(target)
+                if target == "step":
+                    self._consecutive = 0
+                    self._escalated = False
+                self.metrics.count("slo.recoveries")
+                self.metrics.decision(
+                    "slo.recovered", target=target, step=int(step),
+                    measured_ms=round(measured, 3),
+                    budget_ms=float(budget))
+            elif target == "step":
+                self._consecutive = 0
+                self._escalated = False
+
+        if (self._consecutive >= self.slo.consecutive
+                and not self._escalated):
+            self._escalated = True
+            self.metrics.count("slo.escalations")
+            if self.slo.demote_backend:
+                # sustained breach -> the PR 3 path-demotion machinery:
+                # the next 'auto' resolution re-plans off this backend
+                from flashmoe_tpu.planner.select import (
+                    report_path_failure,
+                )
+
+                report_path_failure(
+                    self.slo.demote_backend,
+                    f"slo: step budget {self.slo.step_ms} ms breached "
+                    f"{self._consecutive} consecutive steps "
+                    f"(last step {int(step)})")
+        return events

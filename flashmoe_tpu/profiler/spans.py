@@ -1,0 +1,247 @@
+"""Host-side span clock: per-step, per-phase wall durations without
+xprof.
+
+How it measures real time.  The EP shard bodies already wrap their
+phases in :func:`flashmoe_tpu.utils.telemetry.trace_span`.  When a
+:class:`PhaseTimeline` is armed (:func:`install` /
+:func:`profiling`) the spans report their host enter/exit instants
+here; and when ``MoEConfig.profile_phases`` is on, the bodies
+additionally call :func:`fence` on each phase's result.  Under *eager*
+execution (no ``jit``) a shard_map body's values are
+``ShardMapTracer``\\ s carrying concrete per-device arrays (``.val``),
+so the fence genuinely blocks until the phase's work has executed —
+the span exit instant is then device-complete time, and the per-step
+phase durations sum to the step's wall time
+(``tests/test_profiler.py`` asserts it).
+
+Under ``jit`` the same code traces once and the fences see abstract
+tracers: they no-op (nothing to block on), no op is added to the
+graph, and the traced jaxpr is byte-identical with the knob on or off
+— ``profile_phases`` is registered as a *graph-neutral* knob in the
+staticcheck registry and the invariant engine proves it.  Phase spans
+are only recorded while a step is open (:meth:`PhaseTimeline.
+begin_step`), so a timeline armed around a jitted training loop never
+collects trace-time garbage; the trainer's host-level *sections*
+(``train.data_pull`` / ``train.step`` / ``train.checkpoint`` /
+``train.drain``) are recorded regardless, because they are host work
+by definition.
+
+Everything here is host-side bookkeeping: with no timeline armed the
+fast paths are a single ``None`` check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+#: the armed timeline (one slot; host-side — profiling is a process
+#: activity, not a per-config one)
+_ACTIVE: list = [None]
+
+
+def active() -> "PhaseTimeline | None":
+    return _ACTIVE[0]
+
+
+def merged_phase(name: str) -> str:
+    """Canonical phase of a span name: chunked pipeline spans
+    (``moe.expert.3``) merge onto their base phase (``moe.expert``)."""
+    head, _, tail = name.rpartition(".")
+    return head if head and tail.isdigit() else name
+
+
+class PhaseTimeline:
+    """Collector for spans, host sections, per-step phase totals, and
+    counter samples — the substrate the cost ledger joins and the
+    Perfetto exporter renders.
+
+    ``spans``: every closed span/section, host-clock ``ts_ms``/
+    ``dur_ms`` relative to the timeline's birth.  ``steps``: one record
+    per :meth:`begin_step`/:meth:`end_step` window with the merged
+    per-phase totals.  ``counters``: (name, ts_ms, value) samples
+    (Perfetto counter tracks).  ``overlapped_ms``: optionally, the same
+    computation's *jitted* (overlap-scheduled) per-step time, set by
+    the ledger driver for the measured-overlap cross-check."""
+
+    def __init__(self, label: str = ""):
+        self.label = label
+        self.spans: list[dict] = []
+        self.steps: list[dict] = []
+        self.counters: list[dict] = []
+        self.sections: list[dict] = []
+        self.overlapped_ms: float | None = None
+        self.meta: dict = {}
+        self._birth = time.perf_counter()
+        self._cur: dict | None = None
+
+    # ---- clock --------------------------------------------------------
+
+    def _now_ms(self) -> float:
+        return (time.perf_counter() - self._birth) * 1e3  # staticcheck: ok host profiler clock, armed only around eager runs
+
+    # ---- steps --------------------------------------------------------
+
+    def begin_step(self, step: int) -> None:
+        """Open a profiled step: phase spans are only recorded while a
+        step is open (keeps jit TRACE-time spans out of the data)."""
+        self._cur = {"step": int(step), "t0_ms": self._now_ms(),
+                     "phases": {}, "wall_ms": None}
+
+    def end_step(self) -> dict:
+        rec = self._cur
+        if rec is None:
+            raise RuntimeError("end_step without begin_step")
+        rec["wall_ms"] = self._now_ms() - rec["t0_ms"]
+        rec["phases"] = {k: round(v, 6) for k, v in rec["phases"].items()}
+        self.steps.append(rec)
+        self._cur = None
+        return rec
+
+    # ---- span listener (telemetry.trace_span calls these) -------------
+
+    def span_enter(self, name: str):
+        if self._cur is None:
+            return None
+        from flashmoe_tpu.utils.compat import under_abstract_trace
+
+        if under_abstract_trace():
+            # a jaxpr-building trace (jit/make_jaxpr) is running: these
+            # span instants would be TRACE time, not run time — drop
+            # them, so a step opened around a jitted call stays clean.
+            # (An eager shard_map body is also "under a trace" but its
+            # values are concrete — those spans are kept.)
+            return None
+        return self._now_ms()
+
+    def span_exit(self, name: str, tok) -> None:
+        if tok is None or self._cur is None:
+            return
+        now = self._now_ms()
+        dur = now - tok
+        self.spans.append({
+            "name": name, "phase": merged_phase(name),
+            "ts_ms": round(tok, 6), "dur_ms": round(dur, 6),
+            "step": self._cur["step"], "kind": "phase",
+        })
+        ph = merged_phase(name)
+        self._cur["phases"][ph] = self._cur["phases"].get(ph, 0.0) + dur
+
+    # ---- host sections (trainer-level, jit-agnostic) -------------------
+
+    @contextlib.contextmanager
+    def section(self, name: str, step: int | None = None):
+        t0 = self._now_ms()
+        try:
+            yield
+        finally:
+            self.sections.append({
+                "name": name, "ts_ms": round(t0, 6),
+                "dur_ms": round(self._now_ms() - t0, 6),
+                "step": step, "kind": "section",
+            })
+
+    # ---- counters -----------------------------------------------------
+
+    def counter(self, name: str, value: float,
+                step: int | None = None) -> None:
+        self.counters.append({"name": name, "ts_ms": round(
+            self._now_ms(), 6), "value": float(value), "step": step})
+
+    # ---- summaries ----------------------------------------------------
+
+    def phase_means(self) -> dict[str, float]:
+        """Mean per-step duration of every merged phase (ms)."""
+        sums: dict[str, float] = {}
+        counts: dict[str, int] = {}
+        for rec in self.steps:
+            for ph, ms in rec["phases"].items():
+                sums[ph] = sums.get(ph, 0.0) + ms
+                counts[ph] = counts.get(ph, 0) + 1
+        return {ph: sums[ph] / counts[ph] for ph in sorted(sums)}
+
+    def step_wall_means(self) -> float | None:
+        if not self.steps:
+            return None
+        return sum(s["wall_ms"] for s in self.steps) / len(self.steps)
+
+    def step_records(self) -> list[dict]:
+        """Flight-recorder-shaped records: one per profiled step, with
+        the per-phase breakdown flattened to ``phase_ms.<name>``."""
+        out = []
+        for rec in self.steps:
+            flat = {"step": rec["step"],
+                    "step_ms": round(rec["wall_ms"], 6)}
+            for ph, ms in rec["phases"].items():
+                flat[f"phase_ms.{ph}"] = ms
+            out.append(flat)
+        return out
+
+
+# ----------------------------------------------------------------------
+# Arming
+# ----------------------------------------------------------------------
+
+def install(tl: PhaseTimeline) -> PhaseTimeline:
+    """Arm ``tl``: trace_span sites report to it and :func:`fence`
+    starts blocking.  One timeline at a time (profiling is a process
+    activity); re-installing replaces."""
+    from flashmoe_tpu.utils.telemetry import set_span_listener
+
+    _ACTIVE[0] = tl
+    set_span_listener(tl)
+    return tl
+
+
+def uninstall() -> None:
+    from flashmoe_tpu.utils.telemetry import set_span_listener
+
+    _ACTIVE[0] = None
+    set_span_listener(None)
+
+
+@contextlib.contextmanager
+def profiling(tl: PhaseTimeline | None = None):
+    """Arm a timeline for the duration of the block (and yield it)."""
+    tl = tl if tl is not None else PhaseTimeline()
+    install(tl)
+    try:
+        yield tl
+    finally:
+        uninstall()
+
+
+# ----------------------------------------------------------------------
+# Phase fencing
+# ----------------------------------------------------------------------
+
+def fence(x):
+    """Block until ``x``'s concrete leaves have executed — the phase
+    boundary of the profiled (eager) execution.  No timeline armed:
+    one ``None`` check and out.  Abstract tracers (a jitted trace of
+    the same code): nothing to block on, nothing recorded, the graph
+    is untouched — which is what keeps ``profile_phases`` graph-
+    neutral.  Returns ``x`` unchanged either way."""
+    if _ACTIVE[0] is None:
+        return x
+    import jax
+
+    from flashmoe_tpu.utils.compat import concrete_leaf
+
+    for leaf in jax.tree_util.tree_leaves(x):
+        # eager shard_map values are tracer onions (RewriteTracer over
+        # ShardMapTracer) whose .val chain bottoms out at the concrete
+        # per-device stack; plain arrays block directly
+        v = concrete_leaf(leaf)
+        if v is not None:
+            v.block_until_ready()
+    return x
+
+
+def section(name: str, step: int | None = None):
+    """A host section on the armed timeline, or a no-op context when
+    nothing is armed (the trainer calls this every step)."""
+    tl = _ACTIVE[0]
+    if tl is None:
+        return contextlib.nullcontext()
+    return tl.section(name, step=step)

@@ -218,7 +218,8 @@ def resilient_train(state: TrainState, step_fn: Callable,
                     rcfg: ResilienceConfig | None = None,
                     metrics: Metrics | None = None,
                     fail_injector: Callable | None = None,
-                    preempt=None):
+                    preempt=None, slo=None,
+                    postmortem_dir: str | None = None, cfg=None):
     """Run ``num_steps`` with detection + restore-and-retry recovery.
 
     ``step_fn(state, batch) -> (state, metrics_dict)`` — e.g. from
@@ -236,12 +237,27 @@ def resilient_train(state: TrainState, step_fn: Callable,
     every checkpoint manifest and is restored on resume — the continued
     run consumes the exact token stream of an uninterrupted one.
 
+    ``slo``: an :class:`flashmoe_tpu.profiler.slo.SLOConfig` / prebuilt
+    watchdog — every successful step's wall time is judged against the
+    step budget (``slo.breach`` decisions; sustained breaches escalate
+    into planner path demotion).  ``postmortem_dir``: when in-job
+    recovery gives up (the :class:`StepFailure` raise), a crash
+    postmortem bundle (:mod:`flashmoe_tpu.profiler.postmortem`) is
+    written there — flight history, decisions, config (``cfg`` when
+    provided), env, traceback — for
+    ``python -m flashmoe_tpu.observe --postmortem``.  In-job recoveries
+    and graceful drains never write one: a bundle means a death.
+
     Returns (state, history).  Raises :class:`StepFailure` after
     ``max_retries`` consecutive failures on one step (after a best-effort
     emergency checkpoint of the last good state).
     """
+    from flashmoe_tpu.profiler import spans as prof
+    from flashmoe_tpu.runtime.trainer import _as_watchdog
+
     rcfg = rcfg or ResilienceConfig()
     metrics = metrics or Metrics()
+    watchdog = _as_watchdog(slo)
     history = []
 
     # resume if a checkpoint exists
@@ -292,11 +308,12 @@ def resilient_train(state: TrainState, step_fn: Callable,
                 # graceful drain: the in-flight step already finished
                 # (the flag is polled between steps); make everything
                 # durable and hand control back before the hard kill
-                ckpt.wait_for_saves()
-                if ckpt.latest_step(rcfg.checkpoint_dir) != i:
-                    ckpt.save(rcfg.checkpoint_dir, state, step=i,
-                              loader_state=replay.loader_state_for(i))
-                    metrics.count("checkpoints")
+                with prof.section("train.drain", step=i):
+                    ckpt.wait_for_saves()
+                    if ckpt.latest_step(rcfg.checkpoint_dir) != i:
+                        ckpt.save(rcfg.checkpoint_dir, state, step=i,
+                                  loader_state=replay.loader_state_for(i))
+                        metrics.count("checkpoints")
                 metrics.count("preempt_drains")
                 metrics.decision(
                     "preempt.drain", step=i, source=preempt.source,
@@ -304,13 +321,22 @@ def resilient_train(state: TrainState, step_fn: Callable,
                 return state, history
             # replay-exact data: a rewound step gets the batch its failed
             # attempt consumed, not the iterator's next fresh one
-            batch = replay.batch_for(i)
+            with prof.section("train.data_pull", step=i):
+                batch = replay.batch_for(i)
             try:
                 if fail_injector is not None:
                     fail_injector(i)
                 t0 = time.perf_counter()
-                new_state, m = _run_step(step_fn, state, batch,
-                                         rcfg.step_timeout_s, ex_box)
+                tl = prof.active()
+                if tl is not None:
+                    # armed timeline: per-step record; any eager fenced
+                    # phases measured inside feed the SLO phase budgets
+                    tl.begin_step(i)
+                with prof.section("train.step", step=i):
+                    new_state, m = _run_step(step_fn, state, batch,
+                                             rcfg.step_timeout_s, ex_box)
+                step_phases = (tl.end_step()["phases"]
+                               if tl is not None else None)
                 loss = _step_loss(m)
                 if loss is not None and not np.isfinite(loss):
                     raise StepFailure(
@@ -393,7 +419,13 @@ def resilient_train(state: TrainState, step_fn: Callable,
                 retries = 0
             state = new_state
             metrics.count("steps")
-            metrics.times["step"].append(time.perf_counter() - t0)
+            step_s = time.perf_counter() - t0
+            metrics.times["step"].append(step_s)
+            if watchdog is not None:
+                # SLO watchdog: sustained step-budget breaches escalate
+                # into planner path demotion (slo.breach decisions)
+                watchdog.observe_step(i, step_s * 1e3,
+                                      phases=step_phases)
             rec = scalar_metrics(m)
             if rec.get("grad_ok", 1.0) == 0.0:
                 # tier-1 guard fired inside the step: the update was
@@ -406,9 +438,10 @@ def resilient_train(state: TrainState, step_fn: Callable,
             history.append(rec)
             i += 1
             if i % rcfg.checkpoint_every == 0 or i == num_steps:
-                ckpt.save(rcfg.checkpoint_dir, state, step=i,
-                          blocking=not rcfg.async_save,
-                          loader_state=replay.loader_state_for(i))
+                with prof.section("train.checkpoint", step=i):
+                    ckpt.save(rcfg.checkpoint_dir, state, step=i,
+                              blocking=not rcfg.async_save,
+                              loader_state=replay.loader_state_for(i))
                 ckpt_boundaries.append(i)
                 durable = ckpt.latest_step(rcfg.checkpoint_dir)
                 # free the host mirror only once a checkpoint is DURABLE
@@ -443,6 +476,18 @@ def resilient_train(state: TrainState, step_fn: Callable,
         # (their losses/grad norms are the postmortem); hand them to the
         # caller on the exception instead of dropping them
         e.partial_history = list(history)
+        if postmortem_dir:
+            # in-job recovery gave up — the real process would be dead.
+            # Freeze everything a triage needs into a bundle dir (best-
+            # effort: the writer never masks the failure it documents).
+            from flashmoe_tpu.profiler import postmortem as pm
+
+            bundle = pm.write_bundle(
+                postmortem_dir, error=e, cfg=cfg, metrics_obj=metrics,
+                history=history, step=i,
+                extra={"retries": retries, "num_steps": num_steps})
+            if bundle is not None:
+                e.postmortem_bundle = bundle
         raise
     finally:
         if ex_box[0] is not None:
@@ -455,7 +500,8 @@ def supervise(cfg, data_factory: Callable, num_steps: int,
               preempt=None, devices_fn: Callable | None = None,
               max_restarts: int = 3, fail_injector: Callable | None = None,
               step_wrapper: Callable | None = None, seed: int = 0,
-              use_pallas: bool | None = None):
+              use_pallas: bool | None = None, slo=None,
+              postmortem_dir: str | None = None):
     """Job-level restart loop: run to ``num_steps`` across preemptions,
     crashes, and world-size changes.
 
@@ -475,6 +521,10 @@ def supervise(cfg, data_factory: Callable, num_steps: int,
     each incarnation's loader; make it a stateful
     :class:`flashmoe_tpu.runtime.data.TokenLoader` for deterministic
     data resume.  ``step_wrapper`` wraps the jitted step (chaos stalls).
+    ``slo`` / ``postmortem_dir`` ride through to
+    :func:`resilient_train`; additionally every SUPERVISOR-level death
+    (incarnation-budget exhaustion, refusing-to-spin) writes its own
+    postmortem bundle — a clean drain or a successful restart does not.
 
     Returns (state, history) with history concatenated over
     incarnations (re-run steps appear once per execution, like
@@ -496,11 +546,22 @@ def supervise(cfg, data_factory: Callable, num_steps: int,
     # drains don't consume the restart budget, but a notice source stuck
     # on "always preempted" must not loop forever either
     max_incarnations = max(8, 4 * (max_restarts + 1))
+    def _bundle(err):
+        if postmortem_dir:
+            from flashmoe_tpu.profiler import postmortem as pm
+
+            pm.write_bundle(postmortem_dir, error=err, cfg=cfg,
+                            metrics_obj=metrics, history=history,
+                            extra={"incarnation": incarnation,
+                                   "restarts": restarts})
+
     while True:
         if incarnation >= max_incarnations:
-            raise StepFailure(
+            e = StepFailure(
                 f"supervisor exceeded {max_incarnations} incarnations "
                 f"without reaching step {num_steps}")
+            _bundle(e)
+            raise e
         devices = list(devices_fn() if devices_fn is not None
                        else jax.devices())
         if ckpt.latest_step(rcfg.checkpoint_dir) is not None:
@@ -532,7 +593,8 @@ def supervise(cfg, data_factory: Callable, num_steps: int,
             state, hist = resilient_train(
                 state, step_fn, data, num_steps, rcfg=rcfg,
                 metrics=metrics, fail_injector=fail_injector,
-                preempt=preempt)
+                preempt=preempt, slo=slo, postmortem_dir=postmortem_dir,
+                cfg=fcfg)
             history.extend(hist)
         except StepFailure as e:
             # in-job recovery exhausted: the real process would be dead.
@@ -554,6 +616,8 @@ def supervise(cfg, data_factory: Callable, num_steps: int,
             preempt.clear()
             metrics.count("preempt_restarts")
             continue
-        raise StepFailure(
+        e = StepFailure(
             f"incarnation ended at step {int(state.step)} of {num_steps} "
             f"with no drain and no failure — refusing to spin")
+        _bundle(e)
+        raise e

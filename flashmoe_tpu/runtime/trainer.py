@@ -250,7 +250,9 @@ def train(cfg: MoEConfig, mesh: Mesh, data_iter, num_steps: int,
           use_pallas: bool | None = None,
           recorder: "FlightRecorder | None" = None,
           flight_path: str | None = None,
-          guard: GradGuardConfig | None = None):
+          flight_flush_every: int = 0,
+          guard: GradGuardConfig | None = None,
+          slo=None):
     """Simple host training loop (see runtime.worker for the CLI).
 
     ``recorder``: a :class:`flashmoe_tpu.utils.telemetry.FlightRecorder`
@@ -259,9 +261,26 @@ def train(cfg: MoEConfig, mesh: Mesh, data_iter, num_steps: int,
     exported there when the loop ends — the artifact
     ``python -m flashmoe_tpu.observe`` summarizes.  Set
     ``cfg.collect_stats`` to include the in-graph MoE stats per record.
+
+    ``flight_flush_every``: > 0 flushes the recorder to ``flight_path``
+    every that many steps via the OFFSET-AWARE append mode
+    (:meth:`FlightRecorder.export_jsonl` with ``start``), so records
+    that rotate out of the bounded ring between flushes are already on
+    disk — the legacy end-of-run snapshot silently discarded them.
+
+    ``slo``: a :class:`flashmoe_tpu.profiler.slo.SLOConfig` (or a
+    prebuilt :class:`~flashmoe_tpu.profiler.slo.SLOWatchdog`): every
+    step's wall time is judged against the step budget (``slo.breach`` /
+    ``slo.recovered`` decisions, consecutive-breach escalation into
+    planner path demotion).  Arming an SLO times every step.
+
+    When a profiler timeline is armed (:func:`flashmoe_tpu.profiler.
+    spans.profiling`), the loop's host work is recorded as
+    ``train.data_pull`` / ``train.step`` sections.
     """
     import time
 
+    from flashmoe_tpu.profiler import spans as prof
     from flashmoe_tpu.utils.telemetry import FlightRecorder, metrics as tm
 
     key = key if key is not None else jax.random.PRNGKey(0)
@@ -274,36 +293,75 @@ def train(cfg: MoEConfig, mesh: Mesh, data_iter, num_steps: int,
                            guard=guard)
     if flight_path is not None and recorder is None:
         recorder = FlightRecorder()
+    watchdog = _as_watchdog(slo)
     history = []
+    flushed = 0  # offset-aware export cursor (absolute record index)
     for i in range(num_steps):
-        batch = next(data_iter)
+        with prof.section("train.data_pull", step=i):
+            batch = next(data_iter)
         log_step = i % log_every == 0 or i == num_steps - 1
-        if recorder is not None or log_step:
+        tl = prof.active()
+        if recorder is not None or log_step or watchdog is not None \
+                or tl is not None:
             # block before reading the clock: jit dispatch is async, so
             # an unsynchronized timer would record ~0 host-dispatch ms.
             # With a recorder every step is timed exactly; log-only runs
             # time the logged step plus whatever backlog drained with it.
             t0 = time.perf_counter()
-            state, metrics = step(state, batch)
-            jax.block_until_ready(metrics)
+            if tl is not None:
+                # an armed timeline gets per-step records; any phases
+                # measured inside (eager fenced runs — under jit the
+                # phase dict stays empty) feed the SLO phase budgets
+                tl.begin_step(i)
+            with prof.section("train.step", step=i):
+                state, metrics = step(state, batch)
+                jax.block_until_ready(metrics)
+            phases = tl.end_step()["phases"] if tl is not None else None
             step_ms = (time.perf_counter() - t0) * 1e3
-            rec = host_metrics(metrics, moe_layers=cfg.moe_layer_indices)
-            rec["step_ms"] = step_ms
             # bounded: the histogram aggregates, no per-step list grows
             tm.histogram("trainer.step_ms", step_ms)
-            if rec.get("grad_ok", 1.0) == 0.0:
-                # tier-1 guard fired: the skipped update is a structured
-                # decision so a postmortem can answer "which steps were
-                # dropped and why" without replaying the run
-                tm.decision("trainer.grad_skip", step=i,
-                            grad_norm=rec.get("grad_norm"),
-                            grad_norm_ema=rec.get("grad_norm_ema"))
-            if recorder is not None:
-                recorder.record(step=i, **rec)
-            if log_step:
-                history.append(rec)
+            if watchdog is not None:
+                watchdog.observe_step(i, step_ms, phases=phases)
+            if recorder is not None or log_step:
+                # the full device->host metrics pull (per-layer MoEStats
+                # when collect_stats is on) only happens when someone
+                # consumes it; a watchdog alone needs just step_ms
+                rec = host_metrics(metrics,
+                                   moe_layers=cfg.moe_layer_indices)
+                rec["step_ms"] = step_ms
+                if rec.get("grad_ok", 1.0) == 0.0:
+                    # tier-1 guard fired: the skipped update is a
+                    # structured decision so a postmortem can answer
+                    # "which steps were dropped and why" without
+                    # replaying the run
+                    tm.decision("trainer.grad_skip", step=i,
+                                grad_norm=rec.get("grad_norm"),
+                                grad_norm_ema=rec.get("grad_norm_ema"))
+                if recorder is not None:
+                    recorder.record(step=i, **rec)
+                    if flight_path is not None and flight_flush_every > 0 \
+                            and (i + 1) % flight_flush_every == 0:
+                        flushed = recorder.export_jsonl(flight_path,
+                                                        start=flushed)
+                if log_step:
+                    history.append(rec)
         else:
-            state, metrics = step(state, batch)
+            with prof.section("train.step", step=i):
+                state, metrics = step(state, batch)
     if flight_path is not None and recorder is not None:
-        recorder.export_jsonl(flight_path)
+        if flight_flush_every > 0:
+            recorder.export_jsonl(flight_path, start=flushed)
+        else:
+            recorder.export_jsonl(flight_path)
     return state, history
+
+
+def _as_watchdog(slo):
+    """Accept an SLOConfig, a prebuilt SLOWatchdog, or None."""
+    if slo is None:
+        return None
+    from flashmoe_tpu.profiler.slo import SLOConfig, SLOWatchdog
+
+    if isinstance(slo, SLOConfig):
+        return SLOWatchdog(slo)
+    return slo

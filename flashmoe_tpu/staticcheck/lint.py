@@ -1,6 +1,6 @@
 """AST lint pass over ``flashmoe_tpu/`` and ``tests/``.
 
-Four rule families, all pure AST — no imports of the heavy modules, no
+Five rule families, all pure AST — no imports of the heavy modules, no
 pytest-in-pytest:
 
 * **in-graph hygiene** — functions that end up inside a trace (bodies
@@ -16,10 +16,16 @@ pytest-in-pytest:
   declared in ``utils/telemetry.py:DECISION_NAMES``; a typo'd name used
   to vanish silently into JSONL.  Non-literal names are flagged too:
   the registry cannot vouch for a name it cannot see.
+* **span-name registry** — the same contract for phase spans: every
+  literal handed to ``trace_span(...)`` / a profiler ``section(...)``
+  must be declared in ``utils/telemetry.py:SPAN_NAMES`` (chunked
+  pipeline f-strings must start with a registered base + ``.``) — a
+  typo'd span silently forks the phase timeline the cost ledger joins.
 * **doc sync** — every registered decision name must appear in
   docs/OBSERVABILITY.md, and every name in that doc's decision table
   must be registered (the table is generated from the registry:
-  ``telemetry.decision_table_markdown``).
+  ``telemetry.decision_table_markdown``); span names likewise
+  (``telemetry.span_table_markdown``).
 * **slow-mark budget guard** — migrated from tests/test_collection.py
   (which now thinly wraps this engine): tests that run chaos drills
   (any test file) or execute shard_map MoE layers (files listed in
@@ -255,8 +261,115 @@ def check_decision_names(files=None) -> list[Violation]:
     return out
 
 
+# ---------------------------------------------------------------------
+# span-name registry rule
+# ---------------------------------------------------------------------
+
+def _span_base(name: str) -> str:
+    """Chunked pipeline spans carry a numeric suffix
+    (``moe.expert.3``) — merge onto the registered base.  Delegates to
+    :func:`flashmoe_tpu.profiler.spans.merged_phase` so the lint and
+    the timeline can never disagree on the suffix convention."""
+    from flashmoe_tpu.profiler.spans import merged_phase
+
+    return merged_phase(name)
+
+
+def check_span_names(files=None) -> list[Violation]:
+    """Every literal handed to ``trace_span(...)`` or a profiler
+    ``section(...)`` must be declared in
+    ``utils/telemetry.py:SPAN_NAMES`` — a misspelled span silently
+    forks the phase timeline the cost ledger joins on.  F-string spans
+    (the chunked pipeline's ``f"moe.expert.{ck}"``) must start with a
+    registered base followed by ``.``; a wholly computed name on
+    ``trace_span`` is flagged (waivable) because the registry cannot
+    vouch for it.  Non-literal ``section`` calls — plain variables and
+    f-strings without a registered literal base — are skipped: the
+    name is too generic to attribute (the profiler's own dispatcher
+    forwards a variable)."""
+    from flashmoe_tpu.utils.telemetry import SPAN_NAMES
+
+    out = []
+    if files is None:
+        files = list(_iter_py(PKG_DIR)) + list(_iter_py(TESTS_DIR))
+
+    def unregistered(rel, lineno, name):
+        out.append(Violation(
+            "lint", "span-name", f"{rel}:{lineno}",
+            f"span name {name!r} is not declared in "
+            f"utils/telemetry.py:SPAN_NAMES — a typo'd span forks the "
+            f"phase timeline; register it (with a one-line meaning), "
+            f"fix the spelling, or waive with '{WAIVER} <reason>'"))
+
+    for path in files:
+        rel = os.path.relpath(path, REPO_ROOT)
+        tree, lines = _parse(path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            f = node.func
+            attr = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if attr not in ("trace_span", "section"):
+                continue
+            line = lines[node.lineno - 1] if node.lineno <= len(
+                lines) else ""
+            if WAIVER in line:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                            str):
+                if _span_base(arg.value) not in SPAN_NAMES:
+                    unregistered(rel, node.lineno, arg.value)
+            elif isinstance(arg, ast.JoinedStr):
+                head = arg.values[0] if arg.values else None
+                if isinstance(head, ast.Constant) and isinstance(
+                        head.value, str) and head.value.endswith("."):
+                    if head.value[:-1] not in SPAN_NAMES:
+                        unregistered(rel, node.lineno, head.value + "*")
+                elif attr == "trace_span":
+                    # section() f-strings without a literal base are
+                    # skipped like other non-literal section names —
+                    # the documented contract only binds trace_span
+                    out.append(Violation(
+                        "lint", "span-name", f"{rel}:{node.lineno}",
+                        "f-string span must start with a registered "
+                        "base name followed by '.' (chunk-suffix "
+                        "convention) — the registry cannot vouch for "
+                        "a computed prefix"))
+            elif attr == "trace_span":
+                out.append(Violation(
+                    "lint", "span-name", f"{rel}:{node.lineno}",
+                    "non-literal span name: the registry cannot vouch "
+                    "for a computed name — pass a registered literal "
+                    f"(or waive with '{WAIVER} <reason>')"))
+    return out
+
+
+def check_span_doc_sync() -> list[Violation]:
+    """Every registered span name must appear in docs/OBSERVABILITY.md
+    (the span table is generated from the registry:
+    ``telemetry.span_table_markdown``)."""
+    from flashmoe_tpu.utils.telemetry import SPAN_NAMES
+
+    if not os.path.exists(OBS_DOC):
+        return [Violation("lint", "span-doc", "docs/OBSERVABILITY.md",
+                          "document is missing")]
+    with open(OBS_DOC) as f:
+        doc = f.read()
+    out = []
+    for name in sorted(SPAN_NAMES):
+        if f"`{name}`" not in doc:
+            out.append(Violation(
+                "lint", "span-doc", name,
+                "registered span name is absent from "
+                "docs/OBSERVABILITY.md — regenerate the table with "
+                "telemetry.span_table_markdown()"))
+    return out
+
+
 def check_decision_doc_sync() -> list[Violation]:
-    from flashmoe_tpu.utils.telemetry import DECISION_NAMES
+    from flashmoe_tpu.utils.telemetry import DECISION_NAMES, SPAN_NAMES
 
     out = []
     if not os.path.exists(OBS_DOC):
@@ -273,11 +386,13 @@ def check_decision_doc_sync() -> list[Violation]:
                 "telemetry.decision_table_markdown()"))
     for name in re.findall(r"^\| `([a-z_]+\.[a-z_.]+)` \|", doc,
                            re.MULTILINE):
-        if name not in DECISION_NAMES:
+        # dotted table rows are either decisions or spans (the span
+        # table of the phase profiler shares the doc)
+        if name not in DECISION_NAMES and name not in SPAN_NAMES:
             out.append(Violation(
                 "lint", "decision-doc", name,
-                "documented decision name is not registered in "
-                "DECISION_NAMES (stale doc row?)"))
+                "documented dotted name is registered neither in "
+                "DECISION_NAMES nor SPAN_NAMES (stale doc row?)"))
     return out
 
 
@@ -420,11 +535,14 @@ def run_lint(paths=None) -> list[Violation]:
     if paths is not None:
         files = [os.path.abspath(p) for p in paths]
         out.extend(check_decision_names(files))
+        out.extend(check_span_names(files))
         out.extend(check_in_graph(files))
         return out
     out.extend(check_slow_marks())
     out.extend(slow_mark_selfcheck())
     out.extend(check_decision_names())
     out.extend(check_decision_doc_sync())
+    out.extend(check_span_names())
+    out.extend(check_span_doc_sync())
     out.extend(check_in_graph())
     return out

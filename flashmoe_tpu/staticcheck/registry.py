@@ -143,6 +143,14 @@ KNOBS: tuple[KnobSpec, ...] = (
         doc="inference kernel-entry selector; on the XLA oracle path "
             "(use_pallas=False) every value traces to the identical "
             "graph — the knob only swaps Pallas kernel entries"),
+    KnobSpec(
+        "profile_phases", off_values=(False,),
+        on={"profile_phases": True}, changes_graph=False,
+        doc="host-side phase-fence clock (flashmoe_tpu/profiler/): the "
+            "fences block on concrete eager values only and no-op on "
+            "tracers, so BOTH values trace the byte-identical graph on "
+            "every backend — off is bit-identical by construction and "
+            "on costs nothing under jit"),
 )
 
 KNOBS_BY_NAME = {k.name: k for k in KNOBS}

@@ -34,3 +34,61 @@ def axis_size(axis_name) -> int:
     if hasattr(jax.lax, "axis_size"):
         return jax.lax.axis_size(axis_name)
     return jax.lax.psum(1, axis_name)
+
+
+#: trace classes that build a jaxpr instead of executing — values under
+#: them are abstract, so host-clock instants taken there are TRACE time
+_ABSTRACT_TRACE_NAMES = frozenset(
+    {"DynamicJaxprTrace", "JaxprTrace", "DynamicJaxprTrace2"})
+
+
+def under_abstract_trace() -> bool:
+    """True when an abstract (jaxpr-building) trace is active on this
+    thread — i.e. the code is being TRACED by ``jit``/``make_jaxpr``,
+    not executed.  ``jax.core.trace_state_clean()`` alone cannot answer
+    this: an *eager* ``shard_map`` body also runs under a trace
+    (ShardMapTrace, plus a RewriteTrace for the replication check), but
+    its values are concrete per-device arrays and its wall clock is
+    real execution time.  Walks the ``parent_trace`` chain looking for
+    a jaxpr-building trace; unknown machinery (no chain to walk while
+    a trace is active) is conservatively reported abstract."""
+    import jax.core as jax_core
+
+    try:
+        if jax_core.trace_state_clean():
+            return False
+    except Exception:  # pragma: no cover - ancient jax
+        return False
+    try:
+        from jax._src.core import trace_ctx
+
+        trace = trace_ctx.trace
+    except Exception:  # pragma: no cover - trace machinery moved again
+        return True
+    hops = 0
+    while trace is not None and hops < 16:
+        if type(trace).__name__ in _ABSTRACT_TRACE_NAMES:
+            return True
+        trace = getattr(trace, "parent_trace", None)
+        hops += 1
+    return False
+
+
+def concrete_leaf(leaf):
+    """The concrete array under ``leaf``, or ``None`` if it is abstract.
+
+    Eager shard_map values arrive as tracer onions —
+    ``RewriteTracer(ShardMapTracer(ArrayImpl))`` — whose ``.val`` chain
+    bottoms out at a blockable concrete array; under an abstract trace
+    the chain ends at a valueless tracer instead."""
+    v = leaf
+    hops = 0
+    while v is not None and hops < 16:
+        try:
+            if hasattr(v, "block_until_ready"):
+                return v
+            v = getattr(v, "val", None)
+        except Exception:  # noqa: BLE001 — tracer attr access can raise
+            return None
+        hops += 1
+    return None

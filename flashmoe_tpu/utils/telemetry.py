@@ -66,11 +66,60 @@ DECISION_NAMES: dict[str, str] = {
         "graceful drain completed: final step, remaining grace",
     "preempt.notice":
         "a preemption notice arrived (signal source, grace budget)",
+    "planner.phase_drift":
+        "one MoE phase's measured time compared against its prediction",
+    "postmortem.saved":
+        "a crash postmortem bundle was written (dir, error, step)",
+    "slo.breach":
+        "a step/phase time exceeded its SLO budget",
+    "slo.recovered":
+        "a breached SLO target returned under budget",
     "supervisor.resume":
         "a restart resumed: incarnation, step, world size, ep x dp",
     "trainer.grad_skip":
         "tier 1 skipped an anomalous update in-graph",
 }
+
+#: Central span-name registry — the trace_span / profiler-section
+#: analogue of :data:`DECISION_NAMES`.  Every literal handed to
+#: :func:`trace_span` or to a profiler ``section(...)`` must be declared
+#: here (chunked pipeline spans append a numeric suffix to a registered
+#: base: ``moe.expert.3``); the staticcheck lint
+#: (``python -m flashmoe_tpu.staticcheck --lint``) flags typo'd or
+#: computed literals, because a misspelled span silently forks the phase
+#: timeline the cost ledger joins on.  The docs/OBSERVABILITY.md span
+#: table is generated from this dict (:func:`span_table_markdown`).
+SPAN_NAMES: dict[str, str] = {
+    "moe.gate": "router: logits, top-k selection, aux losses",
+    "moe.dispatch": "scatter tokens into the exchange layout",
+    "moe.a2a_dispatch":
+        "dispatch all-to-all (``.k`` suffix = pipeline chunk k)",
+    "moe.expert": "expert FFN on received rows (``.k`` = chunk k)",
+    "moe.a2a_combine":
+        "return all-to-all (``.k`` suffix = pipeline chunk k)",
+    "moe.combine": "weighted gather back to token order",
+    "moe.fused_kernel": "fused RDMA kernel (dispatch+FFN in one launch)",
+    "train.data_pull": "host wait on the data iterator",
+    "train.step": "one train step: dispatch + device execution",
+    "train.checkpoint": "checkpoint save on the step loop",
+    "train.drain": "graceful preemption drain (final save + cursor)",
+}
+
+
+def register_span(name: str, meaning: str) -> None:
+    """Declare a span name at runtime (plugins / experiments).  Repo
+    code should add to :data:`SPAN_NAMES` directly so the static lint
+    and the docs table see it."""
+    SPAN_NAMES[name] = meaning
+
+
+def span_table_markdown() -> str:
+    """The docs/OBSERVABILITY.md span table, generated from the
+    registry (the staticcheck doc-sync rule keeps the doc aligned)."""
+    lines = ["| span | meaning |", "|------|---------|"]
+    for name in sorted(SPAN_NAMES):
+        lines.append(f"| `{name}` | {SPAN_NAMES[name]} |")
+    return "\n".join(lines)
 
 
 def register_decision(name: str, meaning: str) -> None:
@@ -90,12 +139,35 @@ def decision_table_markdown() -> str:
     return "\n".join(lines)
 
 
+#: Active span listener (one slot): an object with ``span_enter(name)
+#: -> token`` / ``span_exit(name, token)``, installed by the phase
+#: profiler (:mod:`flashmoe_tpu.profiler.spans`) while a timeline is
+#: armed.  ``None`` (default) keeps :func:`trace_span` exactly the
+#: metadata-only context manager it always was.
+_SPAN_LISTENER: list = [None]
+
+
+def set_span_listener(listener) -> None:
+    """Install (or, with ``None``, remove) the span listener the phase
+    profiler uses to turn trace_span sites into a host-side timeline."""
+    _SPAN_LISTENER[0] = listener
+
+
 @contextlib.contextmanager
 def trace_span(name: str):
-    """Named scope visible in xprof traces and HLO metadata."""
-    with jax.profiler.TraceAnnotation(name):
-        with jax.named_scope(name):
-            yield
+    """Named scope visible in xprof traces and HLO metadata.  When a
+    phase-profiler timeline is armed (:func:`set_span_listener`), the
+    span's host enter/exit instants are additionally recorded — the
+    xprof-free phase timeline of :mod:`flashmoe_tpu.profiler`."""
+    lst = _SPAN_LISTENER[0]
+    tok = lst.span_enter(name) if lst is not None else None
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            with jax.named_scope(name):
+                yield
+    finally:
+        if lst is not None:
+            lst.span_exit(name, tok)
 
 
 def start_trace(log_dir: str):
@@ -188,6 +260,7 @@ class FlightRecorder:
             except ValueError:
                 capacity = 1024
         self._buf: deque = deque(maxlen=max(1, int(capacity)))
+        self._total = 0  # records ever recorded (ring wraps don't reset)
 
     @property
     def capacity(self) -> int:
@@ -197,21 +270,58 @@ class FlightRecorder:
     def records(self) -> list[dict]:
         return list(self._buf)
 
+    @property
+    def total_recorded(self) -> int:
+        """Records ever recorded, including ones the ring has dropped —
+        the absolute-index space the offset-aware export speaks."""
+        return self._total
+
     def __len__(self) -> int:
         return len(self._buf)
 
     def record(self, **fields) -> dict:
         rec = dict(fields)
         self._buf.append(rec)
+        self._total += 1
         return rec
 
-    def export_jsonl(self, path: str) -> int:
-        """Write every retained record, one JSON object per line.
-        Returns the number written."""
-        with open(path, "w") as f:
-            for rec in self._buf:
-                f.write(json.dumps(rec) + "\n")
-        return len(self._buf)
+    def export_jsonl(self, path: str, start: int | None = None,
+                     metrics_obj: "Metrics | None" = None) -> int:
+        """Export records as JSONL.
+
+        ``start=None`` (legacy): snapshot — truncate ``path`` and write
+        every record the ring still holds; returns the count written.
+
+        ``start=<int>``: offset-aware export (the
+        :meth:`Metrics.dump_decisions_jsonl` convention): write every
+        record with absolute index >= ``start`` that the ring still
+        holds, and return the total record count — the next call's
+        ``start``.  ``start == 0`` (the cursor's initial value) starts
+        a FRESH file, so a stale artifact from an earlier run never
+        contaminates this one; ``start > 0`` appends.  A periodic
+        flusher passing the previous return value therefore writes each
+        record exactly once, and records that rotate out of the bounded
+        ring BETWEEN flushes are already on disk instead of silently
+        discarded (the mode-"w" data-loss bug this closes).  Records
+        that rotated out before ever being flushed are unrecoverable;
+        the gap is counted as ``flight.export_lost`` in ``metrics_obj``
+        (the global stream by default) so the loss is visible."""
+        if start is None:
+            with open(path, "w") as f:
+                for rec in self._buf:
+                    f.write(json.dumps(rec) + "\n")
+            return len(self._buf)
+        oldest = self._total - len(self._buf)  # abs index of buf[0]
+        lost = max(0, oldest - max(start, 0))
+        if lost:
+            sink = metrics_obj if metrics_obj is not None else metrics
+            sink.count("flight.export_lost", lost)
+        first = max(start - oldest, 0)
+        with open(path, "w" if start <= 0 else "a") as f:
+            for i, rec in enumerate(self._buf):
+                if i >= first:
+                    f.write(json.dumps(rec) + "\n")
+        return self._total
 
 
 def _prom_name(name: str) -> str:

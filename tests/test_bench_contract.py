@@ -112,6 +112,45 @@ def test_wire_fields_in_records():
     assert one["wire_modeled_comm_saved_mb"] == 0.0
 
 
+def test_cli_profile_plumbs_ledger_matrix(monkeypatch, capsys, tmp_path):
+    """`bench.py --profile` is the CLI face of
+    profiler.ledger.run_ledger_matrix (which test_profiler gates end to
+    end): the arg plumbing must hand it the obs dir / quick / steps
+    flags, print each returned record as a JSON line, and mirror it
+    into the --obs-dir artifacts."""
+    import sys as _sys
+
+    import __graft_entry__
+    import bench
+    from flashmoe_tpu.profiler import ledger
+
+    seen = {}
+
+    def fake_matrix(obs_dir, *, quick=False, steps=1, devices=None,
+                    **kw):
+        seen.update(obs_dir=obs_dir, quick=quick, steps=steps,
+                    n_devices=len(devices or []))
+        return [{"metric": "phase_ledger[flat,chunks=1,wire=off]",
+                 "value": 1.25, "unit": "ms", "path": "flat"}]
+
+    monkeypatch.setattr(ledger, "run_ledger_matrix", fake_matrix)
+    monkeypatch.setattr(__graft_entry__, "_force_cpu_devices",
+                        lambda n: None)
+    obs = tmp_path / "obs"
+    monkeypatch.setattr(_sys, "argv",
+                        ["bench.py", "--profile-quick", "--profile-steps",
+                         "3", "--obs-dir", str(obs), "--deadline", "0"])
+    bench.main()
+    assert seen["obs_dir"] == str(obs)
+    assert seen["quick"] is True and seen["steps"] == 3
+    assert seen["n_devices"] >= 1
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"].startswith("phase_ledger[")
+    mirrored = [json.loads(line) for line in
+                (obs / "bench_records.jsonl").read_text().splitlines()]
+    assert mirrored == [rec]
+
+
 def test_cli_emits_json_error_fast_when_backend_dead():
     """With the backend guaranteed dead (bogus platform — the probe
     subprocess fails deterministically, unlike relying on probe-timeout

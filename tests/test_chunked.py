@@ -155,6 +155,7 @@ def test_per_chunk_trace_spans(monkeypatch, devices):
     import contextlib
 
     from flashmoe_tpu.parallel import ep as ep_mod
+    from flashmoe_tpu.parallel import ragged_ep as ragged_mod
     from flashmoe_tpu.utils import telemetry as tel
 
     seen = []
@@ -165,6 +166,7 @@ def test_per_chunk_trace_spans(monkeypatch, devices):
         yield
 
     monkeypatch.setattr(ep_mod, "trace_span", spy)
+    monkeypatch.setattr(ragged_mod, "trace_span", spy)
     monkeypatch.setattr(tel, "trace_span", spy)
     cfg, params, x = _setup(a2a_chunks=2)
     mesh = make_mesh(cfg, dp=1, devices=devices[:2])

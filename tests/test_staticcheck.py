@@ -258,3 +258,74 @@ def test_decision_table_matches_doc():
         assert "test.extension" in DECISION_NAMES
     finally:
         del DECISION_NAMES["test.extension"]
+
+
+def test_planted_span_name_violations(tmp_path):
+    """The span-registry lint (PR 8): a typo'd trace_span literal, an
+    f-string with an unregistered base, and a wholly computed span name
+    all trip; registered literals — chunk suffix included — pass."""
+    from flashmoe_tpu.staticcheck.lint import check_span_names
+
+    bad = tmp_path / "bad_span.py"
+    bad.write_text(
+        "from flashmoe_tpu.utils.telemetry import trace_span\n"
+        "def f(ck, name):\n"
+        '    with trace_span("moe.gaet"):\n'        # typo
+        "        pass\n"
+        '    with trace_span(f"moe.exprt.{ck}"):\n'  # typo'd f-base
+        "        pass\n"
+        "    with trace_span(name):\n"               # computed
+        "        pass\n"
+        '    with trace_span("moe.gate"):\n'         # ok
+        "        pass\n"
+        '    with trace_span(f"moe.expert.{ck}"):\n'  # ok (chunk)
+        "        pass\n"
+        '    with trace_span("moe.expert.3"):\n'     # ok (suffix)
+        "        pass\n")
+    violations = check_span_names([str(bad)])
+    assert len(violations) == 3
+    assert all(v.rule == "span-name" for v in violations)
+    details = " | ".join(v.detail for v in violations)
+    assert "moe.gaet" in details
+    assert "moe.exprt" in details
+    assert "non-literal" in details
+    # the rule rides run_lint's explicit-paths mode too
+    assert sum(1 for v in run_lint(paths=[str(bad)])
+               if v.rule == "span-name") == 3
+
+
+def test_planted_section_literal_typo(tmp_path):
+    from flashmoe_tpu.staticcheck.lint import check_span_names
+
+    bad = tmp_path / "bad_section.py"
+    bad.write_text(
+        "from flashmoe_tpu.profiler import spans as prof\n"
+        "def g(i):\n"
+        '    with prof.section("train.stpe", step=i):\n'
+        "        pass\n"
+        '    with prof.section("train.step", step=i):\n'
+        "        pass\n")
+    violations = check_span_names([str(bad)])
+    assert len(violations) == 1
+    assert "train.stpe" in violations[0].detail
+
+
+def test_span_table_matches_doc():
+    import os
+
+    from flashmoe_tpu.staticcheck.lint import check_span_doc_sync
+    from flashmoe_tpu.utils.telemetry import (
+        SPAN_NAMES, register_span, span_table_markdown,
+    )
+
+    table = span_table_markdown()
+    doc = open(os.path.join(os.path.dirname(__file__), "..", "docs",
+                            "OBSERVABILITY.md")).read()
+    for name in SPAN_NAMES:
+        assert f"`{name}`" in table and f"`{name}`" in doc
+    assert check_span_doc_sync() == []
+    register_span("test.span_extension", "scratch")
+    try:
+        assert "test.span_extension" in SPAN_NAMES
+    finally:
+        del SPAN_NAMES["test.span_extension"]
