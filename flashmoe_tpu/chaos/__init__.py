@@ -31,9 +31,14 @@ from flashmoe_tpu.chaos import inject
 #: the drill matrix: every fault class the ladder claims to survive
 FAULTS = ("nan_expert", "nan_grad", "grad_spike", "slow_step",
           "corrupt_ckpt", "skewed_routing", "path_raise", "preempt",
-          "device_loss")
+          "device_loss", "skew_sustained", "slow_device")
 
-#: which recovery tier is expected to absorb each fault
+#: which recovery tier is expected to absorb each fault.  The
+#: ``controller:*`` tiers are the self-healing runtime controller
+#: (docs/RESILIENCE.md "Self-healing controller"): the fault is not a
+#: crash but a sustained PERFORMANCE/QUALITY regression, and recovery
+#: means the controller repairs it mid-job — path morphing for
+#: sustained routing skew, Decider re-placement for a degraded device.
 EXPECTED_TIER = {
     "nan_expert": "tier0:expert_mask",
     "skewed_routing": "tier0:telemetry",
@@ -44,6 +49,8 @@ EXPECTED_TIER = {
     "path_raise": "tier2:planner_fallback",
     "preempt": "tier3:drain_resume",
     "device_loss": "tier3:elastic_refold",
+    "skew_sustained": "controller:morph",
+    "slow_device": "controller:replace",
 }
 
 
@@ -59,9 +66,20 @@ class FaultPlan:
     ``scale``: gradient multiplier for grad_spike.
     ``bias``:  router logit bias for skewed_routing.
     ``sleep_s``: stall duration for slow_step (must exceed the
-               ResilienceConfig step deadline to be detected).
+               ResilienceConfig step deadline to be detected) and the
+               full-degradation stall for slow_device.
     ``once``:  host faults fire once then disarm (the transient-fault
                model); False = fire at every visit of ``step``.
+    ``duration``: how many consecutive steps a SUSTAINED fault holds —
+               ``slow_step`` stalls every step in ``[step, step +
+               duration)`` (each visited step at most once under
+               ``once``), ``slow_device`` degrades from ``step`` for
+               ``duration`` steps, and the drill harness keeps
+               ``skew_sustained`` armed that long.  Default 1 keeps
+               every pre-existing single-shot drill byte-compatible.
+               The self-healing controller's debounce window requires
+               sustained faults: a one-step blip must never trigger a
+               morph or re-placement.
     ``seed``:  reserved for randomized plans; recorded for provenance.
     """
 
@@ -72,12 +90,16 @@ class FaultPlan:
     bias: float = 100.0
     sleep_s: float = 2.0
     once: bool = True
+    duration: int = 1
     seed: int = 0
 
     def __post_init__(self):
         if self.fault not in FAULTS:
             raise ValueError(
                 f"unknown fault {self.fault!r}; known: {FAULTS}")
+        if self.duration < 1:
+            raise ValueError(f"duration must be >= 1, "
+                             f"got {self.duration}")
 
 
 def clear() -> None:
@@ -94,7 +116,11 @@ def arm_plan(plan: FaultPlan) -> None:
     Arm BEFORE building/jitting the computation under test."""
     if plan.fault == "nan_expert":
         inject.arm("nan_expert", expert=plan.expert)
-    elif plan.fault == "skewed_routing":
+    elif plan.fault in ("skewed_routing", "skew_sustained"):
+        # skew is armed at trace time and poisons every traced step: a
+        # ``skew_sustained`` plan is the same injection, drilled long
+        # enough (``duration``) to cross the controller's debounce
+        # window and force a morph instead of mere telemetry
         inject.arm("skewed_routing", expert=plan.expert, bias=plan.bias)
     elif plan.fault == "nan_grad":
         inject.arm("nan_grad", step=plan.step)
@@ -177,23 +203,49 @@ def make_injector(plan: FaultPlan, rcfg=None, preempt=None):
     return injector
 
 
-def wrap_step(step_fn, plan: FaultPlan, deadline_s: float | None = None):
-    """Wrap a train step with the plan's stall fault (slow_step): the
-    wrapped step sleeps ``plan.sleep_s`` when the state reaches
-    ``plan.step``, which the resilient runner's wall-clock deadline
-    converts into a detected StepFailure.  Other faults pass through."""
-    if plan.fault != "slow_step":
-        return step_fn
-    fired = {"n": 0}
+def wrap_step(step_fn, plan: FaultPlan, deadline_s: float | None = None,
+              load_share=None):
+    """Wrap a train step with the plan's stall fault.
 
-    def wrapped(state, batch):
-        i = int(state.step)
-        if i == plan.step and not (plan.once and fired["n"]):
-            fired["n"] += 1
-            time.sleep(plan.sleep_s)
-        return step_fn(state, batch)
+    ``slow_step``: the wrapped step sleeps ``plan.sleep_s`` at every
+    step in ``[plan.step, plan.step + plan.duration)`` (each visited
+    step at most once under ``plan.once``), which the resilient
+    runner's wall-clock deadline converts into a detected StepFailure.
 
-    return wrapped
+    ``slow_device``: models one DEGRADED (not dead) device gating the
+    collective — the step slows by the share of expert work parked on
+    that device: sleep = ``plan.sleep_s * load_share(step)``, sustained
+    from ``plan.step`` for ``plan.duration`` steps.  ``load_share`` is
+    the drill's probe of the live placement (e.g. ``controller.
+    device_load_share(slow_dev) / rate``): once the self-healing
+    controller re-places the hot experts off the slow device, the share
+    — and the stall — collapses.  Defaults to a constant 1.0.
+
+    Other faults pass through untouched."""
+    if plan.fault == "slow_step":
+        fired: set = set()
+
+        def wrapped(state, batch):
+            i = int(state.step)
+            in_window = plan.step <= i < plan.step + plan.duration
+            if in_window and not (plan.once and i in fired):
+                fired.add(i)
+                time.sleep(plan.sleep_s)
+            return step_fn(state, batch)
+
+        return wrapped
+    if plan.fault == "slow_device":
+        def wrapped(state, batch):
+            i = int(state.step)
+            if plan.step <= i < plan.step + plan.duration:
+                share = float(load_share(i)) if load_share is not None \
+                    else 1.0
+                if share > 0:
+                    time.sleep(plan.sleep_s * share)
+            return step_fn(state, batch)
+
+        return wrapped
+    return step_fn
 
 
 __all__ = ["FAULTS", "EXPECTED_TIER", "FaultPlan", "arm_plan", "clear",

@@ -40,6 +40,11 @@ def main(argv=None) -> int:
         description="drill the fault-tolerance ladder (docs/RESILIENCE.md)")
     p.add_argument("--faults", default=None,
                    help="comma-separated subset (default: full matrix)")
+    p.add_argument("--fault", action="append", default=None,
+                   metavar="NAME",
+                   help="drill a single fault (repeatable; composes "
+                        "with --faults) — the CI fast path for smoking "
+                        "one fault without the full slow matrix")
     p.add_argument("--steps", type=int, default=6,
                    help="training steps per drill (default 6)")
     p.add_argument("--checkpoint-every", type=int, default=2,
@@ -55,7 +60,12 @@ def main(argv=None) -> int:
     from flashmoe_tpu.chaos.drill import run_drill
 
     faults = ([f.strip() for f in args.faults.split(",") if f.strip()]
-              if args.faults else list(FAULTS))
+              if args.faults else [])
+    for f in args.fault or []:
+        if f.strip() and f.strip() not in faults:
+            faults.append(f.strip())
+    if not args.faults and not args.fault:
+        faults = list(FAULTS)
     if not faults:
         # '--faults ,' must not report "all recovered" over zero drills
         p.error(f"--faults selected no fault; known: {list(FAULTS)}")

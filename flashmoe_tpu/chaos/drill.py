@@ -24,8 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from flashmoe_tpu.chaos import (
-    EXPECTED_TIER, FAULTS, FaultPlan, arm_plan, clear, make_injector,
-    wrap_step,
+    EXPECTED_TIER, FAULTS, FaultPlan, arm_plan, clear, inject,
+    make_injector, wrap_step,
 )
 from flashmoe_tpu.config import MoEConfig
 from flashmoe_tpu.parallel.mesh import make_mesh
@@ -257,6 +257,228 @@ def _run_supervised_drill(fault: str, *, num_steps: int,
         evidence=evidence, decisions=decisions)
 
 
+def _run_controller_drill(fault: str, *, num_steps: int,
+                          checkpoint_every: int, workdir: str | None,
+                          seed: int, batch: int) -> DrillResult:
+    """Drill the self-healing runtime controller (docs/RESILIENCE.md
+    "Self-healing controller"): faults that are sustained PERFORMANCE /
+    QUALITY regressions rather than crashes, which no crash-recovery
+    tier can absorb — the controller must repair the job mid-flight.
+
+    ``skew_sustained``: routing collapses onto one expert for the whole
+    run (the same in-graph injection as ``skewed_routing``, held past
+    the controller's debounce window).  The capacity path drowns in
+    token drops; recovery = a ``controller.morph`` onto a dropless
+    execution, after which the drop EMA decays back under the trigger.
+
+    ``slow_device``: one device degrades to a fraction of its rate
+    mid-job while the workload's hot expert sits on it (the wrap_step
+    stall is priced from the controller's LIVE placement: ``sleep_s *
+    device_load_share(slow)/rate``).  Recovery = a
+    ``controller.replace`` — the Decider's rate-proportional assignment
+    moves the hot expert onto a fast device (replicating it onto a dead
+    slot when that improves the makespan), the stall collapses, and the
+    armed SLO watchdog records the step time returning under budget
+    (``slo.recovered``)."""
+    from flashmoe_tpu.profiler.slo import SLOConfig
+    from flashmoe_tpu.runtime.controller import (
+        ControllerConfig, RuntimeController,
+    )
+
+    clear()
+    tmp = workdir or tempfile.mkdtemp(prefix=f"chaos_{fault}_")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    pm_dir = os.path.join(tmp, "postmortem")
+    slow = fault == "slow_device"
+    sleep_s = 0.4
+    plan = FaultPlan(fault, step=(2 if slow else 0),
+                     duration=num_steps, expert=0, bias=100.0,
+                     sleep_s=sleep_s, seed=seed)
+    if slow:
+        # top-1 routing: the biased workload parks ALL load on expert 0
+        # and leaves genuinely dead slots for the replication policy
+        cfg = drill_config(num_experts=8, expert_top_k=1)
+    else:
+        cfg = drill_config()
+    arm_plan(FaultPlan("skew_sustained", step=0, duration=num_steps,
+                       expert=plan.expert, bias=plan.bias, seed=seed))
+
+    n_dev = 4 if slow else 1
+    rates = np.array([0.25, 1.0, 1.0, 1.0]) if slow else None
+    ccfg = ControllerConfig(
+        enable_morph=not slow, enable_replace=slow,
+        debounce_steps=2, cooldown_steps=3, baseline_steps=2,
+        morph_budget=1, replace_budget=1, ema_decay=0.5,
+        slow_factor=1.5)
+    metrics = Metrics()
+    controller = RuntimeController(
+        cfg, ccfg, metrics=metrics, n_devices=n_dev,
+        rates_fn=(lambda: rates) if slow else None)
+
+    mesh = make_mesh(cfg, dp=1, devices=jax.devices()[:1])
+    guard = GradGuardConfig(warmup_steps=2, spike_factor=10.0)
+    opt = make_optimizer(cfg, total_steps=num_steps)
+    state = init_state(jax.random.PRNGKey(seed), cfg, opt, guard=guard)
+    state = jax.device_put(state, state_shardings(state, cfg, mesh))
+
+    def _rearm_hot_column():
+        # the injected skew models CONTENT-based routing: tokens chase
+        # the hot expert's FUNCTION, which a re-placement moves to a
+        # new router column (gate_w columns permute with their FFN
+        # weights).  The logit-bias injection point is column-anchored,
+        # so the faithful sustained-skew simulation re-arms it at the
+        # hot expert's current column before every re-trace.
+        col = plan.expert
+        for rec in controller.timeline:
+            if rec.get("decision") == "controller.replace":
+                col = list(rec["perm"]).index(col)
+        inject.arm("skewed_routing", expert=col, bias=plan.bias)
+        return col
+
+    def rebuild(overrides):
+        _rearm_hot_column()
+        scfg = cfg.replace(**overrides) if overrides else cfg
+        return make_train_step(scfg, mesh, opt, guard=guard)
+
+    step_fn = rebuild({})
+    slo = None
+    if slow:
+        # the slow device gates the step at sleep_s / rate; the budget
+        # sits between the degraded and the re-placed step time, so the
+        # watchdog narrates breach -> (replace) -> recovered
+        slo = SLOConfig(step_ms=sleep_s * 1e3 * 0.6, consecutive=3)
+
+        def load_share(i):
+            # bottleneck model: the slow device's work share over its
+            # degraded rate (1.0 when the hot expert sits on it)
+            return controller.device_load_share(0) / (
+                rates[0] / rates.max())
+
+        wrapped = wrap_step(step_fn, plan, load_share=load_share)
+
+        def rebuild_wrapped(overrides):
+            return wrap_step(rebuild(overrides), plan,
+                             load_share=load_share)
+    else:
+        wrapped, rebuild_wrapped = step_fn, rebuild
+
+    rcfg = ResilienceConfig(checkpoint_dir=ckpt_dir,
+                            checkpoint_every=checkpoint_every,
+                            max_retries=3)
+    g0 = len(global_metrics.decisions)
+    t0 = time.perf_counter()
+    error = None
+    step_wall: list[float] = []
+
+    def timed(fn):
+        # host-side wall-clock wrapper AROUND the jitted step (never
+        # traced): the drill's recovery verdict reads these timings
+        def run(st, b):
+            s0 = time.perf_counter()  # staticcheck: ok host wrapper around the jitted step, not traced code
+            out = fn(st, b)
+            jax.block_until_ready(out[0])
+            step_wall.append(time.perf_counter() - s0)  # staticcheck: ok host wrapper around the jitted step, not traced code
+            return out
+        return run
+
+    try:
+        final, history = resilient_train(
+            state, timed(wrapped), data_stream(cfg, batch, seed),
+            num_steps, rcfg=rcfg, metrics=metrics, slo=slo,
+            postmortem_dir=pm_dir, cfg=cfg, controller=controller,
+            rebuild_step=lambda ov: timed(rebuild_wrapped(ov)))
+        final_step = int(final.step)
+    except Exception as e:  # noqa: BLE001 — a drill reports, never dies
+        error, final_step, history = f"{type(e).__name__}: {e}", -1, []
+    wall = time.perf_counter() - t0
+
+    from flashmoe_tpu.profiler import postmortem as pm
+    from flashmoe_tpu.runtime import checkpoint as ckpt_mod
+
+    bundles = pm.find_bundles(pm_dir)
+    decisions = metrics.decisions + global_metrics.decisions[g0:]
+    names = sorted({d["decision"] for d in decisions})
+    c = metrics.counters
+    act_name = "controller.replace" if slow else "controller.morph"
+    act = next((d for d in decisions if d["decision"] == act_name), None)
+    last = ckpt_mod.latest_step(ckpt_dir)
+    manifest_plan = (ckpt_mod.load_controller_state(ckpt_dir, last)
+                     if last is not None else None)
+    evidence: dict = {
+        "failures": c.get("failures", 0.0),
+        "decision_names": names,
+        "action": {k: v for k, v in (act or {}).items()
+                   if k not in ("perm",)},
+        "drop_ema_end": controller.drop_ema,
+        "imbalance_ema_end": controller.imbalance_ema,
+        "morphs_used": controller.morphs_used,
+        "replaces_used": controller.replaces_used,
+        "overrides": {k: str(v)
+                      for k, v in controller.cfg_overrides.items()},
+        "manifest_plan": bool(manifest_plan),
+        "postmortem_bundles": bundles,
+    }
+
+    ok, why = True, []
+
+    def need(cond, msg):
+        nonlocal ok
+        if not cond:
+            ok = False
+            why.append(msg)
+
+    need(error is None, f"aborted: {error}")
+    need(final_step == num_steps, f"ended at step {final_step}")
+    need(act is not None, f"no {act_name} decision")
+    need(c.get("failures", 0) == 0,
+         "controller fault escalated into step failures")
+    need(not bundles,
+         f"self-healed fault left postmortem bundle(s): {bundles}")
+    need(manifest_plan is not None and bool(manifest_plan),
+         "newest checkpoint manifest carries no controller plan")
+    steps_rerun = max(0, int(c.get("steps", 0)) - num_steps)
+    need(steps_rerun == 0,
+         f"self-healing re-ran {steps_rerun} steps (must be zero lost "
+         f"steps)")
+    if act is not None:
+        act_step = int(act.get("step", 0))
+        if slow:
+            perm = act.get("perm") or list(range(cfg.num_experts))
+            need(perm != list(range(cfg.num_experts))
+                 or act.get("replicas"),
+                 "re-placement changed nothing (identity perm, no "
+                 "replicas)")
+            need(bool(act.get("replicas")),
+                 "hot expert was not replicated onto a dead slot")
+            pre = [s for i, s in enumerate(step_wall)
+                   if plan.step <= i < act_step]
+            post = step_wall[act_step + 1:]  # skip the re-jit step
+            evidence["pre_ms"] = round(1e3 * max(pre), 1) if pre else None
+            evidence["post_ms"] = (round(1e3 * min(post), 1)
+                                   if post else None)
+            need(pre and post and min(post) < 0.5 * max(pre),
+                 f"step time did not recover "
+                 f"(pre {evidence['pre_ms']} ms -> "
+                 f"post {evidence['post_ms']} ms)")
+            need("slo.breach" in names, "SLO never saw the degradation")
+            need("slo.recovered" in names,
+                 "step time never returned under the SLO budget")
+        else:
+            need(act.get("dropless"),
+                 "morph did not target a dropless execution")
+            need(controller.drop_ema is not None
+                 and controller.drop_ema < ccfg.drop_high,
+                 f"drop EMA {controller.drop_ema} still above the "
+                 f"trigger after the morph")
+
+    clear()
+    return DrillResult(
+        fault=fault, expected_tier=EXPECTED_TIER[fault], recovered=ok,
+        reason="; ".join(why), final_step=final_step,
+        steps_rerun=steps_rerun, wall_s=round(wall, 3),
+        evidence=evidence, decisions=decisions)
+
+
 def run_drill(fault: str, *, num_steps: int = 6, checkpoint_every: int = 2,
               workdir: str | None = None, seed: int = 0,
               batch: int = 2) -> DrillResult:
@@ -266,6 +488,13 @@ def run_drill(fault: str, *, num_steps: int = 6, checkpoint_every: int = 2,
         return _run_supervised_drill(
             fault, num_steps=num_steps, checkpoint_every=checkpoint_every,
             workdir=workdir, seed=seed, batch=batch)
+    if fault in ("skew_sustained", "slow_device"):
+        # the self-healing drills need room for debounce + cooldown +
+        # post-action recovery evidence: at least 12 steps
+        return _run_controller_drill(
+            fault, num_steps=max(num_steps, 12),
+            checkpoint_every=checkpoint_every, workdir=workdir,
+            seed=seed, batch=batch)
     plan = FaultPlan(fault, step=3, seed=seed)
     if fault == "corrupt_ckpt":
         # corrupt the NEWEST checkpoint after two exist, so the fallback

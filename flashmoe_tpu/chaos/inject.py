@@ -70,6 +70,21 @@ def spec(point: str) -> dict:
     return dict(_ARMED.get(point, {}))
 
 
+def trace_signature() -> tuple:
+    """Hashable snapshot of the armed registry, for use as a STATIC
+    argument of cached traces.  Arming is trace-time state, so any
+    cache keyed only on (function, config) — ``jax.checkpoint``'s remat
+    cache in :func:`flashmoe_tpu.models.transformer.forward` — would
+    resurrect a stale fault-free (or fault-carrying) jaxpr when the
+    registry changes between two builds of an EQUAL config.  Threading
+    this signature through the static args makes the registry part of
+    the cache key: () when disarmed (the zero-cost common case), a
+    distinct tuple per armed spec otherwise."""
+    return tuple(sorted(
+        (point, tuple(sorted(sp.items()))) for point, sp in _ARMED.items()
+    ))
+
+
 # ----------------------------------------------------------------------
 # Appliers — called from the hook sites only when is_armed() (trace time)
 # ----------------------------------------------------------------------

@@ -192,6 +192,23 @@ class MoEConfig:
     # the bodies contain no fence calls at all.
     profile_phases: bool = False
 
+    # Static hot-expert replica routing map, written by the self-healing
+    # runtime controller (flashmoe_tpu/runtime/controller.py) when it
+    # re-places experts under sustained load skew: each (hot, slot) pair
+    # splits the traffic of expert ``hot`` between its own slot and the
+    # replica ``slot`` (whose FFN weights the controller overwrites with
+    # a copy of ``hot``'s — the victim slot must be a ~dead expert, so
+    # evicting it costs nothing).  Applied in-graph AFTER top-k
+    # (ops/gate.py): tokens routed to ``hot`` alternate between the two
+    # physical slots by token parity, so each token is processed by
+    # exactly one value-identical replica and the combine merges
+    # contributions unchanged — the hot expert's load (and its capacity
+    # drops) split in half.  Default (): OFF, bit-identical to a
+    # replica-free build (the collect_stats / wire_dtype convention;
+    # registered in staticcheck/registry.py, proven by the invariant
+    # engine).
+    expert_replicas: tuple = ()
+
     # Inference-only: fuse the dispatch gather into the FFN kernel
     # (ops/expert.py:grouped_ffn_tokens — no [E, C, H] HBM buffer).
     # None = auto: follow the FLASHMOE_GATHER_FUSED env var, else stay on
@@ -266,6 +283,52 @@ class MoEConfig:
                     f"a2a_chunks={n} must divide the local-expert axis "
                     f"(num_experts // ep = {nlx}); pick a divisor or "
                     f"leave a2a_chunks=None for the serial schedule")
+        # replica routing map: reject malformed maps at config time so
+        # the in-graph remap (ops/gate.py) only ever sees valid static
+        # (hot, slot) pairs
+        if self.expert_replicas:
+            if not isinstance(self.expert_replicas, tuple):
+                raise ValueError(
+                    f"expert_replicas must be a tuple of (hot, slot) "
+                    f"pairs, got {type(self.expert_replicas).__name__}")
+            seen_slots: set = set()
+            hots = set()
+            for pair in self.expert_replicas:
+                if (not isinstance(pair, tuple) or len(pair) != 2
+                        or not all(isinstance(v, int) for v in pair)):
+                    raise ValueError(
+                        f"expert_replicas entries must be (hot, slot) "
+                        f"int pairs, got {pair!r}")
+                hot, slot = pair
+                if hot == slot:
+                    raise ValueError(
+                        f"expert_replicas pair {pair} replicates an "
+                        f"expert onto its own slot")
+                for v in pair:
+                    if not 0 <= v < self.num_experts:
+                        raise ValueError(
+                            f"expert_replicas id {v} out of range "
+                            f"[0, {self.num_experts})")
+                if slot in seen_slots:
+                    raise ValueError(
+                        f"expert_replicas slot {slot} used as a replica "
+                        f"target twice")
+                if hot in hots:
+                    # the in-graph split is a token-parity half/half
+                    # between ONE (hot, slot) pair; a second replica of
+                    # the same expert would receive zero traffic — its
+                    # evicted slot wasted silently
+                    raise ValueError(
+                        f"expert_replicas replicates expert {hot} "
+                        f"twice; the parity split supports exactly one "
+                        f"replica per hot expert")
+                seen_slots.add(slot)
+                hots.add(hot)
+            if hots & seen_slots:
+                raise ValueError(
+                    f"expert_replicas chains a replica "
+                    f"({sorted(hots & seen_slots)} appear as both hot "
+                    f"expert and replica slot)")
         if ((self.wire_dtype or self.wire_dtype_combine)
                 and self.moe_backend == "fused"):
             raise ValueError(

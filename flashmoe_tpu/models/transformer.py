@@ -205,10 +205,19 @@ def _ffn(layer, x, cfg: MoEConfig, li: int, mesh, use_pallas):
             o.aux_loss + o.z_loss, o.stats)
 
 
-def block(layer, x, cfg: MoEConfig, li: int, mesh=None, use_pallas=None):
+def block(layer, x, cfg: MoEConfig, li: int, mesh=None, use_pallas=None,
+          chaos_sig=()):
     """One pre-norm transformer block.  Returns (x, moe_losses,
     moe_stats) — stats is the layer's MoEStats when ``cfg.collect_stats``
-    and this is an MoE layer, else None (an empty pytree leaf)."""
+    and this is an MoE layer, else None (an empty pytree leaf).
+
+    ``chaos_sig`` is the chaos-injection registry snapshot
+    (:func:`flashmoe_tpu.chaos.inject.trace_signature`), unused in the
+    body but STATIC: ``jax.checkpoint`` caches block traces by
+    (function, static args), and without the signature in the key a
+    re-armed injection point silently reuses the previous arming
+    state's jaxpr whenever two builds share an equal config (the chaos
+    drills rebuild their step exactly to pick up new arming)."""
     a = attention(layer, rms_norm(x, layer["attn_norm"]), cfg, mesh=mesh,
                   use_pallas=use_pallas)
     x = x + a
@@ -238,14 +247,18 @@ def forward(params, tokens, cfg: MoEConfig, mesh=None, use_pallas=None):
                     and cfg.num_experts > 1
                     and _resolved_plan(cfg, mesh)[0] == "fused")
     blk_remat = jax.checkpoint(
-        block, static_argnums=(2, 3, 4, 5),
+        block, static_argnums=(2, 3, 4, 5, 6),
         policy=jax.checkpoint_policies.nothing_saveable,
     )
+    from flashmoe_tpu.chaos import inject as chaos_inject
+
+    chaos_sig = chaos_inject.trace_signature()
     moe_layers = set(cfg.moe_layer_indices)
     for li, layer in enumerate(params["layers"]):
         fused_block = fused_active and li in moe_layers
         blk = blk_remat if (cfg.is_training and not fused_block) else block
-        x, moe_loss, moe_stats = blk(layer, x, cfg, li, mesh, use_pallas)
+        x, moe_loss, moe_stats = blk(layer, x, cfg, li, mesh, use_pallas,
+                                     chaos_sig)
         total_aux = total_aux + moe_loss
         if moe_stats is not None:
             layer_stats.append(moe_stats)

@@ -207,6 +207,64 @@ def resilience_report(records: list[dict]) -> dict:
     }
 
 
+def adaptation_report(records: list[dict]) -> dict:
+    """The self-healing controller's story (docs/RESILIENCE.md
+    "Self-healing controller"): every ``controller.*`` decision in
+    timeline order, and — for each morph/re-placement — the mean MoE
+    imbalance and dropped fraction over the flight-recorder steps
+    BEFORE vs AFTER the action, so the report answers "did the repair
+    actually repair" without replaying the run."""
+    acts = [r for r in records
+            if str(r.get("decision", "")).startswith("controller.")]
+    flight = []
+    for rec in records:
+        ms = _layer_stats(rec)
+        if ms and isinstance(rec.get("step"), (int, float)):
+            flight.append((int(rec["step"]),
+                           max(m.get("imbalance", 0.0) for m in ms),
+                           max(m.get("dropped_fraction", 0.0)
+                               for m in ms)))
+    flight.sort()
+
+    def window(step, after: bool, n: int = 5):
+        rows = [(i, d) for s, i, d in flight
+                if (s >= step if after else s < step)]
+        rows = rows[:n] if after else rows[-n:]
+        if not rows:
+            return None
+        return {"imbalance": round(sum(r[0] for r in rows)
+                                   / len(rows), 3),
+                "dropped_fraction": round(sum(r[1] for r in rows)
+                                          / len(rows), 4)}
+
+    timeline = []
+    for a in acts:
+        entry = {"decision": a.get("decision"), "step": a.get("step"),
+                 "trigger": a.get("trigger")}
+        if a["decision"] == "controller.morph":
+            entry.update(backend=a.get("backend"),
+                         dropless=a.get("dropless"),
+                         overrides=a.get("overrides"),
+                         reason=a.get("reason"))
+        elif a["decision"] == "controller.replace":
+            entry.update(replicas=a.get("replicas"),
+                         rates=a.get("rates"),
+                         device_share_before=a.get(
+                             "device_share_before"))
+        elif a["decision"] == "controller.demotion_reset":
+            entry.update(dropped=a.get("dropped"),
+                         world=a.get("world"))
+        if a["decision"] in ("controller.morph", "controller.replace") \
+                and isinstance(a.get("step"), (int, float)):
+            entry["before"] = window(int(a["step"]), after=False)
+            entry["after"] = window(int(a["step"]), after=True)
+        timeline.append(entry)
+    counts: dict[str, int] = {}
+    for a in acts:
+        counts[a["decision"]] = counts.get(a["decision"], 0) + 1
+    return {"actions": counts, "timeline": timeline}
+
+
 def phase_report(records: list[dict]) -> dict:
     """Mean of every ``*_ms`` field across records (flight ``step_ms``,
     bench leg timings) plus ``*_ms_p50`` phase timers from metrics
@@ -240,6 +298,7 @@ def summarize(records: list[dict]) -> dict:
         "degradation": degradation_report(flight),
         "wire": wire_report(flight),
         "resilience": resilience_report(records),
+        "adaptation": adaptation_report(records),
         "phases": phase_report(records),
         "drift": drift_report(records),
         "decisions": sorted({r["decision"] for r in records
@@ -463,6 +522,32 @@ def render_text(s: dict) -> str:
             lines.append(f"  resume #{r['incarnation']} at step "
                          f"{r['step']}: world={r['world']} "
                          f"(ep={r['ep']} x dp={r['dp']})")
+    adapt = s.get("adaptation", {})
+    if adapt.get("actions"):
+        lines.append("")
+        lines.append("self-healing controller: " + ", ".join(
+            f"{k.split('.', 1)[1]}={v}"
+            for k, v in sorted(adapt["actions"].items())))
+        for t in adapt["timeline"]:
+            kind = str(t["decision"]).split(".", 1)[1]
+            head = f"  step {t.get('step')}: {kind}"
+            if kind == "morph":
+                head += (f" -> {t.get('backend')}"
+                         f"{' (dropless)' if t.get('dropless') else ''}")
+            elif kind == "replace":
+                reps = t.get("replicas") or []
+                head += (f" (replicas {reps})" if reps
+                         else " (permutation only)")
+            elif kind == "demotion_reset":
+                head += f" dropped={t.get('dropped')}"
+            lines.append(head)
+            b, a = t.get("before"), t.get("after")
+            if b and a:
+                lines.append(
+                    f"    imbalance {b['imbalance']} -> "
+                    f"{a['imbalance']}, dropped "
+                    f"{b['dropped_fraction']} -> "
+                    f"{a['dropped_fraction']}")
     if s["phases"]:
         lines.append("")
         lines.append("phase times (mean):")

@@ -500,6 +500,35 @@ def gate_vmem_bytes(s: int, h: int, e: int, dtype) -> int:
 _GATE_VMEM_BUDGET = 12 * 2**20
 
 
+def apply_replicas(out: RouterOutput, cfg: MoEConfig) -> RouterOutput:
+    """Split hot-expert traffic across its replica slots
+    (``cfg.expert_replicas``, written by the self-healing controller's
+    re-placement action — :mod:`flashmoe_tpu.runtime.controller`).
+
+    For each static (hot, slot) pair, tokens whose top-k selected
+    ``hot`` are remapped to ``slot`` by token parity — a deterministic
+    half/half split.  The controller guarantees ``slot``'s FFN weights
+    are a value-identical copy of ``hot``'s, so every token is processed
+    by exactly one replica with the same math and the combine merges
+    contributions unchanged; only the *physical* load (and therefore
+    capacity drops and per-device work) splits.  ``expert_counts`` is
+    recomputed over the remapped slots so the dispatch plan, MoEStats
+    load histogram, and the controller's own feedback all see physical
+    slot load; ``aux_loss``/``probs_mean`` keep the router's logical
+    view (computed pre-remap).  Empty map = identity (no ops added)."""
+    if not cfg.expert_replicas:
+        return out
+    idx = out.expert_idx
+    pos = jnp.arange(idx.shape[0], dtype=idx.dtype)[:, None]
+    for hot, slot in cfg.expert_replicas:
+        take = (idx == hot) & (pos % 2 == 1)
+        idx = jnp.where(take, jnp.asarray(slot, idx.dtype), idx)
+    counts = jnp.sum(
+        jax.nn.one_hot(idx, cfg.num_experts, dtype=jnp.int32),
+        axis=(0, 1))
+    return out._replace(expert_idx=idx, expert_counts=counts)
+
+
 def router(x, gate_w, cfg: MoEConfig, use_pallas: bool = True,
            interpret: bool = False) -> RouterOutput:
     """Dispatch to a fused kernel on TPU, XLA fallback elsewhere.
@@ -512,17 +541,18 @@ def router(x, gate_w, cfg: MoEConfig, use_pallas: bool = True,
         # the skew fault biases router LOGITS (router_xla hook); the
         # fused gate kernels compute logits in-kernel, so chaos drills
         # route through the XLA gate while this point is armed
-        return router_xla(x, gate_w, cfg)
+        return apply_replicas(router_xla(x, gate_w, cfg), cfg)
     on_tpu = interpret or jax.default_backend() == "tpu"
     s, h = x.shape
     if not (use_pallas and s % 8 == 0 and on_tpu):
-        return router_xla(x, gate_w, cfg)
+        return apply_replicas(router_xla(x, gate_w, cfg), cfg)
     fits = gate_vmem_bytes(s, h, cfg.num_experts, x.dtype) \
         <= _GATE_VMEM_BUDGET
     if fits:
-        return _router_pallas_ad(x, gate_w, cfg, interpret)
+        return apply_replicas(_router_pallas_ad(x, gate_w, cfg, interpret),
+                              cfg)
     if 2 * cfg.expert_top_k > LANE:
         # the tiled kernel's carried+candidate top-k merge holds 2k lanes;
         # beyond that use the XLA path instead of raising (advisor r4 #4)
-        return router_xla(x, gate_w, cfg)
-    return _router_tiled_ad(x, gate_w, cfg, interpret)
+        return apply_replicas(router_xla(x, gate_w, cfg), cfg)
+    return apply_replicas(_router_tiled_ad(x, gate_w, cfg, interpret), cfg)

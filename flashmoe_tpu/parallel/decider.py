@@ -111,11 +111,19 @@ class Placement:
     expert_owner:  [E] device id owning each expert (within each group the
                    same logical assignment maps to that group's devices)
     local_experts: device id -> list of expert ids
+    replicas:      hot-expert replication map.  From :func:`decide`:
+                   expert id -> extra device ids also hosting a copy.
+                   From :func:`rebalance_placement` (the equal-slot
+                   runtime projection): hot SLOT -> victim SLOTs whose
+                   ~dead experts are evicted to carry the copy (the
+                   ``MoEConfig.expert_replicas`` encoding).  Empty when
+                   no expert is replicated.
     """
 
     groups: list
     expert_owner: dict
     local_experts: dict
+    replicas: dict = dataclasses.field(default_factory=dict)
 
 
 def _intra_comm_ms(members, adj: Adjacency, mbytes: float) -> float:
@@ -156,10 +164,113 @@ def _placement_from_native(group_ids, counts, n: int, e: int) -> Placement:
     return Placement(groups, expert_owner, local_experts)
 
 
+def assign_experts(group: list, rates, e: int,
+                   expert_costs=None) -> dict:
+    """Partition ``e`` experts across one group's devices proportionally
+    to processing rate (``decider.cuh:273-329``).
+
+    ``expert_costs=None`` keeps the contiguous rate-proportional budget
+    split (uniform experts).  With per-expert costs — the controller's
+    observed load histogram, the reference's cost-sorted multiset — the
+    assignment is the greedy makespan heuristic over that multiset:
+    experts sorted by cost descending (ties: lower id first), each
+    placed on the device with the smallest projected finish time
+    ``(assigned_cost + cost) / rate`` (ties: lower device id).  Both
+    arms are fully deterministic: identical inputs yield the identical
+    assignment (the stability property the runtime controller leans on
+    — a re-plan from unchanged telemetry must be a no-op).
+
+    Returns device id -> list of expert ids (sorted ascending).
+    """
+    out: dict[int, list[int]] = {d: [] for d in group}
+    if expert_costs is None:
+        grates = np.array([rates[d] for d in group], dtype=np.float64)
+        budgets = np.floor(e * grates / grates.sum()).astype(int)
+        # distribute the remainder to the fastest devices
+        rem = e - budgets.sum()
+        order = np.argsort(-grates, kind="stable")
+        for k in range(rem):
+            budgets[order[k % len(group)]] += 1
+        eid = 0
+        for d_idx, d in enumerate(group):
+            for _ in range(budgets[d_idx]):
+                out[d].append(eid)
+                eid += 1
+        return out
+    costs = np.asarray(expert_costs, dtype=np.float64)
+    if costs.shape != (e,):
+        raise ValueError(
+            f"expert_costs must have shape ({e},), got {costs.shape}")
+    assigned = {d: 0.0 for d in group}
+    # cost-sorted multiset, heaviest first; ties broken by expert id so
+    # the order (and therefore the placement) is reproducible
+    for eid in sorted(range(e), key=lambda i: (-costs[i], i)):
+        d = min(group,
+                key=lambda dd: ((assigned[dd] + costs[eid])
+                                / max(rates[dd], 1e-9), dd))
+        out[d].append(eid)
+        assigned[d] += costs[eid]
+    for d in group:
+        out[d].sort()
+    return out
+
+
+def _replicate_hot(group: list, rates, per_device: dict, costs,
+                   spare_slots: int) -> dict:
+    """Replicate the costliest experts onto extra devices while spare
+    memory slots remain AND each copy improves the group's projected
+    makespan ``max(assigned/rate)``.  Returns expert -> extra device
+    ids; ``per_device`` is extended in place."""
+    replicas: dict[int, list[int]] = {}
+    if spare_slots <= 0 or len(group) < 2:
+        return replicas
+    costs = np.asarray(costs, dtype=np.float64)
+    assigned = {d: sum(costs[e] for e in per_device[d]) for d in group}
+    # every copy must improve the makespan, so the loop terminates on
+    # its own; the cap just bounds pathological memory-rich groups
+    for _ in range(min(spare_slots, len(costs) * (len(group) - 1))):
+        # the bottleneck device's costliest expert is the candidate
+        bot = max(group, key=lambda d: (assigned[d] / max(rates[d], 1e-9),
+                                        d))
+        cands = [e for e in per_device[bot]
+                 if e not in replicas or bot not in replicas[e]]
+        if not cands:
+            return replicas
+        hot = max(cands, key=lambda e: (costs[e], -e))
+        hosts = {d for d in group if hot in per_device[d]}
+        free = [d for d in group if d not in hosts]
+        if not free:
+            return replicas
+        # splitting the hot expert's cost evenly across its copies:
+        # place the new copy where the post-split makespan is smallest
+        n_copies = len(hosts) + 1
+        share = costs[hot] / n_copies
+        best, best_makespan = None, None
+        for d in free:
+            proj = dict(assigned)
+            for h in hosts:
+                proj[h] -= costs[hot] / len(hosts) - share
+            proj[d] += share
+            mk = max(proj[x] / max(rates[x], 1e-9) for x in group)
+            if best_makespan is None or (mk, d) < (best_makespan, best):
+                best, best_makespan = d, mk
+        cur = max(assigned[x] / max(rates[x], 1e-9) for x in group)
+        if best is None or best_makespan >= cur:
+            return replicas  # no copy helps: capacity stays unspent
+        for h in hosts:
+            assigned[h] -= costs[hot] / len(hosts) - share
+        assigned[best] += share
+        per_device[best].append(hot)
+        per_device[best].sort()
+        replicas.setdefault(hot, []).append(best)
+    return replicas
+
+
 def decide(adj: Adjacency, workers: list[WorkerAttr], cfg: MoEConfig,
            expert_mb: float | None = None,
            native: str | bool = "auto",
-           price_mode: str = "bottleneck") -> Placement:
+           price_mode: str = "bottleneck",
+           expert_costs=None, replicate: bool = False) -> Placement:
     """Form DP x EP groups and assign experts (the reference's
     ``Decider<JobType>::operator()`` + ``assign``).
 
@@ -179,6 +290,17 @@ def decide(adj: Adjacency, workers: list[WorkerAttr], cfg: MoEConfig,
     ``native``: "auto" prefers the C++ implementation
     (:mod:`flashmoe_tpu.parallel._native`) when it builds/loads, True
     requires it, False forces pure Python.
+
+    ``expert_costs``: observed per-expert processing cost ([E], any
+    positive unit — the runtime controller feeds its load-histogram
+    EMA).  Switches the within-group assignment from the contiguous
+    uniform split to the reference's cost-sorted multiset
+    (:func:`assign_experts`), so a hot expert lands with cheap
+    neighbors and a slow device receives the cold tail.  ``replicate``
+    additionally copies bottleneck experts onto extra devices while
+    group memory capacity allows AND each copy improves the projected
+    makespan (``Placement.replicas``).  Both are host-side only and
+    force the pure-Python path (the C++ decider predates them).
     """
     import heapq
 
@@ -203,7 +325,8 @@ def decide(adj: Adjacency, workers: list[WorkerAttr], cfg: MoEConfig,
         gamma=gamma,
     )
 
-    if native != False and price_mode == "bottleneck":  # noqa: E712
+    if (native != False and price_mode == "bottleneck"  # noqa: E712
+            and expert_costs is None and not replicate):
         from flashmoe_tpu.parallel import _native
 
         res = _native.native_decide(
@@ -355,22 +478,134 @@ def decide(adj: Adjacency, workers: list[WorkerAttr], cfg: MoEConfig,
     # --- expert assignment within each group (decider.cuh:273-329) ---
     expert_owner: dict[int, int] = {}
     local_experts: dict[int, list[int]] = {d: [] for d in range(n)}
+    replicas: dict[int, list[int]] = {}
     for group in groups:
-        grates = np.array([rates[d] for d in group], dtype=np.float64)
-        budgets = np.floor(e * grates / grates.sum()).astype(int)
-        # distribute the remainder to the fastest devices
-        rem = e - budgets.sum()
-        order = np.argsort(-grates)
-        for k in range(rem):
-            budgets[order[k % len(group)]] += 1
-        eid = 0
-        for d_idx, d in enumerate(group):
-            for _ in range(budgets[d_idx]):
-                if group is groups[0]:
-                    expert_owner[eid] = d
-                local_experts[d].append(eid)
-                eid += 1
-    return Placement(groups, expert_owner, local_experts)
+        per_device = assign_experts(group, rates, e,
+                                    expert_costs=expert_costs)
+        if replicate and expert_costs is not None:
+            cap_mb = sum(workers[d].memory_gb for d in group) * 1024.0
+            spare = int(cap_mb // expert_mb) - e if expert_mb > 0 else 0
+            reps = _replicate_hot(group, rates, per_device,
+                                  expert_costs, spare)
+            if group is groups[0]:
+                replicas = reps
+        for d in group:
+            local_experts[d] = list(per_device[d])
+            if group is groups[0]:
+                for eid in per_device[d]:
+                    if eid not in expert_owner:
+                        expert_owner[eid] = d
+    return Placement(groups, expert_owner, local_experts,
+                     replicas=replicas)
+
+
+def rebalance_placement(loads, n_devices: int, cfg: MoEConfig, *,
+                        rates=None, replicate: bool = False,
+                        cold_eps: float = 1e-3,
+                        hot_min: float | None = None) -> Placement:
+    """Equal-slot projection of :func:`decide`'s rate-proportional
+    assignment for a RUNNING job: re-place the current physical expert
+    slots across devices from their *observed* load histogram.
+
+    The live EP layers shard experts uniformly (``num_experts // ep``
+    contiguous slots per rank), so a mid-job re-placement cannot change
+    per-device slot counts — only WHICH experts fill which slots.  This
+    is the cost-sorted multiset of :func:`assign_experts` under that
+    slot constraint: slots sorted by observed load descending (ties:
+    lower slot id), each assigned to the device with the smallest
+    projected finish time ``(load + l) / rate`` among devices with free
+    slots.  Deterministic: identical (loads, rates) produce the
+    identical placement.
+
+    ``loads``: [E] observed per-slot load (the controller's MoEStats
+    EMA).  ``rates``: per-device throughput (default uniform) — a slow
+    device then receives the cold tail.  ``replicate``: while a ~dead
+    slot exists (load share < ``cold_eps``), the hottest slot (share >
+    ``hot_min``, default ``2/E``) is replicated onto it when splitting
+    improves the projected makespan; the pair lands in
+    ``Placement.replicas`` as {hot_slot: [victim_slot, ...]} — the
+    :attr:`flashmoe_tpu.config.MoEConfig.expert_replicas` encoding
+    (victim evicted, its slot overwritten with the hot expert's
+    weights).
+
+    Returns a single-group :class:`Placement` whose ``local_experts[d]``
+    lists the OLD slot ids device ``d``'s new block holds — i.e. the
+    permutation ``perm[new_slot] = old_slot`` read off block by block.
+    """
+    e = cfg.num_experts
+    loads = np.asarray(loads, dtype=np.float64)
+    if loads.shape != (e,):
+        raise ValueError(f"loads must have shape ({e},), "
+                         f"got {loads.shape}")
+    if n_devices < 1 or e % n_devices:
+        raise ValueError(
+            f"n_devices={n_devices} must divide num_experts={e} "
+            f"(the uniform EP shard's slot constraint)")
+    nlx = e // n_devices
+    rates = (np.ones(n_devices) if rates is None
+             else np.asarray(rates, dtype=np.float64))
+    if rates.shape != (n_devices,):
+        raise ValueError(f"rates must have shape ({n_devices},), "
+                         f"got {rates.shape}")
+
+    assigned = [0.0] * n_devices
+    slots_left = [nlx] * n_devices
+    per_device: dict[int, list[int]] = {d: [] for d in range(n_devices)}
+    for s in sorted(range(e), key=lambda i: (-loads[i], i)):
+        free = [d for d in range(n_devices) if slots_left[d]]
+        d = min(free, key=lambda dd: ((assigned[dd] + loads[s])
+                                      / max(rates[dd], 1e-9), dd))
+        per_device[d].append(s)
+        assigned[d] += loads[s]
+        slots_left[d] -= 1
+    for d in per_device:
+        per_device[d].sort()
+
+    expert_owner = {s: d for d in per_device for s in per_device[d]}
+    placement = Placement([list(range(n_devices))], expert_owner,
+                          per_device)
+
+    if replicate:
+        total = float(loads.sum())
+        if total > 0:
+            share = loads / total
+            hot_min = (2.0 / e) if hot_min is None else hot_min
+            # new-slot index of each old slot under the permutation
+            perm = [s for d in range(n_devices) for s in per_device[d]]
+            new_of = {old: i for i, old in enumerate(perm)}
+            hot = int(np.argmax(loads))
+            dead = [s for s in range(e)
+                    if share[s] < cold_eps and s != hot]
+            if dead and share[hot] > hot_min:
+                # split helps iff moving half the hot load onto some
+                # dead slot's device lowers the bottleneck finish time;
+                # pick the victim whose device benefits most
+                dh = expert_owner[hot]
+                before = max(assigned[d] / max(rates[d], 1e-9)
+                             for d in range(n_devices))
+                best, best_after = None, before
+                for cold in dead:
+                    dc = expert_owner[cold]
+                    if dc == dh:
+                        continue
+                    proj = list(assigned)
+                    proj[dh] -= loads[hot] / 2
+                    proj[dc] += loads[hot] / 2
+                    after = max(proj[d] / max(rates[d], 1e-9)
+                                for d in range(n_devices))
+                    if after < best_after:
+                        best, best_after = cold, after
+                if best is not None:
+                    placement.replicas = {new_of[hot]: [new_of[best]]}
+    return placement
+
+
+def placement_permutation(placement: Placement) -> tuple:
+    """``perm[new_slot] = old_slot`` for an equal-slot single-group
+    placement (:func:`rebalance_placement`): device blocks concatenated
+    in device order."""
+    group = placement.groups[0]
+    return tuple(s for d in group for s in placement.local_experts[d])
 
 
 def uniform_placement(n_devices: int, cfg: MoEConfig) -> Placement:

@@ -18,6 +18,9 @@ Model details: :mod:`flashmoe_tpu.planner.model` docstring and
 ``docs/PLANNER.md``.
 """
 
+from flashmoe_tpu.planner.adapt import (  # noqa: F401
+    MorphPlan, measured_ledger, replan,
+)
 from flashmoe_tpu.planner.drift import (  # noqa: F401
     DriftRecord, OverlapDriftRecord, drift_report, record_drift,
     record_overlap_drift,

@@ -1,0 +1,189 @@
+"""Drift-corrected mid-job re-selection: the planner half of the
+self-healing runtime controller (:mod:`flashmoe_tpu.runtime.controller`).
+
+PR 1's planner selects an execution path ONCE, from analytic priors
+(plus committed measurements); PR 8's profiler measures where reality
+disagrees.  This module closes that gap RaMP-style (runtime-aware
+polymorphism, arXiv 2604.26039): given the live telemetry the
+controller accumulates — the measured cost of the path actually running
+and the observed routing shape — re-run the selection with the
+*measured* ledger overriding the analytic prior for the running path,
+and emit a :class:`MorphPlan` the runner can re-jit onto at a step
+boundary (``models/transformer._resolved_plan`` re-resolves on the
+fresh trace).
+
+Two morph axes:
+
+* **path re-selection** — :func:`replan` prices every feasible
+  candidate with the measured ledger CORRECTING the analytic prior for
+  the families it covers (deliberately NOT select_path's
+  measured-winner rule: with only the running path measured, that rule
+  would re-elect the degraded path it was meant to demote), so a path
+  that has drifted slow in production loses to the next candidate on
+  real numbers; the chunk sweep and wire identity ride along
+  unchanged.
+* **capacity -> dropless morphing** — when the trigger is *token
+  drops* (sustained routing skew overflowing the capacity buffers, the
+  chaos harness's ``skew_sustained`` drill), latency re-pricing cannot
+  help: the capacity-format paths are pricing tokens they THREW AWAY.
+  ``prefer_dropless=True`` then targets a dropless execution: the
+  ragged transport when the planner prices it feasible at this width,
+  else the same path with ``drop_tokens=False`` (capacity = all
+  tokens).
+
+Everything here is a pure host-side query — no graph is touched until
+the runner rebuilds its step with the returned overrides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from flashmoe_tpu.config import MoEConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class MorphPlan:
+    """One re-selection verdict: the config overrides a runner applies
+    (``cfg.replace(**overrides)``) before re-jitting, plus the evidence
+    trail for the ``controller.morph`` decision record."""
+
+    overrides: dict             # MoEConfig.replace kwargs ({} = no-op)
+    backend: str                # execution path the morph targets
+    a2a_chunks: int | None
+    dropless: bool              # True when the morph disables drops
+    mode: str                   # 'reselect' | 'dropless' | 'noop'
+    predicted_ms: float | None  # target's predicted latency (d>1 only)
+    reason: str
+
+    @property
+    def is_noop(self) -> bool:
+        return not self.overrides
+
+
+def measured_ledger(family: str, measured_ms: float) -> dict:
+    """The measured-override dict for :func:`replan`: the running
+    path's family priced at its OBSERVED per-step MoE cost.  Thin, but
+    named — the controller and tests build the ledger through one
+    spelling."""
+    return {family: float(measured_ms)}
+
+
+def current_family(cfg: MoEConfig, d: int) -> str:
+    """The measurement family of the path ``cfg`` is running at width
+    ``d`` (what :func:`replan`'s measured override should be keyed
+    by)."""
+    if d <= 1 or cfg.ep <= 1:
+        return "local"
+    if cfg.moe_backend == "auto":
+        from flashmoe_tpu.planner.select import resolve_moe_plan
+
+        return resolve_moe_plan(cfg)[0]
+    return cfg.moe_backend
+
+
+def replan(cfg: MoEConfig, d: int = 1, *, gen: str | None = None,
+           measured_ms: dict | None = None,
+           prefer_dropless: bool = False,
+           slices: int = 1) -> MorphPlan:
+    """Re-select the MoE execution strategy from live telemetry.
+
+    ``measured_ms``: {path_family: observed ms} — the drift-corrected
+    ledger (:func:`measured_ledger`); it overrides the analytic prior
+    for those families exactly like a committed tuning measurement.
+    ``prefer_dropless``: the trigger is token drops, not latency — the
+    morph must land on a dropless execution (see module docstring).
+
+    Single-chip widths (``d <= 1``) have one execution path, so the
+    only meaningful morph is the dropless flip."""
+    if prefer_dropless and not cfg.drop_tokens:
+        return MorphPlan({}, current_family(cfg, d), cfg.a2a_chunks,
+                         dropless=True, mode="noop", predicted_ms=None,
+                         reason="already dropless")
+    if d <= 1:
+        if prefer_dropless:
+            return MorphPlan(
+                {"drop_tokens": False}, "local", None, dropless=True,
+                mode="dropless", predicted_ms=None,
+                reason="single-chip capacity path overflowing: disable "
+                       "token drops (capacity = all tokens)")
+        return MorphPlan({}, "local", None, dropless=False, mode="noop",
+                         predicted_ms=None,
+                         reason="single-chip: nothing to re-select")
+
+    from flashmoe_tpu import tuning
+    from flashmoe_tpu.planner.select import select_path
+
+    gen = gen or tuning.generation()
+    # NOTE: the ledger is deliberately NOT passed through select_path's
+    # ``measured=`` override.  That rule elects the fastest MEASURED
+    # family over every prediction — correct for committed tuning
+    # entries (all families measured), but with a single live entry
+    # (the running path, measured precisely because it drifted SLOW)
+    # the degraded path would be the only measured family and therefore
+    # always re-elect itself.  Here the measurement must CORRECT the
+    # running family's prior and then compete against the other
+    # families' priors.
+    sel = select_path(cfg, d, gen, slices=slices, record=False,
+                      sweep_chunks=True)
+
+    if prefer_dropless:
+        # target the dropless transport the planner prices feasible at
+        # this width; ragged is the native dropless path — fall back to
+        # the capacity transport with drops disabled when it is not
+        # runnable for this config
+        ragged_ok = (not cfg.num_shared_experts and cfg.tp == 1 and any(
+            p.feasible and p.family == "ragged" for p in sel.predictions))
+        if ragged_ok:
+            pred = min((p for p in sel.predictions
+                        if p.feasible and p.family == "ragged"),
+                       key=lambda p: p.total_ms)
+            over: dict = {"drop_tokens": False}
+            if cfg.moe_backend != "ragged":
+                over["moe_backend"] = "ragged"
+            if cfg.a2a_chunks is not None:
+                over["a2a_chunks"] = None  # re-swept by the new path
+            return MorphPlan(
+                over, "ragged", None, dropless=True, mode="dropless",
+                predicted_ms=pred.total_ms,
+                reason="sustained drops: morph onto the dropless "
+                       "ragged transport")
+        return MorphPlan(
+            {"drop_tokens": False}, sel.backend, sel.a2a_chunks,
+            dropless=True, mode="dropless", predicted_ms=sel.predicted_ms,
+            reason="sustained drops: ragged not runnable here — "
+                   "disable token drops on the current transport")
+
+    # drift-corrected comparison: each feasible family's cost is its
+    # measured ms when the ledger covers it, else its analytic prior —
+    # the slow running path now competes on its REAL number
+    ledger = dict(measured_ms or {})
+    feasible = [p for p in sel.predictions if p.feasible]
+    by_family: dict = {}
+    for p in feasible:
+        cost = ledger.get(p.family, p.total_ms)
+        prev = by_family.get(p.family)
+        if prev is None or cost < prev[0]:
+            by_family[p.family] = (cost, p)
+    if not by_family:
+        return MorphPlan({}, sel.backend, sel.a2a_chunks,
+                         dropless=not cfg.drop_tokens, mode="noop",
+                         predicted_ms=sel.predicted_ms,
+                         reason="no feasible candidate to re-select")
+    _, win = min(by_family.values(), key=lambda t: (t[0], t[1].family))
+
+    over = {}
+    if win.backend != current_family(cfg, d) \
+            and win.backend != cfg.moe_backend:
+        over["moe_backend"] = win.backend
+    chunks = win.a2a_chunks if win.a2a_chunks and win.a2a_chunks > 1 \
+        else None
+    if chunks != cfg.a2a_chunks:
+        over["a2a_chunks"] = chunks
+    mode = "reselect" if over else "noop"
+    return MorphPlan(
+        over, win.backend, chunks, dropless=not cfg.drop_tokens,
+        mode=mode, predicted_ms=win.total_ms,
+        reason=(f"measured-corrected re-selection: {win.family!r} beats "
+                f"the running path's observed cost" if over else
+                "re-selection confirms the running path"))

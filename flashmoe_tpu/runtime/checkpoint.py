@@ -143,20 +143,29 @@ def _walk_payload(root: str) -> dict[str, dict]:
 
 
 def write_manifest(directory: str, step: int,
-                   loader_state: dict | None = None) -> str:
+                   loader_state: dict | None = None,
+                   controller_state: dict | None = None) -> str:
     """Checksum every file under the step dir into manifest-<step>.json.
     Called by :func:`save` after the write lands; returns the path.
 
     ``loader_state``: the data-loader cursor captured with the state
     snapshot (``TokenLoader.state_dict()``) — stored in the manifest so
     a resumed run consumes the exact token stream the dead run would
-    have (:func:`load_loader_state`).  Written AFTER the payload is
-    durable: a kill between the two leaves a legacy-style manifest-less
-    checkpoint, never a manifest pointing at missing bytes."""
+    have (:func:`load_loader_state`).  ``controller_state``: the
+    self-healing controller's persistent plan (morph overrides, replica
+    map, spent budgets — :meth:`flashmoe_tpu.runtime.controller.
+    RuntimeController.state_dict`), tied to the step so a restore
+    always resumes the plan the PARAMS were written under (a replica
+    map without its weight copies, or vice versa, would corrupt the
+    model).  Written AFTER the payload is durable: a kill between the
+    two leaves a legacy-style manifest-less checkpoint, never a
+    manifest pointing at missing bytes."""
     root = step_dir(directory, step)
     manifest = {"step": step, "files": _walk_payload(root)}
     if loader_state is not None:
         manifest["loader"] = dict(loader_state)
+    if controller_state is not None:
+        manifest["controller"] = dict(controller_state)
     path = _manifest_path(directory, step)
     # per-process tmp name + atomic replace: even if two writers race
     # (they should not — save() gates on process 0), no reader ever sees
@@ -204,6 +213,20 @@ def load_loader_state(directory: str, step: int) -> dict | None:
         return None
     loader = manifest.get("loader")
     return dict(loader) if isinstance(loader, dict) else None
+
+
+def load_controller_state(directory: str, step: int) -> dict | None:
+    """The self-healing controller's plan stored with the step's
+    manifest, or None (no controller, legacy checkpoint, unreadable
+    manifest).  Restored by ``supervise``/``resilient_train`` so a
+    restart resumes the morphed plan the params were saved under."""
+    try:
+        with open(_manifest_path(directory, step)) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return None
+    cs = manifest.get("controller")
+    return dict(cs) if isinstance(cs, dict) else None
 
 
 def restore_loader_state(directory: str, step: int, loader) -> bool:
@@ -305,9 +328,10 @@ class _AsyncWriter:
                     self._cond.wait()
                 job = self._pending.pop(next(iter(self._pending)))
                 self._in_flight = True
-            directory, host_state, step, loader_state = job
+            directory, host_state, step, loader_state, ctrl_state = job
             try:
-                _write_sync(directory, host_state, step, loader_state)
+                _write_sync(directory, host_state, step, loader_state,
+                            ctrl_state)
                 with self._cond:
                     self.completed += 1
             except Exception as e:  # noqa: BLE001 — surfaced via barrier
@@ -358,7 +382,8 @@ def async_save_stats() -> dict:
 # ----------------------------------------------------------------------
 
 def _write_sync(directory: str, state: TrainState, step: int,
-                loader_state: dict | None) -> None:
+                loader_state: dict | None,
+                controller_state: dict | None = None) -> None:
     """The durable write: orbax payload (atomic step-dir commit), THEN
     the CRC manifest.  The ordering is the async-crash guarantee — a
     kill mid-payload leaves only an uncommitted tmp dir (invisible to
@@ -371,32 +396,38 @@ def _write_sync(directory: str, state: TrainState, step: int,
     # array write across hosts, but the manifest is plain JSON on a
     # shared directory — every process writing it would race
     if jax.process_index() == 0:
-        write_manifest(directory, step, loader_state=loader_state)
+        write_manifest(directory, step, loader_state=loader_state,
+                       controller_state=controller_state)
         _prune_stale_manifests(directory)
 
 
 def save(directory: str, state: TrainState, step: int | None = None,
          wait: bool = True, *, blocking: bool = True,
-         loader_state: dict | None = None) -> int:
+         loader_state: dict | None = None,
+         controller_state: dict | None = None) -> int:
     """Save a checkpoint; returns the step it was saved under.
 
     ``blocking=False`` snapshots the state to host (``jax.device_get`` —
     the only cost left on the step loop) and hands serialize + fsync +
     atomic-rename to the background writer; call :func:`wait_for_saves`
     before exiting (drain/emergency paths do).  ``loader_state`` is the
-    data-loader cursor to persist in the step's manifest.
+    data-loader cursor to persist in the step's manifest;
+    ``controller_state`` the self-healing controller's plan
+    (:func:`load_controller_state`).
     """
     step = int(state.step) if step is None else step
     if not blocking:
         host_state = jax.device_get(state)
-        _WRITER.submit((directory, host_state, step, loader_state))
+        _WRITER.submit((directory, host_state, step, loader_state,
+                        controller_state))
         return step
     mgr = _manager(directory)
     mgr.save(step, args=ocp.args.StandardSave(_payload(state)))
     if wait:
         mgr.wait_until_finished()
         if jax.process_index() == 0:
-            write_manifest(directory, step, loader_state=loader_state)
+            write_manifest(directory, step, loader_state=loader_state,
+                           controller_state=controller_state)
             _prune_stale_manifests(directory)
     return step
 
@@ -485,7 +516,8 @@ def _fresh_guard(template_guard):
 
 
 def emergency_save(directory: str, state: TrainState,
-                   loader_state: dict | None = None) -> int | None:
+                   loader_state: dict | None = None,
+                   controller_state: dict | None = None) -> int | None:
     """Best-effort save for abort paths: persists ``state`` unless its
     step is already on disk; swallows every error (the caller is already
     crashing — the emergency copy must never mask the original fault).
@@ -506,7 +538,8 @@ def emergency_save(directory: str, state: TrainState,
         if latest_step(directory) == step:
             return None
         saved = save(directory, state, step=step,
-                     loader_state=loader_state)
+                     loader_state=loader_state,
+                     controller_state=controller_state)
         _telemetry.decision("checkpoint.emergency_save",
                             directory=os.path.abspath(directory),
                             step=saved)

@@ -1,0 +1,561 @@
+"""Self-healing runtime controller: close the telemetry -> planner ->
+placement loop (ROADMAP item 3; RaMP runtime-aware polymorphism,
+arXiv 2604.26039).
+
+Everything this module consumes already existed open-loop after PRs
+2-8: MoEStats load histograms and drop fractions (PR 2), the SLO
+watchdog and ``PathFailure`` demotion (PRs 3/8), the phase/overlap
+drift monitors (PRs 6/8), the Kruskal/union-find Decider
+(:mod:`flashmoe_tpu.parallel.decider`) and the elastic re-fold /
+checkpoint machinery (PR 4).  The controller is the loop closure: it
+watches those streams through debounced, hysteretic triggers and — at
+step boundaries only — performs two graduated recovery actions:
+
+* **path morphing** (:class:`MorphAction`) — re-run the planner's
+  selection with the MEASURED cost of the running path overriding its
+  analytic prior (:func:`flashmoe_tpu.planner.adapt.replan`) and switch
+  backend / chunk depth / capacity mode mid-job, re-jitting behind the
+  existing ``_resolved_plan`` seam (the runner rebuilds its train step
+  with ``cfg.replace(**overrides)``; params and optimizer state are
+  untouched).  Triggered by sustained token drops / load skew.
+* **expert re-placement** (:class:`ReplaceAction`) — feed the observed
+  per-expert load histogram (EMA of the MoEStats ``expert_load``
+  vector) into the Decider's rate-proportional assignment
+  (:func:`flashmoe_tpu.parallel.decider.rebalance_placement`), emit a
+  new :class:`~flashmoe_tpu.parallel.decider.Placement`, and carry
+  expert weights (and their optimizer moments) to their new owners by
+  permuting the live TrainState (:func:`permute_expert_state`) — the
+  same logical-array resharding story the elastic re-fold machinery
+  uses, applied along the expert axis.  When a ~dead expert slot
+  exists, the hottest expert is REPLICATED onto it
+  (``MoEConfig.expert_replicas`` + the controller's weight copy): its
+  traffic splits across two value-identical physical slots and the
+  combine merges contributions unchanged.  Triggered by a sustained
+  step-time regression (a slow/degraded device).
+
+Oscillation is impossible by construction: every action starts a
+cooldown window (triggers during it are recorded as
+``controller.cooldown`` decisions, not acted on), each action class has
+a hard per-job budget, and the skew trigger is hysteretic (the debounce
+counter resets the moment the condition clears).  Every action is a
+registered telemetry decision (``controller.morph`` /
+``controller.replace`` / ``controller.cooldown``), the full trigger ->
+action timeline rides :meth:`RuntimeController.state_dict` into the
+checkpoint manifest (so restarts resume with the morphed plan and the
+spent budgets, and a postmortem can replay the whole adaptation story —
+``python -m flashmoe_tpu.observe`` renders it as the adaptation
+report).
+
+Default off = bit-identical: a run without a controller takes exactly
+the pre-controller code path, and the one in-graph mechanism the
+controller can enable (``MoEConfig.expert_replicas``) is registered in
+the staticcheck knob matrix with its own invariant row.
+
+Wiring: ``resilient_train(..., controller=, rebuild_step=)`` and
+``supervise(..., controller=)`` / ``ResilienceConfig.adapt``;
+``runtime.trainer.train(..., controller=)`` for the plain loop.
+Drilled by ``python -m flashmoe_tpu.chaos`` (``skew_sustained`` must
+recover via morph, ``slow_device`` via re-placement).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+import numpy as np
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.utils.telemetry import Metrics, metrics as _global
+
+
+@dataclasses.dataclass(frozen=True)
+class ControllerConfig:
+    """Trigger thresholds, debounce/cooldown windows, and action
+    budgets.  Defaults are deliberately conservative: a controller
+    should be boringly inert on a healthy job."""
+
+    enable_morph: bool = True
+    enable_replace: bool = True
+    # --- skew trigger (drives morphing) ---
+    drop_high: float = 0.05        # dropped-fraction EMA above => skew
+    imbalance_high: float = 2.5    # load-imbalance EMA above => skew
+    # --- slow trigger (drives re-placement) ---
+    slow_factor: float = 1.5       # step_ms EMA > factor * baseline
+    baseline_steps: int = 3        # baseline = min of the first N steps
+    # --- dynamics ---
+    debounce_steps: int = 3        # consecutive triggering observations
+    cooldown_steps: int = 8        # no action for N steps after one
+    ema_decay: float = 0.5         # per-step EMA decay of every signal
+    # --- budgets (oscillation bound: hard per-job caps) ---
+    morph_budget: int = 2
+    replace_budget: int = 2
+    # --- replication policy ---
+    replicate: bool = True         # allow hot-expert replication
+    cold_eps: float = 1e-3         # "dead slot" load-share ceiling
+    # a re-placement must improve the projected bottleneck finish time
+    # by at least this fraction, else it is a noop (a balanced layout
+    # must never be churned for marginal or zero gain)
+    min_replace_gain: float = 0.1
+
+    def __post_init__(self):
+        if self.debounce_steps < 1:
+            raise ValueError("debounce_steps must be >= 1")
+        if self.cooldown_steps < 0:
+            raise ValueError("cooldown_steps must be >= 0")
+        if not 0 < self.ema_decay < 1:
+            raise ValueError("ema_decay must be in (0, 1)")
+        if self.slow_factor <= 1.0:
+            raise ValueError("slow_factor must be > 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class MorphAction:
+    """Path morph: rebuild the step with ``overrides`` applied."""
+
+    overrides: dict
+    trigger: str
+    reason: str
+
+    @property
+    def needs_rebuild(self) -> bool:
+        return bool(self.overrides)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplaceAction:
+    """Expert re-placement: permute the live state by ``perm`` and, for
+    each (hot, slot) replica pair, copy the hot expert's FFN weights
+    onto the victim slot.  ``overrides`` carries the matching
+    ``expert_replicas`` config change (empty when no replication, in
+    which case the permutation needs no rebuild at all — the graph is
+    placement-agnostic, only the params move)."""
+
+    perm: tuple
+    replica_pairs: tuple
+    overrides: dict
+    trigger: str
+    reason: str
+
+    @property
+    def needs_rebuild(self) -> bool:
+        return bool(self.overrides)
+
+
+#: MoE param leaves stacked on a leading expert axis (permuted by
+#: ``perm`` along axis 0); ``gate_w`` is the router table, permuted
+#: along its expert COLUMNS instead
+_EXPERT_AXIS0 = frozenset({"w_up", "b_up", "w_down", "b_down", "w_gate"})
+
+
+def _key_str(k) -> str:
+    return re.sub(r"[^A-Za-z0-9_]", "", str(k))
+
+
+def permute_expert_state(state, cfg: MoEConfig, perm,
+                         replica_pairs=()):
+    """Re-place experts in a live TrainState: every MoE leaf (params
+    AND their mirrored optimizer moments — optax embeds the param tree,
+    so trailing key paths match) with an expert axis is permuted by
+    ``perm[new_slot] = old_slot``; ``gate_w`` columns move with their
+    experts, so the model computes the identical function under the new
+    physical layout.  ``replica_pairs``: (hot, victim) NEW-slot pairs —
+    the victim slot's FFN weights (and moments) are overwritten with
+    the hot slot's copy (its router column is left alone; the in-graph
+    split happens after top-k, :func:`flashmoe_tpu.ops.gate.
+    apply_replicas`).
+
+    Host round-trip per touched leaf (device_get -> permute ->
+    device_put onto the original sharding): re-placement is a rare
+    step-boundary action, not a hot path."""
+    import jax
+    import jax.numpy as jnp
+
+    e = cfg.num_experts
+    perm = tuple(int(p) for p in perm)
+    if sorted(perm) != list(range(e)):
+        raise ValueError(f"perm must be a permutation of range({e}), "
+                         f"got {perm}")
+    idx = np.asarray(perm)
+
+    def fix(path, leaf):
+        keys = [_key_str(k) for k in path]
+        if "moe" not in keys or not hasattr(leaf, "shape"):
+            return leaf
+        name = keys[-1]
+        if name == "gate_w" and leaf.ndim >= 2 and leaf.shape[-1] == e:
+            arr = np.asarray(jax.device_get(leaf))[..., idx]
+        elif name in _EXPERT_AXIS0 and leaf.ndim >= 1 \
+                and leaf.shape[0] == e:
+            arr = np.asarray(jax.device_get(leaf))[idx]
+            for hot, slot in replica_pairs:
+                arr[slot] = arr[hot]
+        else:
+            return leaf
+        sharding = getattr(leaf, "sharding", None)
+        out = jnp.asarray(arr)
+        return jax.device_put(out, sharding) if sharding is not None \
+            else out
+
+    return jax.tree_util.tree_map_with_path(fix, state)
+
+
+class RuntimeController:
+    """The closed loop.  Feed it every step
+    (:meth:`observe_step`), ask it at every step boundary
+    (:meth:`maybe_act`), apply what it returns
+    (:meth:`apply_action` for re-placements; rebuild the step with
+    :attr:`cfg_overrides` when ``action.needs_rebuild``).
+
+    ``n_devices``: the device count the placement math targets (the EP
+    width; defaults to ``cfg.ep`` or 1).  ``rates_fn``: optional
+    callable returning per-device throughput (e.g. a re-run of the
+    bootstrap probe, or the chaos drill's simulated rates); None prices
+    devices uniformly.  ``d`` / ``gen``: the planner width/generation
+    morphs re-select at (default ``n_devices`` / the trace-time pin).
+    """
+
+    def __init__(self, cfg: MoEConfig,
+                 ccfg: ControllerConfig | None = None, *,
+                 metrics: Metrics | None = None,
+                 rates_fn=None, n_devices: int | None = None,
+                 d: int | None = None, gen: str | None = None):
+        self.cfg = cfg
+        self.ccfg = ccfg or ControllerConfig()
+        self.metrics = metrics if metrics is not None else _global
+        self.rates_fn = rates_fn
+        self.n_devices = int(n_devices or max(cfg.ep, 1))
+        if cfg.num_experts % self.n_devices:
+            raise ValueError(
+                f"n_devices={self.n_devices} must divide "
+                f"num_experts={cfg.num_experts}")
+        self.d = int(d) if d is not None else self.n_devices
+        self.gen = gen
+        # --- live signal state ---
+        self.load_ema: np.ndarray | None = None   # [E] slot loads
+        self.imbalance_ema: float | None = None
+        self.drop_ema: float | None = None
+        self.step_ms_ema: float | None = None
+        # last INSTANTANEOUS observations: the debounce counters run on
+        # these, not the EMAs — a single spike must not keep a trigger
+        # "active" while its EMA tail decays across the window
+        self._last_drop: float | None = None
+        self._last_imb: float | None = None
+        self._last_step_ms: float | None = None
+        self.baseline_ms: float | None = None
+        self._baseline_seen: list[float] = []
+        self._skew_run = 0
+        self._slow_run = 0
+        # --- persistent (manifest-riding) state ---
+        self.overrides: dict = {}
+        self.morphs_used = 0
+        self.replaces_used = 0
+        self.cooldown_until = -1
+        self.timeline: list[dict] = []
+        self._cooldown_logged: set = set()
+
+    # ------------------------------------------------------------------
+    # Observation
+    # ------------------------------------------------------------------
+
+    def _ema(self, prev, value):
+        a = self.ccfg.ema_decay
+        return value if prev is None else a * prev + (1 - a) * value
+
+    def observe_step(self, step: int, step_ms: float,
+                     metrics_dict=None) -> None:
+        """Fold one completed step into the trigger state.
+        ``metrics_dict``: the step's device metrics (``moe_stats``
+        consumed when present — requires ``cfg.collect_stats``)."""
+        step = int(step)
+        if len(self._baseline_seen) < self.ccfg.baseline_steps:
+            self._baseline_seen.append(float(step_ms))
+            # min, not mean: the first step carries compile time
+            self.baseline_ms = min(self._baseline_seen)
+        self.step_ms_ema = self._ema(self.step_ms_ema, float(step_ms))
+        self._last_step_ms = float(step_ms)
+
+        stats = None
+        if isinstance(metrics_dict, dict):
+            stats = metrics_dict.get("moe_stats")
+        if stats:
+            from flashmoe_tpu.ops.stats import stats_to_host
+
+            load = None
+            imb, drop = 0.0, 0.0
+            for st in stats:
+                h = st if isinstance(st, dict) else stats_to_host(st)
+                v = np.asarray(h["expert_load"], dtype=np.float64)
+                load = v if load is None else load + v
+                imb = max(imb, float(h["imbalance"]))
+                drop = max(drop, float(h["dropped_fraction"]))
+            if load is not None:
+                if self.load_ema is None \
+                        or self.load_ema.shape != load.shape:
+                    self.load_ema = load
+                else:
+                    a = self.ccfg.ema_decay
+                    self.load_ema = a * self.load_ema + (1 - a) * load
+            self.imbalance_ema = self._ema(self.imbalance_ema, imb)
+            self.drop_ema = self._ema(self.drop_ema, drop)
+            self._last_imb, self._last_drop = imb, drop
+
+        # --- debounce with hysteresis: any clear observation resets ---
+        if self._skew_active():
+            self._skew_run += 1
+        else:
+            self._skew_run = 0
+        if self._slow_active():
+            self._slow_run += 1
+        else:
+            self._slow_run = 0
+
+    def _skew_active(self) -> bool:
+        # instantaneous values: the debounce counts CONSECUTIVE skewed
+        # observations, so a one-step blip resets at the next clear
+        # step instead of riding its EMA decay tail across the window
+        c = self.ccfg
+        return ((self._last_drop is not None
+                 and self._last_drop > c.drop_high)
+                or (self._last_imb is not None
+                    and self._last_imb > c.imbalance_high))
+
+    def _slow_active(self) -> bool:
+        return (self.baseline_ms is not None
+                and self._last_step_ms is not None
+                and len(self._baseline_seen) >= self.ccfg.baseline_steps
+                and self._last_step_ms
+                > self.ccfg.slow_factor * self.baseline_ms)
+
+    def device_load_share(self, device: int) -> float:
+        """Observed load share of one device's slot block under the
+        CURRENT physical layout (slot s lives on device s // nLx) —
+        what a slow-device simulation (or dashboard) reads."""
+        if self.load_ema is None:
+            return 1.0 / self.n_devices
+        total = float(self.load_ema.sum())
+        if total <= 0:
+            return 1.0 / self.n_devices
+        nlx = self.cfg.num_experts // self.n_devices
+        lo = device * nlx
+        return float(self.load_ema[lo:lo + nlx].sum()) / total
+
+    # ------------------------------------------------------------------
+    # Decision
+    # ------------------------------------------------------------------
+
+    @property
+    def cfg_overrides(self) -> dict:
+        """Accumulated ``MoEConfig.replace`` kwargs a rebuilt step must
+        apply (morph targets + the replica routing map)."""
+        return dict(self.overrides)
+
+    def apply_to(self, cfg: MoEConfig) -> MoEConfig:
+        return cfg.replace(**self.overrides) if self.overrides else cfg
+
+    def _current_cfg(self) -> MoEConfig:
+        return self.apply_to(self.cfg)
+
+    def maybe_act(self, step: int, can_rebuild: bool = True):
+        """The step-boundary decision: returns a :class:`MorphAction`,
+        a :class:`ReplaceAction`, or None.  At most one action per
+        boundary; during a cooldown window suppressed triggers are
+        recorded as ``controller.cooldown`` decisions (once per window
+        per trigger)."""
+        step = int(step)
+        c = self.ccfg
+        skew = self._skew_run >= c.debounce_steps and c.enable_morph
+        slow = self._slow_run >= c.debounce_steps and c.enable_replace
+        if not (skew or slow):
+            return None
+        if step < self.cooldown_until:
+            for name, hit in (("skew", skew), ("slow", slow)):
+                key = (name, self.cooldown_until)
+                if hit and key not in self._cooldown_logged:
+                    self._cooldown_logged.add(key)
+                    self._decide("controller.cooldown", step=step,
+                                 trigger=name,
+                                 until=self.cooldown_until)
+            return None
+        # slow wins ties: a degraded device also skews load downstream,
+        # and re-placement is the cheaper action (no retrace unless a
+        # replica lands)
+        if slow and self.replaces_used < c.replace_budget:
+            act = self._plan_replace(step)
+            if act is not None:
+                return act
+            if step < self.cooldown_until:
+                return None  # planned a noop: its cooldown stands
+        if skew and self.morphs_used < c.morph_budget and can_rebuild:
+            act = self._plan_morph(step)
+            if act is not None:
+                return act
+        return None
+
+    def _cooldown(self, step: int) -> None:
+        self.cooldown_until = step + self.ccfg.cooldown_steps
+        self._skew_run = 0
+        self._slow_run = 0
+        # a fresh baseline: the action changed what "normal" looks like
+        self._baseline_seen = []
+        self.baseline_ms = None
+        self.step_ms_ema = None
+        self._last_step_ms = None
+
+    def _decide(self, name: str, **fields) -> dict:
+        rec = self.metrics.decision(  # staticcheck: ok forwarding helper; every call site passes a registered literal
+            name, **fields)
+        self.timeline.append(rec)
+        return rec
+
+    def _plan_morph(self, step: int):
+        from flashmoe_tpu.planner import adapt
+
+        cfg = self._current_cfg()
+        drop_driven = (self.drop_ema is not None
+                       and self.drop_ema > self.ccfg.drop_high)
+        fam = adapt.current_family(cfg, self.d)
+        measured = (adapt.measured_ledger(fam, self.step_ms_ema)
+                    if self.step_ms_ema else None)
+        plan = adapt.replan(cfg, self.d, gen=self.gen,
+                            measured_ms=measured,
+                            prefer_dropless=drop_driven)
+        if plan.is_noop:
+            self._decide("controller.cooldown", step=step,
+                         trigger="skew", until=step,
+                         reason=f"replan noop: {plan.reason}")
+            self._cooldown(step)
+            return None
+        self.overrides.update(plan.overrides)
+        self.morphs_used += 1
+        self._cooldown(step)
+        self._decide(
+            "controller.morph", step=step, trigger="skew",
+            mode=plan.mode, backend=plan.backend,
+            a2a_chunks=plan.a2a_chunks, dropless=plan.dropless,
+            overrides={k: v for k, v in plan.overrides.items()},
+            drop_ema=(round(self.drop_ema, 4)
+                      if self.drop_ema is not None else None),
+            imbalance_ema=(round(self.imbalance_ema, 4)
+                           if self.imbalance_ema is not None else None),
+            predicted_ms=plan.predicted_ms,
+            budget_left=self.ccfg.morph_budget - self.morphs_used,
+            reason=plan.reason)
+        return MorphAction(dict(plan.overrides), "skew", plan.reason)
+
+    def _plan_replace(self, step: int):
+        from flashmoe_tpu.parallel.decider import (
+            placement_permutation, rebalance_placement,
+        )
+
+        if self.load_ema is None or float(self.load_ema.sum()) <= 0:
+            return None  # no load signal yet: nothing to re-place on
+        rates = (np.asarray(self.rates_fn(), dtype=np.float64)
+                 if self.rates_fn is not None else None)
+        placement = rebalance_placement(
+            self.load_ema, self.n_devices, self.cfg, rates=rates,
+            replicate=self.ccfg.replicate, cold_eps=self.ccfg.cold_eps)
+        perm = placement_permutation(placement)
+        pairs = tuple(sorted(
+            (int(hot), int(v))
+            for hot, vs in placement.replicas.items() for v in vs))
+
+        # projected bottleneck finish time, current layout vs proposal
+        # (a replica halves its hot slot's load): churn only for a real
+        # improvement — a balanced layout re-shuffled for zero gain
+        # would look like oscillation
+        r = (rates if rates is not None
+             else np.ones(self.n_devices, dtype=np.float64))
+        nlx = self.cfg.num_experts // self.n_devices
+
+        def makespan(slot_loads):
+            per_dev = slot_loads.reshape(self.n_devices, nlx).sum(axis=1)
+            return float(np.max(per_dev / np.maximum(r, 1e-9)))
+
+        cur = makespan(self.load_ema)
+        proposed_loads = self.load_ema[np.asarray(perm)].copy()
+        for hot, victim in pairs:
+            proposed_loads[victim] = proposed_loads[hot] / 2
+            proposed_loads[hot] /= 2
+        proposed = makespan(proposed_loads)
+        if (perm == tuple(range(self.cfg.num_experts)) and not pairs) \
+                or proposed > cur * (1 - self.ccfg.min_replace_gain):
+            self._decide("controller.cooldown", step=step,
+                         trigger="slow", until=step,
+                         reason="re-placement noop: layout already "
+                                "rate-balanced "
+                                f"(projected {proposed:.3g} vs "
+                                f"current {cur:.3g})")
+            self._cooldown(step)
+            return None
+        before = [self.device_load_share(d)
+                  for d in range(self.n_devices)]
+        overrides = {"expert_replicas": pairs} if pairs else {}
+        if pairs:
+            self.overrides["expert_replicas"] = pairs
+        self.replaces_used += 1
+        self._cooldown(step)
+        rec_rates = (rates.tolist() if rates is not None else None)
+        self._decide(
+            "controller.replace", step=step, trigger="slow",
+            perm=list(perm), replicas=[list(p) for p in pairs],
+            device_share_before=[round(s, 4) for s in before],
+            rates=rec_rates,
+            step_ms_ema=(round(self.step_ms_ema, 3)
+                         if self.step_ms_ema is not None else None),
+            baseline_ms=(round(self.baseline_ms, 3)
+                         if self.baseline_ms is not None else None),
+            budget_left=self.ccfg.replace_budget - self.replaces_used,
+            reason="sustained step-time regression: rate-proportional "
+                   "re-placement of the observed load histogram")
+        # the load histogram indexes physical slots: re-index it under
+        # the new layout so post-action observations stay coherent
+        self.load_ema = self.load_ema[np.asarray(perm)]
+        return ReplaceAction(perm, pairs, overrides, "slow",
+                             "rate-proportional expert re-placement")
+
+    # ------------------------------------------------------------------
+    # Application / persistence
+    # ------------------------------------------------------------------
+
+    def apply_action(self, action, state):
+        """Apply an action to the live TrainState.  Morphs leave the
+        state untouched (the runner rebuilds the step); re-placements
+        permute expert params/moments and copy replica weights."""
+        if isinstance(action, ReplaceAction):
+            return permute_expert_state(state, self.cfg, action.perm,
+                                        action.replica_pairs)
+        return state
+
+    def state_dict(self) -> dict:
+        """JSON-able persistent state, written into every checkpoint
+        manifest after an action (``runtime.checkpoint.save(...,
+        controller_state=)``), so a restarted incarnation resumes with
+        the morphed plan, the replica map, and the SPENT budgets — a
+        restart must not refill the oscillation bound."""
+        ov = dict(self.overrides)
+        if "expert_replicas" in ov:
+            ov["expert_replicas"] = [list(p)
+                                     for p in ov["expert_replicas"]]
+        return {"overrides": ov,
+                "morphs_used": self.morphs_used,
+                "replaces_used": self.replaces_used,
+                "timeline": list(self.timeline)}
+
+    def load_state_dict(self, sd: dict) -> None:
+        ov = dict(sd.get("overrides") or {})
+        if ov.get("expert_replicas"):
+            ov["expert_replicas"] = tuple(
+                tuple(int(v) for v in p) for p in ov["expert_replicas"])
+        elif "expert_replicas" in ov:
+            ov.pop("expert_replicas")
+        self.overrides = ov
+        # budgets are MONOTONIC: a rewind restores the plan the params
+        # were saved under but never refills the oscillation bound
+        self.morphs_used = max(self.morphs_used,
+                               int(sd.get("morphs_used", 0)))
+        self.replaces_used = max(self.replaces_used,
+                                 int(sd.get("replaces_used", 0)))
+        stored = list(sd.get("timeline") or [])
+        if len(stored) > len(self.timeline):
+            self.timeline = stored

@@ -93,6 +93,11 @@ class ResilienceConfig:
     # periodic saves off the step loop: the loop pays only the host
     # snapshot; drain/failure paths barrier on ckpt.wait_for_saves()
     async_save: bool = False
+    # self-healing runtime controller (flashmoe_tpu/runtime/controller):
+    # a ControllerConfig arms mid-job path morphing + expert
+    # re-placement in supervise()/resilient_train.  Default None = off =
+    # the exact pre-controller loop (bit-identical training).
+    adapt: "object | None" = None
 
 
 def _run_step(step_fn, state, batch, timeout_s, ex_box=None):
@@ -219,7 +224,8 @@ def resilient_train(state: TrainState, step_fn: Callable,
                     metrics: Metrics | None = None,
                     fail_injector: Callable | None = None,
                     preempt=None, slo=None,
-                    postmortem_dir: str | None = None, cfg=None):
+                    postmortem_dir: str | None = None, cfg=None,
+                    controller=None, rebuild_step: Callable | None = None):
     """Run ``num_steps`` with detection + restore-and-retry recovery.
 
     ``step_fn(state, batch) -> (state, metrics_dict)`` — e.g. from
@@ -236,6 +242,18 @@ def resilient_train(state: TrainState, step_fn: Callable,
     :class:`flashmoe_tpu.runtime.data.TokenLoader`), its cursor rides
     every checkpoint manifest and is restored on resume — the continued
     run consumes the exact token stream of an uninterrupted one.
+
+    ``controller``: a :class:`flashmoe_tpu.runtime.controller.
+    RuntimeController` closes the telemetry loop — it observes every
+    successful step and may, at a step boundary, morph the execution
+    path or re-place experts (docs/RESILIENCE.md "Self-healing
+    controller").  ``rebuild_step(overrides) -> step_fn`` rebuilds the
+    jitted step with the controller's accumulated
+    ``MoEConfig.replace`` overrides applied (``supervise`` provides
+    one automatically); without it, actions that need a re-jit are
+    not offered.  Every controller action forces an immediate
+    checkpoint whose manifest carries the controller plan, so restores
+    and restarts resume the layout the params were written under.
 
     ``slo``: an :class:`flashmoe_tpu.profiler.slo.SLOConfig` / prebuilt
     watchdog — every successful step's wall time is judged against the
@@ -260,12 +278,32 @@ def resilient_train(state: TrainState, step_fn: Callable,
     watchdog = _as_watchdog(slo)
     history = []
 
+    def _ctrl_state():
+        return controller.state_dict() if controller is not None else None
+
+    def _ctrl_resync(step: int):
+        # a restore landed on some step's params: the controller plan
+        # (morph overrides, replica map) must be the one THOSE params
+        # were saved under, and the step must be rebuilt onto it — a
+        # replica routing map without its weight copies corrupts the
+        # model (budgets stay monotonic; a rewind never refills them)
+        nonlocal step_fn
+        if controller is None:
+            return
+        cs = ckpt.load_controller_state(rcfg.checkpoint_dir, step)
+        before = controller.cfg_overrides
+        controller.load_state_dict(cs or {})
+        if rebuild_step is not None \
+                and controller.cfg_overrides != before:
+            step_fn = rebuild_step(controller.cfg_overrides)
+
     # resume if a checkpoint exists
     start = ckpt.latest_step(rcfg.checkpoint_dir)
     if start is not None and start > int(state.step):
         state = ckpt.restore(rcfg.checkpoint_dir, state,
                              check_integrity=rcfg.verify_checkpoints)
         metrics.count("resumes")
+        _ctrl_resync(int(state.step))
         # the restore may have FALLEN BACK to an older intact step:
         # position the loader for the step actually restored
         if ckpt.restore_loader_state(rcfg.checkpoint_dir,
@@ -312,7 +350,8 @@ def resilient_train(state: TrainState, step_fn: Callable,
                     ckpt.wait_for_saves()
                     if ckpt.latest_step(rcfg.checkpoint_dir) != i:
                         ckpt.save(rcfg.checkpoint_dir, state, step=i,
-                                  loader_state=replay.loader_state_for(i))
+                                  loader_state=replay.loader_state_for(i),
+                                  controller_state=_ctrl_state())
                         metrics.count("checkpoints")
                 metrics.count("preempt_drains")
                 metrics.decision(
@@ -372,12 +411,14 @@ def resilient_train(state: TrainState, step_fn: Callable,
                         lstate = replay.loader_state_for(i)
                         saved = ckpt.emergency_save(
                             rcfg.checkpoint_dir, state,
-                            loader_state=lstate)
+                            loader_state=lstate,
+                            controller_state=_ctrl_state())
                         if saved is None and safe_state is not None:
                             saved = ckpt.emergency_save(
                                 rcfg.checkpoint_dir,
                                 jax.device_put(safe_state, shardings),
-                                loader_state=lstate)
+                                loader_state=lstate,
+                                controller_state=_ctrl_state())
                         if saved is not None:
                             metrics.count("emergency_saves")
                     raise StepFailure(
@@ -392,6 +433,7 @@ def resilient_train(state: TrainState, step_fn: Callable,
                         state = ckpt.restore(
                             rcfg.checkpoint_dir, template,
                             check_integrity=rcfg.verify_checkpoints)
+                        _ctrl_resync(int(state.step))
                     except ckpt.CheckpointCorruptionError as ce:
                         # NOTHING intact on disk.  The in-memory mirror
                         # (if it still exists) is the recovery point of
@@ -426,6 +468,8 @@ def resilient_train(state: TrainState, step_fn: Callable,
                 # into planner path demotion (slo.breach decisions)
                 watchdog.observe_step(i, step_s * 1e3,
                                       phases=step_phases)
+            if controller is not None:
+                controller.observe_step(i, step_s * 1e3, m)
             rec = scalar_metrics(m)
             if rec.get("grad_ok", 1.0) == 0.0:
                 # tier-1 guard fired inside the step: the update was
@@ -437,11 +481,29 @@ def resilient_train(state: TrainState, step_fn: Callable,
                                  grad_norm_ema=rec.get("grad_norm_ema"))
             history.append(rec)
             i += 1
-            if i % rcfg.checkpoint_every == 0 or i == num_steps:
+            force_ckpt = False
+            if controller is not None:
+                # the self-healing decision point: a morph rebuilds the
+                # step onto the controller's accumulated overrides; a
+                # re-placement permutes the live state (and, with a
+                # replica, also rebuilds).  Either way the action is
+                # made durable IMMEDIATELY: the next restore must see
+                # params and plan from the same side of the action.
+                act = controller.maybe_act(
+                    i, can_rebuild=rebuild_step is not None)
+                if act is not None:
+                    state = controller.apply_action(act, state)
+                    if act.needs_rebuild and rebuild_step is not None:
+                        step_fn = rebuild_step(controller.cfg_overrides)
+                    force_ckpt = True
+            if i % rcfg.checkpoint_every == 0 or i == num_steps \
+                    or force_ckpt:
                 with prof.section("train.checkpoint", step=i):
                     ckpt.save(rcfg.checkpoint_dir, state, step=i,
-                              blocking=not rcfg.async_save,
-                              loader_state=replay.loader_state_for(i))
+                              blocking=(not rcfg.async_save
+                                        or force_ckpt),
+                              loader_state=replay.loader_state_for(i),
+                              controller_state=_ctrl_state())
                 ckpt_boundaries.append(i)
                 durable = ckpt.latest_step(rcfg.checkpoint_dir)
                 # free the host mirror only once a checkpoint is DURABLE
@@ -501,7 +563,7 @@ def supervise(cfg, data_factory: Callable, num_steps: int,
               max_restarts: int = 3, fail_injector: Callable | None = None,
               step_wrapper: Callable | None = None, seed: int = 0,
               use_pallas: bool | None = None, slo=None,
-              postmortem_dir: str | None = None):
+              postmortem_dir: str | None = None, controller=None):
     """Job-level restart loop: run to ``num_steps`` across preemptions,
     crashes, and world-size changes.
 
@@ -526,6 +588,18 @@ def supervise(cfg, data_factory: Callable, num_steps: int,
     (incarnation-budget exhaustion, refusing-to-spin) writes its own
     postmortem bundle — a clean drain or a successful restart does not.
 
+    ``controller``: a prebuilt :class:`flashmoe_tpu.runtime.controller.
+    RuntimeController` (or arm one via ``rcfg.adapt`` = a
+    :class:`~flashmoe_tpu.runtime.controller.ControllerConfig`).  The
+    supervisor owns its lifecycle across incarnations: each restart
+    restores the controller plan from the resumed checkpoint's
+    manifest, applies its accumulated config overrides before building
+    the step, and hands :func:`resilient_train` a rebuild closure so
+    mid-job morphs/re-placements can re-jit.  Each restart onto a
+    (possibly re-folded) topology also clears the process-level path
+    blacklist (``controller.demotion_reset``): a demotion earned on a
+    dead topology must not outlive it.
+
     Returns (state, history) with history concatenated over
     incarnations (re-run steps appear once per execution, like
     :func:`resilient_train`).
@@ -540,6 +614,10 @@ def supervise(cfg, data_factory: Callable, num_steps: int,
 
     rcfg = rcfg or ResilienceConfig()
     metrics = metrics or Metrics()
+    # a controller built from rcfg.adapt is OWNED by the supervisor:
+    # it is re-targeted to every incarnation's folded topology below
+    # (a prebuilt `controller=` is the caller's responsibility)
+    own_controller = controller is None and rcfg.adapt is not None
     history: list = []
     restarts = 0
     incarnation = 0
@@ -564,6 +642,7 @@ def supervise(cfg, data_factory: Callable, num_steps: int,
             raise e
         devices = list(devices_fn() if devices_fn is not None
                        else jax.devices())
+        resumed_step = None
         if ckpt.latest_step(rcfg.checkpoint_dir) is not None:
             state, mesh, fcfg, opt = elastic_resume(
                 cfg, rcfg.checkpoint_dir, devices=devices, guard=guard,
@@ -572,6 +651,23 @@ def supervise(cfg, data_factory: Callable, num_steps: int,
                 "supervisor.resume", incarnation=incarnation,
                 step=int(state.step), world=len(devices),
                 ep=fcfg.ep, dp=fcfg.dp)
+            # an incarnation resumes on a fresh (possibly re-folded)
+            # topology: path demotions earned by the DEAD incarnation
+            # describe hardware/paths that may no longer exist — clear
+            # the process blacklist so the planner re-evaluates every
+            # path against the surviving world
+            from flashmoe_tpu.planner.select import (
+                failed_backends, reset_path_failures,
+            )
+
+            stale = sorted(failed_backends())
+            if stale:
+                reset_path_failures()
+                metrics.decision(
+                    "controller.demotion_reset",
+                    incarnation=incarnation, world=len(devices),
+                    ep=fcfg.ep, dp=fcfg.dp, dropped=stale)
+            resumed_step = int(state.step)
         else:
             fcfg = fold_parallelism(cfg, len(devices))
             mesh = make_mesh(fcfg, devices=devices)
@@ -580,21 +676,48 @@ def supervise(cfg, data_factory: Callable, num_steps: int,
                                guard=guard)
             state = jax.device_put(state,
                                    state_shardings(state, fcfg, mesh))
+        if own_controller:
+            # re-target the controller to THIS incarnation's folded
+            # topology: placement math (n_devices, slot -> device) and
+            # morph re-selection (d, the folded cfg) must describe the
+            # world that is actually running, not the one that died.
+            # Spent budgets and the accumulated plan carry over (slot
+            # ids are expert ids — independent of the device count);
+            # the manifest restore below then pins the plan to the
+            # params actually resumed.
+            from flashmoe_tpu.runtime.controller import RuntimeController
+
+            prev = controller
+            controller = RuntimeController(fcfg, rcfg.adapt,
+                                           metrics=metrics)
+            if prev is not None:
+                controller.load_state_dict(prev.state_dict())
+        if controller is not None and resumed_step is not None:
+            cs = ckpt.load_controller_state(rcfg.checkpoint_dir,
+                                            resumed_step)
+            controller.load_state_dict(cs or {})
         data = data_factory(fcfg)
         if ckpt.restore_loader_state(rcfg.checkpoint_dir,
                                      int(state.step), data):
             metrics.count("loader_restores")
-        step_fn = make_train_step(fcfg, mesh, opt, use_pallas=use_pallas,
-                                  guard=guard)
-        if step_wrapper is not None:
-            step_fn = step_wrapper(step_fn)
+
+        def _build_step(overrides: dict, _fcfg=fcfg, _mesh=mesh,
+                        _opt=opt):
+            scfg = _fcfg.replace(**overrides) if overrides else _fcfg
+            sf = make_train_step(scfg, _mesh, _opt,
+                                 use_pallas=use_pallas, guard=guard)
+            return step_wrapper(sf) if step_wrapper is not None else sf
+
+        step_fn = _build_step(
+            controller.cfg_overrides if controller is not None else {})
         incarnation += 1
         try:
             state, hist = resilient_train(
                 state, step_fn, data, num_steps, rcfg=rcfg,
                 metrics=metrics, fail_injector=fail_injector,
                 preempt=preempt, slo=slo, postmortem_dir=postmortem_dir,
-                cfg=fcfg)
+                cfg=fcfg, controller=controller,
+                rebuild_step=_build_step)
             history.extend(hist)
         except StepFailure as e:
             # in-job recovery exhausted: the real process would be dead.

@@ -252,7 +252,7 @@ def train(cfg: MoEConfig, mesh: Mesh, data_iter, num_steps: int,
           flight_path: str | None = None,
           flight_flush_every: int = 0,
           guard: GradGuardConfig | None = None,
-          slo=None):
+          slo=None, controller=None):
     """Simple host training loop (see runtime.worker for the CLI).
 
     ``recorder``: a :class:`flashmoe_tpu.utils.telemetry.FlightRecorder`
@@ -273,6 +273,15 @@ def train(cfg: MoEConfig, mesh: Mesh, data_iter, num_steps: int,
     step's wall time is judged against the step budget (``slo.breach`` /
     ``slo.recovered`` decisions, consecutive-breach escalation into
     planner path demotion).  Arming an SLO times every step.
+
+    ``controller``: a :class:`flashmoe_tpu.runtime.controller.
+    RuntimeController` closes the telemetry loop on this plain host
+    loop too — the loop owns cfg/mesh/optimizer, so morphs rebuild the
+    jitted step in place and re-placements permute the live state
+    (checkpoint-free runs get no durable plan; production jobs should
+    prefer ``resilient_train``/``supervise``, which persist controller
+    actions in checkpoint manifests).  Arming a controller times every
+    step.
 
     When a profiler timeline is armed (:func:`flashmoe_tpu.profiler.
     spans.profiling`), the loop's host work is recorded as
@@ -302,7 +311,7 @@ def train(cfg: MoEConfig, mesh: Mesh, data_iter, num_steps: int,
         log_step = i % log_every == 0 or i == num_steps - 1
         tl = prof.active()
         if recorder is not None or log_step or watchdog is not None \
-                or tl is not None:
+                or tl is not None or controller is not None:
             # block before reading the clock: jit dispatch is async, so
             # an unsynchronized timer would record ~0 host-dispatch ms.
             # With a recorder every step is timed exactly; log-only runs
@@ -322,6 +331,19 @@ def train(cfg: MoEConfig, mesh: Mesh, data_iter, num_steps: int,
             tm.histogram("trainer.step_ms", step_ms)
             if watchdog is not None:
                 watchdog.observe_step(i, step_ms, phases=phases)
+            if controller is not None:
+                controller.observe_step(i, step_ms, metrics)
+                act = controller.maybe_act(i + 1)
+                if act is not None:
+                    # self-healing action at the step boundary: permute
+                    # the live state (re-placement) and/or re-jit onto
+                    # the controller's accumulated config overrides
+                    state = controller.apply_action(act, state)
+                    if act.needs_rebuild:
+                        step = make_train_step(
+                            cfg.replace(**controller.cfg_overrides),
+                            mesh, optimizer, use_pallas=use_pallas,
+                            guard=guard)
             if recorder is not None or log_step:
                 # the full device->host metrics pull (per-layer MoEStats
                 # when collect_stats is on) only happens when someone

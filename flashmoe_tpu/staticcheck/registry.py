@@ -138,6 +138,16 @@ KNOBS: tuple[KnobSpec, ...] = (
             "extra is_finite beyond the router's logsumexp), on is "
             "jnp.where-only — no collectives"),
     KnobSpec(
+        "expert_replicas", off_values=((),),
+        on={"expert_replicas": ((0, 1),)},
+        on_rules=("no_extra_exchange",),
+        doc="hot-expert replica routing map ((hot, slot), ...) written "
+            "by the self-healing controller's re-placement action "
+            "(runtime/controller.py): tokens routed to `hot` split "
+            "across the two value-identical physical slots after top-k "
+            "(ops/gate.py apply_replicas) — jnp.where-only, no "
+            "collectives; off = bit-identical, replica-free graph"),
+    KnobSpec(
         "gather_fused", off_values=(None, False), on={"gather_fused": True},
         backends=("local",), changes_graph=False,
         doc="inference kernel-entry selector; on the XLA oracle path "

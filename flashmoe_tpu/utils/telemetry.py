@@ -52,6 +52,16 @@ DECISION_NAMES: dict[str, str] = {
         "last good state persisted on an abort path",
     "checkpoint.fallback":
         "restore demoted a corrupt step to an older intact one",
+    "controller.cooldown":
+        "a trigger fired during cooldown (or planned a noop) and was "
+        "suppressed",
+    "controller.demotion_reset":
+        "a restart cleared path demotions earned on the dead topology",
+    "controller.morph":
+        "the self-healing controller re-selected the MoE path mid-job",
+    "controller.replace":
+        "the self-healing controller re-placed/replicated experts "
+        "mid-job",
     "planner.backend_constraint":
         "auto pick demoted to a backend the config can actually run",
     "planner.drift":

@@ -10,7 +10,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from flashmoe_tpu.chaos import FaultPlan, clear, inject, make_injector
+from flashmoe_tpu.chaos import (
+    FaultPlan, clear, inject, make_injector, wrap_step,
+)
+from flashmoe_tpu.chaos.drill import drill_config
 from flashmoe_tpu.config import MoEConfig
 from flashmoe_tpu.models.reference import init_moe_params
 from flashmoe_tpu.ops.moe import moe_layer
@@ -703,8 +706,11 @@ def test_drill_matrix():
     # every recovery left telemetry evidence; in-graph tiers cost zero
     # re-executed steps, host tiers stay within the checkpoint window
     for r in results:
-        assert r.final_step == 6
-        if r.expected_tier.startswith(("tier0", "tier1")):
+        # controller drills need debounce + cooldown + recovery room,
+        # so run_drill floors them at 12 steps
+        want = 12 if r.expected_tier.startswith("controller") else 6
+        assert r.final_step == want
+        if r.expected_tier.startswith(("tier0", "tier1", "controller")):
             assert r.steps_rerun == 0
 
 
@@ -758,3 +764,210 @@ def test_drill_cli_rejects_unknown_fault(capsys):
     # an all-separator list must be a usage error, not a 0-drill PASS
     with pytest.raises(SystemExit):
         main(["--faults", ","])
+
+
+# ----------------------------------------------------------------------
+# Self-healing controller drills (slow) + sustained-fault plumbing
+# ----------------------------------------------------------------------
+
+def test_fault_plan_duration_validates():
+    with pytest.raises(ValueError, match="duration"):
+        FaultPlan("slow_step", duration=0)
+    assert FaultPlan("slow_step").duration == 1  # legacy single-shot
+
+
+def test_wrap_step_slow_step_holds_for_duration():
+    """`duration` turns the one-step stall into a sustained window —
+    the shape the controller's debounce requires."""
+    import types
+
+    calls = []
+
+    def fake_step(state, batch):
+        calls.append(int(state.step))
+        return state, {}
+
+    slept = []
+    plan = FaultPlan("slow_step", step=2, duration=3, sleep_s=0.0)
+    wrapped = wrap_step(fake_step, plan)
+    import flashmoe_tpu.chaos as chaos_mod
+
+    orig_sleep = chaos_mod.time.sleep
+    chaos_mod.time.sleep = lambda s: slept.append(s)
+    try:
+        for i in range(7):
+            st = types.SimpleNamespace(step=i)
+            wrapped(st, None)
+            wrapped(st, None)  # once=True: each window step fires once
+    finally:
+        chaos_mod.time.sleep = orig_sleep
+    assert len(slept) == 3  # steps 2, 3, 4 — once each
+
+
+def test_wrap_step_slow_device_prices_stall_from_load_share():
+    import types
+
+    plan = FaultPlan("slow_device", step=1, duration=2, sleep_s=10.0)
+    shares = {1: 0.5, 2: 0.0}
+    slept = []
+
+    def fake_step(state, batch):
+        return state, {}
+
+    wrapped = wrap_step(fake_step, plan,
+                        load_share=lambda i: shares.get(i, 1.0))
+    import flashmoe_tpu.chaos as chaos_mod
+
+    orig_sleep = chaos_mod.time.sleep
+    chaos_mod.time.sleep = lambda s: slept.append(s)
+    try:
+        for i in range(4):
+            wrapped(types.SimpleNamespace(step=i), None)
+    finally:
+        chaos_mod.time.sleep = orig_sleep
+    # step 0: pre-window; step 1: 10 * 0.5; step 2: share 0 -> no
+    # sleep at all; step 3: past the window
+    assert slept == [5.0]
+
+
+def test_rearmed_injection_survives_remat_cache(devices):
+    """Regression: jax.checkpoint caches block traces by (function,
+    static args), so two builds of an EQUAL config used to splice the
+    FIRST build's arming state into the second's jaxpr — re-arming +
+    rebuilding silently produced a fault-free step.  The chaos trace
+    signature now rides the remat static args."""
+    from flashmoe_tpu.models import transformer
+
+    # as small as the config allows: the test pays two full jit
+    # compiles, so every dimension is floored
+    cfg = drill_config(num_layers=1, sequence_len=16, vocab_size=64,
+                       intermediate_size=64)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1),
+                              (2, cfg.sequence_len + 1), 0,
+                              cfg.vocab_size)
+    batch = {"tokens": toks}
+    clear()
+
+    def build():
+        # a FRESH jit wrapper per build, exactly like make_train_step
+        return jax.jit(lambda p, b: transformer.loss_fn(
+            p, b, cfg, None, False)[1]["moe_stats"][0].expert_load)
+
+    calm = np.asarray(build()(params, batch))
+    inject.arm("skewed_routing", expert=0, bias=100.0)
+    try:
+        skewed = np.asarray(build()(params, batch))
+    finally:
+        clear()
+    n_tok = 2 * cfg.sequence_len  # batch of 2 next-token windows
+    assert calm.max() < n_tok * 0.95  # sanity: calm routing is spread
+    assert skewed[0] >= n_tok * 0.95  # collapse onto expert 0
+
+
+@pytest.mark.slow
+def test_drill_skew_sustained_triggers_morph():
+    from flashmoe_tpu.chaos.drill import run_drill
+
+    r = run_drill("skew_sustained")
+    assert r.recovered, r.reason
+    assert r.expected_tier == "controller:morph"
+    assert r.steps_rerun == 0 and r.evidence["failures"] == 0
+    assert "controller.morph" in r.evidence["decision_names"]
+    assert r.evidence["action"]["dropless"]
+    # the drop EMA recovered under the trigger after the morph
+    assert r.evidence["drop_ema_end"] < 0.05
+    # the plan is durable: the newest manifest carries it
+    assert r.evidence["manifest_plan"]
+    assert not r.evidence["postmortem_bundles"]
+
+
+@pytest.mark.slow
+def test_drill_slow_device_triggers_replacement():
+    from flashmoe_tpu.chaos.drill import run_drill
+
+    r = run_drill("slow_device")
+    assert r.recovered, r.reason
+    assert r.expected_tier == "controller:replace"
+    assert r.steps_rerun == 0 and r.evidence["failures"] == 0
+    names = r.evidence["decision_names"]
+    assert "controller.replace" in names
+    # the hot expert was replicated onto a dead slot
+    assert r.evidence["action"]["replicas"]
+    # the SLO watchdog narrated degradation AND recovery
+    assert "slo.breach" in names and "slo.recovered" in names
+    # measured step time collapsed after the re-placement
+    assert r.evidence["post_ms"] < 0.5 * r.evidence["pre_ms"]
+
+
+@pytest.mark.slow
+def test_drill_cli_single_fault_filter(tmp_path):
+    """`--fault NAME` drills exactly that fault — the CI fast path that
+    smokes one fault without the full slow matrix."""
+    from flashmoe_tpu.chaos.__main__ import main
+
+    obs = tmp_path / "obs"
+    rc = main(["--fault", "nan_grad", "--obs-dir", str(obs)])
+    assert rc == 0
+    results = [json.loads(l) for l in
+               (obs / "drill_results.jsonl").read_text().splitlines()]
+    assert [r["fault"] for r in results] == ["nan_grad"]
+
+
+def test_drill_cli_fault_flag_validates():
+    from flashmoe_tpu.chaos.__main__ import main
+
+    with pytest.raises(SystemExit):
+        main(["--fault", "meteor_strike"])
+
+
+@pytest.mark.slow
+def test_supervise_controller_morphs_and_plan_survives_restart(
+        tmp_path, devices):
+    """End-to-end supervisor wiring of the controller
+    (``ResilienceConfig.adapt``): a sustained skew morphs the job
+    mid-incarnation; a preemption drain then restarts it, and the new
+    incarnation resumes the MORPHED plan and the SPENT budget from the
+    checkpoint manifest (no re-morph, no oscillation)."""
+    import os
+
+    from flashmoe_tpu.runtime.controller import ControllerConfig
+    from flashmoe_tpu.runtime.data import TokenLoader, write_token_file
+    from flashmoe_tpu.runtime.preempt import PreemptionListener
+    from flashmoe_tpu.runtime.resilient import supervise
+
+    cfg = drill_config()
+    tok = str(tmp_path / "tokens.bin")
+    rng = np.random.default_rng(3)
+    write_token_file(tok, rng.integers(
+        0, cfg.vocab_size, size=40 * (cfg.sequence_len + 1),
+        dtype=np.int32))
+    inject.arm("skewed_routing", expert=0, bias=100.0)
+    rcfg = ResilienceConfig(
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        adapt=ControllerConfig(debounce_steps=2, cooldown_steps=3,
+                               baseline_steps=2, ema_decay=0.5,
+                               morph_budget=1, enable_replace=False))
+    pl = PreemptionListener()
+    fired = {"n": 0}
+
+    def poke(i):
+        if i == 6 and not fired["n"]:
+            fired["n"] = 1
+            pl.notify("test")
+
+    metrics = Metrics()
+    final, hist = supervise(
+        cfg, lambda fcfg: TokenLoader(tok, 2, fcfg.sequence_len,
+                                      seed=3, native=False),
+        10, rcfg, metrics=metrics, preempt=pl,
+        devices_fn=lambda: jax.devices()[:1], fail_injector=poke)
+    assert int(final.step) == 10
+    morphs = [d for d in metrics.decisions
+              if d["decision"] == "controller.morph"]
+    assert len(morphs) == 1 and morphs[0]["dropless"]
+    assert metrics.counters["preempt_drains"] == 1
+    assert metrics.last_decision("supervisor.resume") is not None
+    plan = ckpt.load_controller_state(rcfg.checkpoint_dir, 10)
+    assert plan is not None and plan["morphs_used"] == 1
+    assert plan["overrides"] == {"drop_tokens": False}

@@ -102,7 +102,8 @@ def test_async_queue_is_newest_wins(tmp_path, monkeypatch):
     real = ckpt_mod._write_sync
     stalled = {"n": 0}
 
-    def slow_write(directory, state, step, loader_state):
+    def slow_write(directory, state, step, loader_state,
+               controller_state=None):
         stalled["n"] += 1
         if stalled["n"] == 1:
             gate.wait(timeout=30)
@@ -145,7 +146,8 @@ def test_async_queue_never_drops_across_directories(tmp_path):
 def test_async_writer_error_is_surfaced_not_raised(tmp_path, monkeypatch):
     import flashmoe_tpu.runtime.checkpoint as ckpt_mod
 
-    def boom(directory, state, step, loader_state):
+    def boom(directory, state, step, loader_state,
+         controller_state=None):
         raise OSError("disk on fire")
 
     monkeypatch.setattr(ckpt_mod, "_write_sync", boom)

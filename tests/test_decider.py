@@ -189,3 +189,153 @@ def test_ici_adjacency_virtual_devices():
     assert adj.n >= 1
     assert (adj.alpha >= 0).all() and (adj.beta >= 0).all()
     assert np.all(np.diag(adj.alpha) == 0)
+
+
+# ----------------------------------------------------------------------
+# Skewed-rate assignment, replication, and the runtime re-placement
+# projection (the self-healing controller's Decider entry points)
+# ----------------------------------------------------------------------
+
+from flashmoe_tpu.parallel.decider import (  # noqa: E402
+    assign_experts, placement_permutation, rebalance_placement,
+)
+
+
+def _flat_adj(n=4):
+    alpha = np.full((n, n), 0.01)
+    beta = np.full((n, n), 0.001)
+    np.fill_diagonal(alpha, 0)
+    np.fill_diagonal(beta, 0)
+    return Adjacency(alpha, beta)
+
+
+def test_decide_skewed_costs_isolates_hot_expert():
+    """Cost-sorted multiset: the device hosting the hot expert carries
+    fewer cold neighbors, so per-device COST (not count) balances."""
+    cfg = MoEConfig(num_experts=8, expert_top_k=2)
+    costs = np.ones(8)
+    costs[0] = 10.0
+    p = decide(_flat_adj(), _workers(n=4), cfg, expert_costs=costs)
+    hot_dev = p.expert_owner[0]
+    assert len(p.local_experts[hot_dev]) < max(
+        len(v) for d, v in p.local_experts.items() if d != hot_dev)
+    loads = [sum(costs[e] for e in p.local_experts[d]) for d in range(4)]
+    assert max(loads) / min(loads) < 10.0 / 1.0  # far better than naive
+    # every expert assigned exactly once (no replication requested)
+    assigned = sorted(e for d in range(4) for e in p.local_experts[d])
+    assert assigned == list(range(8))
+
+
+def test_decide_skewed_rates_feed_cold_tail_to_slow_device():
+    cfg = MoEConfig(num_experts=8, expert_top_k=2)
+    costs = np.ones(8)
+    costs[0] = 8.0
+    workers = [WorkerAttr(throughput=0.25 if d == 0 else 1.0,
+                          memory_gb=16.0) for d in range(4)]
+    p = decide(_flat_adj(), workers, cfg, expert_costs=costs)
+    # the slow device must not own the hot expert
+    assert p.expert_owner[0] != 0
+    slow_cost = sum(costs[e] for e in p.local_experts[0])
+    assert slow_cost <= min(
+        sum(costs[e] for e in p.local_experts[d]) for d in range(1, 4))
+
+
+def test_decide_replicates_hot_expert_when_capacity_allows():
+    cfg = MoEConfig(num_experts=8, expert_top_k=2)
+    costs = np.ones(8)
+    costs[0] = 10.0
+    p = decide(_flat_adj(), _workers(n=4, mem=64.0), cfg,
+               expert_costs=costs, replicate=True)
+    assert 0 in p.replicas and p.replicas[0]
+    extra = p.replicas[0][0]
+    assert extra != p.expert_owner[0]
+    assert 0 in p.local_experts[extra]
+    # tight memory: no spare slot, no replica
+    tight = [WorkerAttr(throughput=1.0, memory_gb=0.001)
+             for _ in range(4)]
+    p2 = decide(_flat_adj(), tight, cfg, expert_costs=costs,
+                replicate=True)
+    assert p2.replicas == {}
+
+
+def test_decide_skewed_is_deterministic():
+    """Stability: identical inputs -> identical Placement (the
+    controller's replan-from-unchanged-telemetry no-op guarantee)."""
+    cfg = MoEConfig(num_experts=16, expert_top_k=2)
+    costs = np.linspace(3.0, 1.0, 16)
+    costs[5] = 20.0
+    workers = [WorkerAttr(throughput=1.0 + 0.5 * (d % 2), memory_gb=64.0)
+               for d in range(4)]
+    runs = [decide(_flat_adj(), workers, cfg, expert_costs=costs.copy(),
+                   replicate=True) for _ in range(3)]
+    for p in runs[1:]:
+        assert p.groups == runs[0].groups
+        assert p.local_experts == runs[0].local_experts
+        assert p.replicas == runs[0].replicas
+
+
+def test_assign_experts_uniform_matches_contiguous_split():
+    out = assign_experts([0, 1, 2, 3], [1.0, 1.0, 1.0, 1.0], 8)
+    assert out == {0: [0, 1], 1: [2, 3], 2: [4, 5], 3: [6, 7]}
+
+
+def test_assign_experts_rejects_bad_cost_shape():
+    import pytest
+
+    with pytest.raises(ValueError, match="shape"):
+        assign_experts([0, 1], [1.0, 1.0], 4, expert_costs=np.ones(3))
+
+
+def test_rebalance_placement_equal_slots_and_rates():
+    """The runtime projection: equal slot counts per device, hot slot
+    off the slow device, deterministic, and the permutation encoding
+    round-trips."""
+    cfg = MoEConfig(num_experts=8, expert_top_k=1)
+    loads = np.zeros(8)
+    loads[0] = 64.0
+    rates = np.array([0.25, 1.0, 1.0, 1.0])
+    p = rebalance_placement(loads, 4, cfg, rates=rates)
+    assert all(len(p.local_experts[d]) == 2 for d in range(4))
+    assert p.expert_owner[0] != 0  # hot slot leaves the slow device
+    perm = placement_permutation(p)
+    assert sorted(perm) == list(range(8))
+    p2 = rebalance_placement(loads, 4, cfg, rates=rates)
+    assert placement_permutation(p2) == perm
+
+
+def test_rebalance_placement_replicates_onto_dead_slot():
+    cfg = MoEConfig(num_experts=8, expert_top_k=1)
+    loads = np.zeros(8)
+    loads[0] = 64.0
+    p = rebalance_placement(loads, 4, cfg,
+                            rates=np.array([0.25, 1.0, 1.0, 1.0]),
+                            replicate=True)
+    assert len(p.replicas) == 1
+    (hot_slot, victims), = p.replicas.items()
+    perm = placement_permutation(p)
+    assert perm[hot_slot] == 0          # the hot expert's new slot
+    assert perm[victims[0]] != 0        # victim is a dead slot
+    # replica lands on a different device than the hot slot
+    nlx = 2
+    assert hot_slot // nlx != victims[0] // nlx
+
+
+def test_rebalance_placement_balanced_no_worse_no_replicas():
+    """Uniform loads: the projection may pick any equal split, but the
+    per-device totals must match the identity layout's (the controller
+    then treats it as a noop via its min-gain guard) and nothing is
+    replicated."""
+    cfg = MoEConfig(num_experts=8, expert_top_k=2)
+    p = rebalance_placement(np.ones(8), 4, cfg)
+    assert [len(p.local_experts[d]) for d in range(4)] == [2, 2, 2, 2]
+    assert p.replicas == {}
+
+
+def test_rebalance_placement_validates_inputs():
+    import pytest
+
+    cfg = MoEConfig(num_experts=8, expert_top_k=2)
+    with pytest.raises(ValueError, match="divide"):
+        rebalance_placement(np.ones(8), 3, cfg)
+    with pytest.raises(ValueError, match="shape"):
+        rebalance_placement(np.ones(7), 4, cfg)

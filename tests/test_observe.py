@@ -478,3 +478,45 @@ def test_bench_emit_records_drift(monkeypatch, capsys):
               if d["decision"] == "planner.drift"]
     # executed path + the xla comparison leg
     assert {d["path"] for d in drifts} == {rec["path"], "xla"}
+
+
+def test_adaptation_report_timeline_with_before_after():
+    from flashmoe_tpu.observe import adaptation_report
+
+    flight = [
+        {"step": s,
+         "moe": [{"layer": 0, "imbalance": 4.0 if s < 5 else 1.2,
+                  "dropped_fraction": 0.3 if s < 5 else 0.0}]}
+        for s in range(10)
+    ]
+    records = flight + [
+        {"decision": "controller.morph", "step": 5, "trigger": "skew",
+         "backend": "local", "dropless": True,
+         "overrides": {"drop_tokens": False}, "reason": "drills"},
+        {"decision": "controller.cooldown", "step": 7,
+         "trigger": "skew", "until": 9},
+        {"decision": "controller.demotion_reset", "incarnation": 1,
+         "world": 2, "dropped": ["fused"]},
+    ]
+    rep = adaptation_report(records)
+    assert rep["actions"] == {"controller.morph": 1,
+                              "controller.cooldown": 1,
+                              "controller.demotion_reset": 1}
+    morph = next(t for t in rep["timeline"]
+                 if t["decision"] == "controller.morph")
+    assert morph["before"]["imbalance"] > morph["after"]["imbalance"]
+    assert morph["before"]["dropped_fraction"] > \
+        morph["after"]["dropped_fraction"]
+    # the summary document carries the section
+    from flashmoe_tpu.observe import render_text, summarize
+
+    text = render_text(summarize(records))
+    assert "self-healing controller" in text
+    assert "morph" in text
+
+
+def test_adaptation_report_empty_without_controller_decisions():
+    from flashmoe_tpu.observe import adaptation_report
+
+    rep = adaptation_report([{"decision": "planner.drift"}])
+    assert rep == {"actions": {}, "timeline": []}

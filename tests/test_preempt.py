@@ -194,6 +194,14 @@ def test_drain_skips_duplicate_save_at_checkpoint_boundary(devices,
 # ----------------------------------------------------------------------
 
 def test_supervise_resumes_after_drain(devices, tmp_path):
+    """Drain -> restart -> exact continuation; the restart also clears
+    stale path demotions (self-healing satellite: a blacklist earned on
+    a dead topology must not outlive it — ``controller.demotion_reset``
+    fires on the elastic resume)."""
+    from flashmoe_tpu.planner.select import (
+        failed_backends, report_path_failure, reset_path_failures,
+    )
+
     rcfg = ResilienceConfig(checkpoint_dir=str(tmp_path / "ck"),
                             checkpoint_every=2)
     pl = PreemptionListener()
@@ -202,18 +210,29 @@ def test_supervise_resumes_after_drain(devices, tmp_path):
     def poke(i):
         if i == 3 and not fired["n"]:
             fired["n"] = 1
+            # the dying incarnation demoted a path on its old topology
+            report_path_failure("fused", "test: stale demotion")
             pl.notify("test")
 
     metrics = Metrics()
-    final, hist = supervise(
-        CFG, lambda fcfg: _token_loader(tmp_path), 6, rcfg,
-        metrics=metrics, preempt=pl,
-        devices_fn=lambda: jax.devices()[:1], fail_injector=poke)
-    assert int(final.step) == 6
-    assert len(hist) == 6  # drain loses zero steps
-    assert metrics.counters["preempt_drains"] == 1
-    assert metrics.counters["preempt_restarts"] == 1
-    d = metrics.last_decision("supervisor.resume")
-    assert d is not None and d["step"] == 4 and d["world"] == 1
-    assert metrics.counters["loader_restores"] == 1
-    assert not pl.requested  # latch cleared for the new incarnation
+    try:
+        final, hist = supervise(
+            CFG, lambda fcfg: _token_loader(tmp_path), 6, rcfg,
+            metrics=metrics, preempt=pl,
+            devices_fn=lambda: jax.devices()[:1], fail_injector=poke)
+        assert int(final.step) == 6
+        assert len(hist) == 6  # drain loses zero steps
+        assert metrics.counters["preempt_drains"] == 1
+        assert metrics.counters["preempt_restarts"] == 1
+        d = metrics.last_decision("supervisor.resume")
+        assert d is not None and d["step"] == 4 and d["world"] == 1
+        assert metrics.counters["loader_restores"] == 1
+        assert not pl.requested  # latch cleared for the new incarnation
+        # the resume wiped the pre-restart blacklist and said so
+        assert failed_backends() == frozenset()
+        dr = metrics.last_decision("controller.demotion_reset")
+        assert dr is not None and dr["dropped"] == ["fused"]
+        assert dr["world"] == 1
+    finally:
+        reset_path_failures()
+
