@@ -392,6 +392,21 @@ def _bench_profile(obs_dir: str | None, *, steps: int = 1,
         _flush_observability(rec)
 
 
+def _bench_serve(loads, *, requests: int, max_batch: int):
+    """Offered-load serving sweep (``--serve``): the continuous-
+    batching engine (flashmoe_tpu/serving/) driven by a seeded arrival
+    trace at each offered-load point, one JSON record per point with
+    throughput (tokens/sec), TTFT/TPOT percentiles, queue depth, cache
+    occupancy, and evictions — the latency/throughput curve.  CPU-
+    sized model; identical procedure on real chips."""
+    from flashmoe_tpu.serving.loadgen import serve_load_sweep
+
+    for rec in serve_load_sweep(loads, n_requests=requests,
+                                max_batch=max_batch):
+        print(json.dumps(rec), flush=True)
+        _flush_observability(rec)
+
+
 def _bench_overlap(ep: int, trials: int, *, path: str | None = None,
                    wire_dtype: str | None = None,
                    wire_combine: str | None = None,
@@ -719,6 +734,19 @@ def main():
                          "point (CI smoke)")
     ap.add_argument("--profile-steps", type=int, default=1,
                     help="profiled steps per matrix point")
+    ap.add_argument("--serve", action="store_true",
+                    help="offered-load serving sweep through the "
+                         "continuous-batching engine (one record per "
+                         "load point with tokens/sec + TTFT/TPOT "
+                         "percentiles; see docs/SERVING.md)")
+    ap.add_argument("--serve-loads", default="4,2,1",
+                    help="comma-separated arrival gaps in engine "
+                         "steps, lightest first (smaller = higher "
+                         "offered load)")
+    ap.add_argument("--serve-requests", type=int, default=8,
+                    help="requests per --serve load point")
+    ap.add_argument("--serve-batch", type=int, default=4,
+                    help="engine decode-batch width for --serve")
     ap.add_argument("--deadline", type=int, default=720,
                     help="wall-clock watchdog (s) for the measurement "
                          "itself, armed AFTER the backend probe succeeds; "
@@ -804,6 +832,14 @@ def main():
                  "not --ckpt")
     if args.a2a_chunks is not None and args.a2a_chunks < 1:
         ap.error("--a2a-chunks must be >= 1")
+    if not args.serve and (args.serve_requests != 8
+                           or args.serve_batch != 4
+                           or args.serve_loads != "4,2,1"):
+        # checked BEFORE any mode dispatches: --profile et al. return
+        # early, and a silently-dropped --serve-requests would break
+        # the fail-fast contract every other flag combination honors
+        ap.error("--serve-loads/--serve-requests/--serve-batch only "
+                 "apply with --serve")
     if args.profile or args.profile_quick:
         # --profile runs its own fixed path x chunks x wire matrix;
         # refuse knobs/modes it would silently ignore rather than let
@@ -813,9 +849,9 @@ def main():
             ap.error("--profile ledgers its own path x chunks x wire "
                      "matrix; --wire-dtype/--wire-combine/--a2a-chunks "
                      "do not apply")
-        if args.overlap or args.ckpt or args.sweep:
+        if args.overlap or args.ckpt or args.sweep or args.serve:
             ap.error("--profile is its own mode; drop "
-                     "--overlap/--ckpt/--sweep")
+                     "--overlap/--ckpt/--sweep/--serve")
         if args.deadline > 0:
             signal.alarm(args.deadline)  # virtual-mesh path: no probe leg
         _bench_profile(args.obs_dir, steps=args.profile_steps,
@@ -824,6 +860,30 @@ def main():
     if args.profile_steps != 1:
         ap.error("--profile-steps only applies with "
                  "--profile/--profile-quick")
+    if args.serve:
+        # the --profile/--ckpt contract: refuse knobs/modes this mode
+        # would silently ignore rather than let the user believe they
+        # swept a shape they named
+        if args.wire_dtype or args.wire_combine or args.a2a_chunks:
+            ap.error("--serve drives the CPU-sized serving drill "
+                     "model; --wire-dtype/--wire-combine/--a2a-chunks "
+                     "do not apply")
+        if args.overlap or args.ckpt or args.sweep:
+            ap.error("--serve is its own mode; drop "
+                     "--overlap/--ckpt/--sweep")
+        try:
+            loads = [int(v) for v in
+                     str(args.serve_loads).split(",") if v.strip()]
+        except ValueError:
+            ap.error(f"--serve-loads must be comma-separated ints, "
+                     f"got {args.serve_loads!r}")
+        if not loads or any(v < 1 for v in loads):
+            ap.error("--serve-loads gaps must be >= 1 engine step")
+        if args.deadline > 0:
+            signal.alarm(args.deadline)  # host+CPU path: no probe leg
+        _bench_serve(loads, requests=args.serve_requests,
+                     max_batch=args.serve_batch)
+        return
     if args.ckpt:
         if args.deadline > 0:
             signal.alarm(args.deadline)  # host-side path: no probe leg

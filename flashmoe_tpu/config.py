@@ -209,6 +209,21 @@ class MoEConfig:
     # engine).
     expert_replicas: tuple = ()
 
+    # Serving-phase selector consumed by the analytical planner when
+    # ``moe_backend='auto'`` (flashmoe_tpu/planner/select.py and the
+    # serving engine, flashmoe_tpu/serving/): None prices the layer at
+    # the training shape (B x S tokens per step — the default every
+    # training job uses); "decode" prices it at DECODE token counts
+    # (per-step tokens = the decode batch, each fanning out top_k
+    # exchange rows — a different regime where per-message alphas
+    # dominate and the training-shaped a2a schedules are simply wrong,
+    # RaMP arXiv 2604.26039); "prefill" prices the full-sequence
+    # inference forward (training shape, inference-mode feasibility).
+    # Pure selector: the traced graph is identical for every value —
+    # only WHICH path 'auto' resolves to changes (registered in
+    # staticcheck/registry.py SELECTOR_FIELDS).
+    serving_mode: str | None = None
+
     # Inference-only: fuse the dispatch gather into the FFN kernel
     # (ops/expert.py:grouped_ffn_tokens — no [E, C, H] HBM buffer).
     # None = auto: follow the FLASHMOE_GATHER_FUSED env var, else stay on
@@ -329,6 +344,10 @@ class MoEConfig:
                     f"expert_replicas chains a replica "
                     f"({sorted(hots & seen_slots)} appear as both hot "
                     f"expert and replica slot)")
+        if self.serving_mode not in (None, "prefill", "decode"):
+            raise ValueError(
+                f"serving_mode {self.serving_mode!r} not in "
+                f"(None, 'prefill', 'decode')")
         if ((self.wire_dtype or self.wire_dtype_combine)
                 and self.moe_backend == "fused"):
             raise ValueError(

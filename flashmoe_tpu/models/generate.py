@@ -5,6 +5,21 @@ forward-only over random tensors; a complete framework serves models).
 Decode runs as a ``lax.scan`` over steps with a static-shape KV cache —
 one token per step through the same parameter tree as training, MoE layers
 included (top-k routing per decoded token).
+
+Prefill has two arms: the original one-token-at-a-time ``fori_loop``
+(the fallback — exact drop semantics for capacity configs) and a batched
+single-pass prefill (full-sequence forward with a causal mask writing
+the whole cache in one shot — one kernel launch chain instead of T0).
+``prefill='auto'`` picks batched for dropless configs, where the two
+arms are logits-equal (asserted by tests/test_generate.py), and the
+loop for ``drop_tokens=True`` configs, whose capacity competition is
+per-step by construction.
+
+Sampling supports greedy, temperature, top-k and nucleus (top-p)
+truncation, plus per-request stop tokens — the retirement primitive the
+continuous-batching engine (:mod:`flashmoe_tpu.serving.engine`) builds
+on.  :func:`sample_tokens` is shared with that engine so the two
+samplers cannot drift.
 """
 
 from __future__ import annotations
@@ -30,6 +45,12 @@ def init_cache(cfg: MoEConfig, batch: int, max_len: int) -> KVCache:
     nkv, dh = cfg.resolved_num_kv_heads, cfg.resolved_head_dim
     shape = (cfg.num_layers, batch, nkv, max_len, dh)
     return KVCache(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+def _layer_cfg(cfg: MoEConfig, li: int) -> MoEConfig:
+    return cfg if li in cfg.moe_layer_indices else cfg.replace(
+        num_experts=1, expert_top_k=1, num_shared_experts=0
+    )
 
 
 def _decode_step(params, cfg: MoEConfig, x, cache: KVCache, pos):
@@ -73,11 +94,9 @@ def _decode_step(params, cfg: MoEConfig, x, cache: KVCache, pos):
         x = x + ctx @ layer["wo"].astype(x.dtype)
 
         f_in = rms_norm(x, layer["ffn_norm"])
-        layer_cfg = cfg if li in cfg.moe_layer_indices else cfg.replace(
-            num_experts=1, expert_top_k=1, num_shared_experts=0
-        )
         o = moe_layer(
-            layer["moe"], f_in.reshape(b, -1), layer_cfg, use_pallas=False
+            layer["moe"], f_in.reshape(b, -1), _layer_cfg(cfg, li),
+            use_pallas=False
         )
         x = x + o.out.reshape(b, 1, -1).astype(x.dtype)
 
@@ -90,48 +109,195 @@ def _decode_step(params, cfg: MoEConfig, x, cache: KVCache, pos):
     return logits, cache
 
 
-@functools.partial(
-    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature"),
-)
-def generate(params, prompt, cfg: MoEConfig, *, max_new_tokens: int = 32,
-             temperature: float = 0.0, key=None):
-    """Greedy (temperature=0) or sampled decoding.
+def prefill_forward(params, cfg: MoEConfig, prompt, cache: KVCache):
+    """Single-pass prefill core: the full prompt through every layer at
+    once, causal-masked, writing the KV cache in one shot.
 
-    prompt: [B, T0] int32.  Returns [B, T0 + max_new_tokens].
+    prompt: [B, T0] int32.  Returns (x [B, T0, H] pre-final-norm hidden
+    states, cache with positions [0, T0) filled).  Mirrors
+    :func:`_decode_step`'s per-layer arithmetic with T0 query positions
+    so the two prefill arms stay logits-equal on dropless configs
+    (capacity configs compete for slots per call, so their drop
+    pattern is step-count-dependent — use the loop arm there).
+    Exposed separately from :func:`prefill_batched` because the serving
+    engine prefills PADDED prompts and needs the hidden state at a
+    dynamic true-length index, not the last row.
     """
     b, t0 = prompt.shape
-    max_len = t0 + max_new_tokens
-    cache = init_cache(cfg, b, max_len)
-    key = key if key is not None else jax.random.PRNGKey(0)
+    nh, nkv, dh = cfg.num_heads, cfg.resolved_num_kv_heads, cfg.resolved_head_dim
+    x = params["embed"].astype(cfg.dtype)[prompt]  # [B, T0, H]
+    positions = jnp.broadcast_to(jnp.arange(t0)[None, :], (b, t0))
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h_in = rms_norm(x, layer["attn_norm"])
+        q = (h_in @ layer["wq"].astype(x.dtype)).reshape(b, t0, nh, dh)
+        k = (h_in @ layer["wk"].astype(x.dtype)).reshape(b, t0, nkv, dh)
+        v = (h_in @ layer["wv"].astype(x.dtype)).reshape(b, t0, nkv, dh)
+        q, k = _rope(q, k, positions, cfg.rope_theta)
 
-    # prefill one token at a time (simple, correct; batched prefill is an
-    # optimization for later rounds)
-    def prefill(i, carry):
+        ck = jax.lax.dynamic_update_slice(
+            cache.k[li], k.transpose(0, 2, 1, 3), (0, 0, 0, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cache.v[li], v.transpose(0, 2, 1, 3), (0, 0, 0, 0)
+        )
+        new_k.append(ck)
+        new_v.append(cv)
+
+        kk, vv = ck, cv
+        if nkv != nh:
+            rep = nh // nkv
+            kk = jnp.repeat(kk, rep, axis=1)
+            vv = jnp.repeat(vv, rep, axis=1)
+        qh = q.transpose(0, 2, 1, 3)  # [B, N, T0, D]
+        t_max = kk.shape[2]
+        logits = jnp.einsum(
+            "bntd,bnsd->bnts", qh, kk, preferred_element_type=jnp.float32
+        ) * (dh ** -0.5)
+        mask = (jnp.arange(t_max)[None, None, None, :]
+                <= positions[:, None, :, None])
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        ctx = jnp.einsum(
+            "bnts,bnsd->bntd", probs, vv, preferred_element_type=jnp.float32
+        ).transpose(0, 2, 1, 3).reshape(b, t0, nh * dh).astype(x.dtype)
+        x = x + ctx @ layer["wo"].astype(x.dtype)
+
+        f_in = rms_norm(x, layer["ffn_norm"])
+        o = moe_layer(
+            layer["moe"], f_in.reshape(b * t0, -1), _layer_cfg(cfg, li),
+            use_pallas=False
+        )
+        x = x + o.out.reshape(b, t0, -1).astype(x.dtype)
+
+    return x, KVCache(jnp.stack(new_k), jnp.stack(new_v))
+
+
+def lm_logits(params, cfg: MoEConfig, h):
+    """Final-norm + lm_head on [B, 1, H] hidden states -> [B, V] f32
+    (the exact tail :func:`_decode_step` applies, shared so every
+    consumer produces bit-identical logits from the same hidden)."""
+    h = rms_norm(h, params["final_norm"])
+    return jnp.dot(
+        h.astype(cfg.dtype), params["lm_head"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )[:, 0]  # [B, V]
+
+
+def prefill_batched(params, cfg: MoEConfig, prompt, cache: KVCache):
+    """Single-pass prefill: :func:`prefill_forward` + the lm head on
+    the LAST prompt position.  Returns (logits [B, V], filled cache)."""
+    x, cache = prefill_forward(params, cfg, prompt, cache)
+    return lm_logits(params, cfg, x[:, -1:]), cache
+
+
+def prefill_loop(params, cfg: MoEConfig, prompt, cache: KVCache):
+    """One-token-at-a-time prefill (the original arm): exact per-step
+    capacity semantics, T0 sequential launches."""
+    b, t0 = prompt.shape
+
+    def body(i, carry):
         cache, _ = carry
         x = params["embed"].astype(cfg.dtype)[prompt[:, i]][:, None, :]
         logits, cache = _decode_step(params, cfg, x, cache, i)
         return cache, logits
 
     cache, logits = jax.lax.fori_loop(
-        0, t0, prefill, (cache, jnp.zeros((b, cfg.vocab_size), jnp.float32))
+        0, t0, body, (cache, jnp.zeros((b, cfg.vocab_size), jnp.float32))
     )
+    return logits, cache
+
+
+def sample_tokens(logits, key, *, temperature: float = 0.0,
+                  top_k: int = 0, top_p: float = 1.0):
+    """Sample next tokens from [B, V] f32 logits -> [B] int32.
+
+    ``temperature=0`` is greedy (argmax; ``key`` unused).  ``top_k > 0``
+    truncates to the k highest logits; ``top_p < 1`` applies nucleus
+    truncation (smallest prefix of the sorted distribution whose mass
+    reaches ``top_p`` — the top token always survives).  Truncations
+    compose (top-k first, then top-p over the survivors).  Shared by
+    :func:`generate` and the serving engine's per-request sampler, so
+    the two can never drift."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if not 0 < top_p <= 1.0:
+        raise ValueError(f"top_p={top_p} must be in (0, 1]")
+    if top_k < 0:
+        raise ValueError(f"top_k={top_k} must be >= 0")
+    logits = logits.astype(jnp.float32) / temperature
+    neg = jnp.asarray(-1e30, logits.dtype)
+    if top_k and top_k < logits.shape[-1]:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p < 1.0:
+        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1)
+        # keep entries whose preceding mass is < top_p (the argmax has
+        # preceding mass 0, so at least one entry always survives)
+        keep = (csum - probs) < top_p
+        thresh = jnp.min(
+            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < thresh, neg, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
+                              "top_k", "top_p", "stop_tokens",
+                              "pad_token", "prefill"),
+)
+def generate(params, prompt, cfg: MoEConfig, *, max_new_tokens: int = 32,
+             temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+             stop_tokens: tuple = (), pad_token: int = 0, key=None,
+             prefill: str = "auto"):
+    """Greedy (temperature=0) or sampled decoding.
+
+    prompt: [B, T0] int32.  Returns [B, T0 + max_new_tokens].
+
+    ``stop_tokens``: static tuple of token ids that retire a row — the
+    stop token itself is emitted, every later position is
+    ``pad_token`` and the retired row's cache stops influencing its
+    outputs (other rows are unaffected).  ``prefill``: 'batched' (one
+    full-sequence pass), 'loop' (one token at a time), or 'auto'
+    (batched for dropless configs, loop when ``drop_tokens`` — whose
+    capacity competition is per-step by definition).
+    """
+    b, t0 = prompt.shape
+    max_len = t0 + max_new_tokens
+    cache = init_cache(cfg, b, max_len)
+    key = key if key is not None else jax.random.PRNGKey(0)
+
+    if prefill == "auto":
+        prefill = "loop" if cfg.drop_tokens else "batched"
+    if prefill not in ("batched", "loop"):
+        raise ValueError(
+            f"prefill={prefill!r} not in ('auto', 'batched', 'loop')")
+    if prefill == "batched":
+        logits, cache = prefill_batched(params, cfg, prompt, cache)
+    else:
+        logits, cache = prefill_loop(params, cfg, prompt, cache)
+
+    stops = jnp.asarray(stop_tokens, jnp.int32) if stop_tokens else None
 
     def sample(logits, k):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            k, logits / temperature, axis=-1
-        ).astype(jnp.int32)
+        return sample_tokens(logits, k, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
 
     def step(carry, i):
-        cache, logits, key = carry
+        cache, logits, key, done = carry
         key, sub = jax.random.split(key)
         tok = sample(logits, sub)
+        if stops is not None:
+            tok = jnp.where(done, jnp.int32(pad_token), tok)
+            done = done | jnp.isin(tok, stops)
         x = params["embed"].astype(cfg.dtype)[tok][:, None, :]
         logits, cache = _decode_step(params, cfg, x, cache, t0 + i)
-        return (cache, logits, key), tok
+        return (cache, logits, key, done), tok
 
-    (_, logits, _), toks = jax.lax.scan(
-        step, (cache, logits, key), jnp.arange(max_new_tokens)
+    done0 = jnp.zeros((b,), bool)
+    (_, logits, _, _), toks = jax.lax.scan(
+        step, (cache, logits, key, done0), jnp.arange(max_new_tokens)
     )
     return jnp.concatenate([prompt, toks.T], axis=1)

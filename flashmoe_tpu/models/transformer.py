@@ -151,7 +151,11 @@ def attention(layer, x, cfg: MoEConfig, positions=None, mesh=None,
 def _resolved_plan(cfg: MoEConfig, mesh) -> tuple[str, int | None]:
     """(moe_backend, a2a_chunks) with 'auto' resolved by the analytical
     planner (predicted-latency winner + chunked-pipeline sweep,
-    measured override; decision recorded in telemetry)."""
+    measured override; decision recorded in telemetry).  The pricing
+    regime follows ``cfg.serving_mode``: a decode-phase config
+    (``serving_mode='decode'``, set by the serving engine) resolves a
+    decode-priced plan — per-step tokens = the decode batch, not
+    B x S — instead of the training-shaped sweep."""
     if cfg.moe_backend != "auto":
         return cfg.moe_backend, cfg.a2a_chunks
     from flashmoe_tpu.parallel.ep import resolve_moe_plan

@@ -21,12 +21,16 @@ Usage::
     python -m flashmoe_tpu.observe flight.jsonl [decisions.jsonl ...]
     python -m flashmoe_tpu.observe --json flight.jsonl
     python -m flashmoe_tpu.observe --ledger obs/ledger.jsonl
+    python -m flashmoe_tpu.observe --serving obs/flight.jsonl obs/decisions.jsonl
     python -m flashmoe_tpu.observe --postmortem /path/to/bundles
 
 ``--ledger`` renders the per-phase predicted-vs-measured cost ledger
 (:mod:`flashmoe_tpu.profiler.ledger` artifacts / ``planner.phase_drift``
-decision dumps); ``--postmortem`` renders a triage report of the crash
-bundle(s) written by :mod:`flashmoe_tpu.profiler.postmortem`.
+decision dumps); ``--serving`` renders the serving-engine report
+(TTFT/TPOT percentiles, queue depth, cache occupancy, the prefill-vs-
+decode planner split — docs/SERVING.md); ``--postmortem`` renders a
+triage report of the crash bundle(s) written by
+:mod:`flashmoe_tpu.profiler.postmortem`.
 """
 
 from __future__ import annotations
@@ -395,6 +399,118 @@ def render_ledger_text(led: dict) -> str:
     return "\n".join(lines)
 
 
+def serving_report(records: list[dict]) -> dict:
+    """The serving engine's story (``--serving``): per-step
+    ``serve_step`` flight records (queue depth, active requests, cache
+    occupancy, tokens emitted), per-request TTFT/TPOT from
+    ``serve_request`` records / ``serve.retire`` decisions, the
+    admission/eviction narrative, the decode-vs-prefill planner split
+    (``serve.plan``), and serving SLO breaches (``slo.breach`` with
+    target ttft/tpot)."""
+    steps = [r for r in records if r.get("kind") == "serve_step"]
+    req_recs = [r for r in records if r.get("kind") == "serve_request"]
+    retires = [r for r in records
+               if r.get("decision") == "serve.retire"]
+    # the one serving percentile definition, shared with the bench
+    # sweep's records so the two surfaces can never disagree on p99
+    from flashmoe_tpu.serving.loadgen import pctl
+
+    per_req = req_recs or retires
+    ttfts = [float(r["ttft_ms"]) for r in per_req
+             if isinstance(r.get("ttft_ms"), (int, float))]
+    tpots = [float(r["tpot_ms"]) for r in per_req
+             if isinstance(r.get("tpot_ms"), (int, float))]
+    tokens = sum(int(r.get("tokens", 0)) for r in steps)
+    wall_ms = sum(float(r.get("step_ms", 0.0)) for r in steps)
+    qd = [int(r["queue_depth"]) for r in steps
+          if isinstance(r.get("queue_depth"), (int, float))]
+    occ = [float(r["cache_occupancy"]) for r in steps
+           if isinstance(r.get("cache_occupancy"), (int, float))]
+    act = [int(r["active"]) for r in steps
+           if isinstance(r.get("active"), (int, float))]
+    plan = next((r for r in reversed(records)
+                 if r.get("decision") == "serve.plan"), None)
+    slo = [r for r in records if r.get("decision") == "slo.breach"
+           and r.get("target") in ("ttft", "tpot")]
+    return {
+        "steps": len(steps),
+        "requests_completed": len({r.get("rid") for r in per_req}
+                                  if per_req else ()),
+        "tokens": tokens,
+        "tokens_per_sec": round(tokens / (wall_ms / 1e3), 1)
+        if wall_ms > 0 else None,
+        "ttft_ms": {"mean": round(sum(ttfts) / len(ttfts), 3),
+                    "p50": pctl(ttfts, 0.5), "p99": pctl(ttfts, 0.99),
+                    "max": round(max(ttfts), 3)} if ttfts else None,
+        "tpot_ms": {"mean": round(sum(tpots) / len(tpots), 3),
+                    "p50": pctl(tpots, 0.5)} if tpots else None,
+        "queue_depth": {"mean": round(sum(qd) / len(qd), 2),
+                        "max": max(qd)} if qd else None,
+        "active": {"mean": round(sum(act) / len(act), 2),
+                   "max": max(act)} if act else None,
+        "cache_occupancy": {"mean": round(sum(occ) / len(occ), 4),
+                            "peak": round(max(occ), 4)} if occ else
+        None,
+        "admissions": sum(1 for r in records
+                          if r.get("decision") == "serve.admit"),
+        "evictions": sum(1 for r in records
+                         if r.get("decision") == "serve.evict"),
+        "plan": ({"prefill": [plan.get("prefill_backend"),
+                              plan.get("prefill_chunks")],
+                  "decode": [plan.get("decode_backend"),
+                             plan.get("decode_chunks")],
+                  "heterogeneous": plan.get("heterogeneous")}
+                 if plan else None),
+        "slo_breaches": {
+            "ttft": sum(1 for r in slo if r["target"] == "ttft"),
+            "tpot": sum(1 for r in slo if r["target"] == "tpot"),
+        } if slo else None,
+    }
+
+
+def render_serving_text(rep: dict) -> str:
+    if not rep["steps"] and not rep["requests_completed"]:
+        return ("no serving records found (run `python -m "
+                "flashmoe_tpu.serving --obs-dir ...` or the engine "
+                "with a recorder first)")
+    lines = [f"serving: {rep['requests_completed']} requests over "
+             f"{rep['steps']} engine steps, {rep['tokens']} tokens"
+             + (f" ({rep['tokens_per_sec']} tok/s)"
+                if rep.get("tokens_per_sec") else "")]
+    if rep.get("ttft_ms"):
+        t = rep["ttft_ms"]
+        lines.append(f"  TTFT ms: mean {t['mean']}  p50 {t['p50']}  "
+                     f"p99 {t['p99']}  max {t['max']}")
+    if rep.get("tpot_ms"):
+        t = rep["tpot_ms"]
+        lines.append(f"  TPOT ms: mean {t['mean']}  p50 {t['p50']}")
+    if rep.get("queue_depth"):
+        lines.append(f"  queue depth: mean {rep['queue_depth']['mean']}"
+                     f"  max {rep['queue_depth']['max']}"
+                     + (f"   active: mean {rep['active']['mean']} max "
+                        f"{rep['active']['max']}" if rep.get("active")
+                        else ""))
+    if rep.get("cache_occupancy"):
+        o = rep["cache_occupancy"]
+        lines.append(f"  cache occupancy: mean {o['mean']}  peak "
+                     f"{o['peak']}")
+    lines.append(f"  admissions {rep['admissions']}  evictions "
+                 f"{rep['evictions']}")
+    plan = rep.get("plan")
+    if plan:
+        lines.append(
+            f"  planner split: prefill {plan['prefill'][0]}"
+            f"(c{plan['prefill'][1]}) vs decode {plan['decode'][0]}"
+            f"(c{plan['decode'][1]})"
+            + ("  [heterogeneous]" if plan.get("heterogeneous")
+               else "  [same plan]"))
+    if rep.get("slo_breaches"):
+        b = rep["slo_breaches"]
+        lines.append(f"  SLO breaches: ttft={b['ttft']} "
+                     f"tpot={b['tpot']}")
+    return "\n".join(lines)
+
+
 def postmortem_report(bundle: dict) -> dict:
     """Triage view of one loaded postmortem bundle
     (:func:`flashmoe_tpu.profiler.postmortem.load_bundle`)."""
@@ -579,6 +695,10 @@ def main(argv=None) -> int:
     ap.add_argument("--ledger", action="store_true",
                     help="render the per-phase cost-ledger report "
                          "(ledger.jsonl / phase_drift decision files)")
+    ap.add_argument("--serving", action="store_true",
+                    help="render the serving report (engine "
+                         "flight/decision dumps: TTFT/TPOT, queue "
+                         "depth, cache occupancy, planner split)")
     ap.add_argument("--postmortem", metavar="DIR",
                     help="render a triage report of the crash postmortem "
                          "bundle(s) under DIR")
@@ -614,6 +734,14 @@ def main(argv=None) -> int:
         else:
             print(render_ledger_text(led))
         return 0 if led["n"] or led["overlap"] else 2
+    if args.serving:
+        rep = serving_report(records)
+        if args.json:
+            json.dump(rep, sys.stdout)
+            print()
+        else:
+            print(render_serving_text(rep))
+        return 0 if rep["steps"] or rep["requests_completed"] else 2
     s = summarize(records)
     if args.json:
         json.dump(s, sys.stdout)

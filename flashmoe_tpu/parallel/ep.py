@@ -447,15 +447,20 @@ def resolve_moe_backend(cfg: MoEConfig, mesh: Mesh | None = None) -> str:
     return _resolve(cfg, mesh)
 
 
-def resolve_moe_plan(cfg: MoEConfig, mesh: Mesh | None = None
+def resolve_moe_plan(cfg: MoEConfig, mesh: Mesh | None = None, *,
+                     mode: str | None = None,
+                     decode_tokens: int | None = None
                      ) -> tuple[str, int | None]:
     """(moe_backend, a2a_chunks) an ``moe_backend='auto'`` config should
     run: the planner's path winner plus its chunked-pipeline pick for
     the XLA transports (``None`` = serial).  Explicit configs pass
-    through with their own ``cfg.a2a_chunks``."""
+    through with their own ``cfg.a2a_chunks``.  ``mode`` selects the
+    pricing regime (None reads ``cfg.serving_mode`` — a decode-phase
+    config resolves a decode-priced plan; ``decode_tokens`` is the
+    per-step decode batch)."""
     from flashmoe_tpu.planner.select import resolve_moe_plan as _resolve
 
-    return _resolve(cfg, mesh)
+    return _resolve(cfg, mesh, mode=mode, decode_tokens=decode_tokens)
 
 
 def apply_chunk_pick(cfg: MoEConfig, backend: str,

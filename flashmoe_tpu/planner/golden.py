@@ -41,6 +41,10 @@ GOLDEN_CHUNKS = {"serial": {}, "c4": {"a2a_chunks": 4}}
 # relative tolerance of the CI gate: generous enough for float noise,
 # far below any modeling change worth reviewing
 GOLDEN_RTOL = 1e-3
+# the decode-mode dimension: per-step decode batch the serving regime
+# is priced at (planner.model.decode_shape) — frozen so the decode-vs-
+# training plan split (docs/SERVING.md) is itself golden-gated
+GOLDEN_DECODE_TOKENS = 64
 
 _TERMS = ("compute_ms", "hbm_ms", "ici_ms", "dcn_ms", "total_ms")
 
@@ -57,11 +61,51 @@ def golden_chunk_variants(cfg) -> dict:
                 and nlx_cfg % knobs["a2a_chunks"] == 0)}
 
 
+def _predicted_plan(cfg, gen: str, mode: str) -> dict:
+    """Hermetic (prediction-only) plan for one (cfg, gen, mode) point:
+    the fastest feasible prediction across the chunk sweep — the same
+    sweep ``select_path(sweep_chunks=True)`` runs, minus the measured
+    overrides (a golden table must not depend on the writer's env)."""
+    from flashmoe_tpu.planner.select import _chunk_candidates
+
+    best = None  # (total_ms, n, prediction)
+    for n in _chunk_candidates(cfg, GOLDEN_D):
+        cfg_n = (cfg if n == (cfg.a2a_chunks or 1)
+                 else cfg.replace(a2a_chunks=None if n == 1 else n))
+        preds = predict_paths(
+            cfg_n, GOLDEN_D, gen, mode=mode,
+            decode_tokens=GOLDEN_DECODE_TOKENS)
+        pw = next((p for p in preds if p.feasible), None)
+        if pw is None:
+            continue
+        if best is None or (pw.total_ms, n) < (best[0], best[1]):
+            best = (pw.total_ms, n, pw)
+    total, n, pw = best
+    return {"winner": pw.path, "backend": pw.backend,
+            "chunks": pw.a2a_chunks, "total_ms": round(total, 6)}
+
+
 def golden_snapshot() -> dict:
     """Recompute the full golden structure from the live model."""
     from flashmoe_tpu.config import BENCH_CONFIGS
 
-    out = {"d": GOLDEN_D, "configs": {}}
+    out = {"d": GOLDEN_D, "configs": {}, "decode": {}}
+    for name in GOLDEN_CONFIGS:
+        cfg = BENCH_CONFIGS[name]
+        gens = {}
+        for gen in GOLDEN_GENS:
+            tr = _predicted_plan(cfg, gen, "training")
+            de = _predicted_plan(cfg, gen, "decode")
+            gens[gen] = {
+                "training": tr, "decode": de,
+                # the serving thesis, CI-gated: decode steps must NOT
+                # inherit the training-shaped plan wholesale — at least
+                # the overlap schedule (chunks), usually the path too,
+                # re-resolves at decode token counts
+                "differs": (tr["winner"], tr["chunks"])
+                != (de["winner"], de["chunks"]),
+            }
+        out["decode"][name] = gens
     for name in GOLDEN_CONFIGS:
         cfg = BENCH_CONFIGS[name]
         gens = {}

@@ -129,7 +129,7 @@ def _dtype_peak(gen: str, cfg: MoEConfig) -> tuple[float, float]:
     from flashmoe_tpu.parallel.topology import chip_spec
 
     peak_tf, hbm_gb = chip_spec(gen)
-    if jnp.dtype(cfg.dtype).itemsize >= 4:
+    if jnp.dtype(cfg.dtype).itemsize >= 4:  # staticcheck: ok static config dtype — host metadata, never a tracer
         peak_tf /= 2.0              # f32 runs the MXU at half rate
     return peak_tf * 1e12, hbm_gb * 1e9
 
@@ -193,16 +193,58 @@ def slab_bytes(cfg: MoEConfig, d: int, *, padded: bool = False,
     return nlx * cap * wire_row_bytes(cfg, leg)
 
 
+#: Default per-step decode token count priced when ``mode='decode'``
+#: and no explicit decode batch is given.  Decode steps move the decode
+#: BATCH through the layer (each token then fans out ``top_k`` exchange
+#: rows) — not B x S like training — so this is the token count every
+#: decode-mode term is priced at.
+DECODE_TOKENS_DEFAULT = 64
+
+
+def decode_shape(cfg: MoEConfig, d: int = 1,
+                 decode_tokens: int | None = None) -> MoEConfig:
+    """The per-STEP problem a decode engine actually runs: ``tokens`` =
+    the decode batch (``decode_tokens``, rounded up so the ranks
+    divide it), inference mode.  This is the config the planner prices
+    when ``mode='decode'`` — per-step tokens = batch x ``top_k``
+    exchange rows, the regime where per-message alphas dominate the
+    tiny slabs and the training-shaped schedule sweeps pick wrong
+    (RaMP, arXiv 2604.26039; the reference's inference-mode Decider
+    specialization, ``decider.cuh:177-268``)."""
+    toks = int(decode_tokens if decode_tokens else DECODE_TOKENS_DEFAULT)
+    if toks < 1:
+        raise ValueError(f"decode_tokens={decode_tokens!r} must be >= 1")
+    d = max(int(d), 1)
+    toks = -(-toks // d) * d          # ranks must divide the step batch
+    return cfg.replace(sequence_len=toks, mini_batch=1,
+                       is_training=False)
+
+
 def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
                   slices: int = 1, links: int = 4,
-                  mxu_fraction: float = 1.0) -> list[PathPrediction]:
+                  mxu_fraction: float = 1.0, mode: str = "training",
+                  decode_tokens: int | None = None
+                  ) -> list[PathPrediction]:
     """Predict every candidate path's latency at (cfg, d ranks, gen).
 
     ``slices``: how many DCN-connected slices the ep axis spans (1 =
     single slice); ``links``: ICI links per chip serving the exchange;
     ``mxu_fraction``: achieved fraction of peak matmul throughput.
     Rows are returned fastest-first among feasible, infeasible last.
+
+    ``mode``: the pricing regime — ``'training'`` (default) prices the
+    config's own B x S step; ``'decode'`` re-shapes it first
+    (:func:`decode_shape`: per-step tokens = ``decode_tokens``, the
+    decode batch); ``'prefill'`` keeps the full-sequence shape but
+    prices inference-mode feasibility (the gather kernel qualifies).
     """
+    if mode not in ("training", "prefill", "decode"):
+        raise ValueError(
+            f"mode {mode!r} not in ('training', 'prefill', 'decode')")
+    if mode == "decode":
+        cfg = decode_shape(cfg, d, decode_tokens)
+    elif mode == "prefill" and cfg.is_training:
+        cfg = cfg.replace(is_training=False)
     peak_fs, hbm_bs = _dtype_peak(gen, cfg)   # validates gen first
     if d < 1:
         raise ValueError(f"d={d} must be >= 1")

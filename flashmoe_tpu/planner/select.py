@@ -192,7 +192,9 @@ def select_path(cfg: MoEConfig, d: int = 1, gen: str | None = None, *,
                 mxu_fraction: float = 1.0,
                 measured: dict | None = None,
                 record: bool = True,
-                sweep_chunks: bool = False) -> Selection:
+                sweep_chunks: bool = False,
+                mode: str = "training",
+                decode_tokens: int | None = None) -> Selection:
     """Pick the execution path for (cfg, d ranks, gen).
 
     ``measured``: explicit {path_family: ms} overrides (highest
@@ -206,8 +208,25 @@ def select_path(cfg: MoEConfig, d: int = 1, gen: str | None = None, *,
     resolution uses this; an explicit ``cfg.a2a_chunks`` pins the
     sweep to that value.  Measurements keep their chunk identity: a
     timing recorded at chunks=4 only competes inside the chunks=4
-    candidate (tuning/bench ``chunks`` keys)."""
+    candidate (tuning/bench ``chunks`` keys).
+
+    ``mode``: the pricing regime (``planner.model.predict_paths``) —
+    ``'decode'`` re-shapes the config to the per-step decode batch
+    (``decode_tokens``, default ``DECODE_TOKENS_DEFAULT``) FIRST, so
+    every downstream consumer (chunk candidates, measurement shape
+    keys, predictions, the decision record) sees the decode-shaped
+    problem; a decode measurement therefore keys at decode token
+    counts and can never override a training-shape selection."""
     from flashmoe_tpu import tuning
+    from flashmoe_tpu.planner.model import decode_shape
+
+    if mode not in ("training", "prefill", "decode"):
+        raise ValueError(
+            f"mode {mode!r} not in ('training', 'prefill', 'decode')")
+    if mode == "decode":
+        cfg = decode_shape(cfg, d, decode_tokens)
+    elif mode == "prefill" and cfg.is_training:
+        cfg = cfg.replace(is_training=False)
 
     gen = gen or tuning.generation()
     if sweep_chunks and cfg.a2a_chunks is None:
@@ -274,6 +293,7 @@ def select_path(cfg: MoEConfig, d: int = 1, gen: str | None = None, *,
     if record:
         metrics.decision(
             "planner.path_select",
+            serving_mode=mode,
             winner=sel.winner, backend=sel.backend, mode=sel.mode,
             predicted_winner=sel.predicted_winner,
             predicted_ms=round(sel.predicted_ms, 4),
@@ -296,17 +316,22 @@ def select_path(cfg: MoEConfig, d: int = 1, gen: str | None = None, *,
 
 
 @functools.lru_cache(maxsize=64)
-def _cached_backend(cfg: MoEConfig, d: int, gen: str, slices: int
+def _cached_backend(cfg: MoEConfig, d: int, gen: str, slices: int,
+                    mode: str = "training", decode_tokens: int = 0
                     ) -> tuple[str, int | None]:
-    """(backend, a2a_chunks) plan for one (cfg, d, gen, slices) point
-    — the chunk count is the planner's sweep pick for the XLA
+    """(backend, a2a_chunks) plan for one (cfg, d, gen, slices, mode)
+    point — the chunk count is the planner's sweep pick for the XLA
     transports (``None`` = serial), kept alongside the backend so
-    ``moe_backend='auto'`` resolves both in one cached decision."""
+    ``moe_backend='auto'`` resolves both in one cached decision.
+    ``mode``/``decode_tokens`` select the pricing regime (the serving
+    engine resolves its decode path with ``mode='decode'``; 0 =
+    default decode batch)."""
     # constraint filter first: combinations config.py rejects outright
     # never reach the latency comparison
     if cfg.tp > 1:
         return "collective", cfg.a2a_chunks
-    sel = select_path(cfg, d, gen, slices=slices, sweep_chunks=True)
+    sel = select_path(cfg, d, gen, slices=slices, sweep_chunks=True,
+                      mode=mode, decode_tokens=decode_tokens or None)
     backend = sel.backend
     chunks = sel.a2a_chunks if sel.a2a_chunks > 1 else None
     if backend in _FAILED_BACKENDS:
@@ -341,7 +366,10 @@ def _cached_backend(cfg: MoEConfig, d: int, gen: str, slices: int
     return backend, chunks
 
 
-def resolve_moe_plan(cfg: MoEConfig, mesh=None) -> tuple[str, int | None]:
+def resolve_moe_plan(cfg: MoEConfig, mesh=None, *,
+                     mode: str | None = None,
+                     decode_tokens: int | None = None
+                     ) -> tuple[str, int | None]:
     """(moe_backend, a2a_chunks) an ``moe_backend='auto'`` config
     should run.
 
@@ -351,13 +379,20 @@ def resolve_moe_plan(cfg: MoEConfig, mesh=None) -> tuple[str, int | None]:
     (:func:`flashmoe_tpu.tuning.generation` — never touches a possibly
     wedged backend), and the detected slice structure; the chunked-
     pipeline depth is swept alongside the path.  Results are cached per
-    (cfg, d, gen, slices); the decision itself is recorded in telemetry
-    once per cache fill.
+    (cfg, d, gen, slices, mode); the decision itself is recorded in
+    telemetry once per cache fill.
+
+    ``mode``: the pricing regime (None reads ``cfg.serving_mode``, so a
+    decode-phase config resolves a decode-priced plan without every
+    call site learning the axis); ``decode_tokens``: the per-step
+    decode batch the decode regime prices (the serving engine passes
+    its batch width; default ``planner.model.DECODE_TOKENS_DEFAULT``).
     """
     if cfg.moe_backend != "auto":
         return cfg.moe_backend, cfg.a2a_chunks
     from flashmoe_tpu import tuning
 
+    mode = mode or cfg.serving_mode or "training"
     d = int(mesh.shape.get("ep", cfg.ep)) if mesh is not None else cfg.ep
     if d <= 1:
         return "collective", None
@@ -370,7 +405,8 @@ def resolve_moe_plan(cfg: MoEConfig, mesh=None) -> tuple[str, int | None]:
             slices = ss[0]
     except Exception:  # noqa: BLE001 — detection must never block trace
         slices = 1
-    return _cached_backend(cfg, d, tuning.generation(), slices)
+    return _cached_backend(cfg, d, tuning.generation(), slices, mode,
+                           int(decode_tokens or 0))
 
 
 def resolve_moe_backend(cfg: MoEConfig, mesh=None) -> str:

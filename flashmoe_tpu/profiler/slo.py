@@ -49,11 +49,19 @@ class SLOConfig:
     phase_ms: tuple = ()            # ((phase, budget_ms), ...)
     consecutive: int = 3            # step breaches before escalation
     demote_backend: str | None = None
+    # serving budgets (flashmoe_tpu/serving/engine.py): per-request
+    # time-to-first-token and time-per-output-token — point
+    # observations judged at retirement via :meth:`SLOWatchdog.
+    # observe_request`, each violation its own ``slo.breach``
+    ttft_ms: float | None = None
+    tpot_ms: float | None = None
 
     def __post_init__(self):
-        if self.step_ms is not None and self.step_ms <= 0:
-            raise ValueError(f"step_ms budget must be > 0, "
-                             f"got {self.step_ms}")
+        for name, v in (("step_ms", self.step_ms),
+                        ("ttft_ms", self.ttft_ms),
+                        ("tpot_ms", self.tpot_ms)):
+            if v is not None and v <= 0:
+                raise ValueError(f"{name} budget must be > 0, got {v}")
         if self.consecutive < 1:
             raise ValueError("consecutive must be >= 1")
         for ph, ms in self.phase_ms:
@@ -66,7 +74,8 @@ class SLOConfig:
 
     @classmethod
     def from_dict(cls, raw: dict) -> "SLOConfig":
-        known = {"step_ms", "consecutive", "demote_backend", "phase_ms"}
+        known = {"step_ms", "consecutive", "demote_backend", "phase_ms",
+                 "ttft_ms", "tpot_ms"}
         unknown = set(raw) - known
         if unknown:
             raise ValueError(f"unknown SLO keys {sorted(unknown)}; "
@@ -90,6 +99,10 @@ class SLOConfig:
                 consecutive=int(cons) if cons is not None else 3,
                 demote_backend=raw.get("demote_backend") or None,
                 phase_ms=tuple(phase_ms),
+                ttft_ms=(float(raw["ttft_ms"])
+                         if raw.get("ttft_ms") is not None else None),
+                tpot_ms=(float(raw["tpot_ms"])
+                         if raw.get("tpot_ms") is not None else None),
             )
         except TypeError as e:
             # a null/list where a scalar belongs: surface the documented
@@ -179,6 +192,30 @@ class SLOWatchdog:
     @property
     def consecutive_breaches(self) -> int:
         return self._consecutive
+
+    def observe_request(self, step: int, request_id,
+                        *, ttft_ms: float | None = None,
+                        tpot_ms: float | None = None) -> list[dict]:
+        """Judge one completed serving request against the TTFT/TPOT
+        budgets.  Point observations — requests are independent, so
+        each violation is its own ``slo.breach`` (target ``ttft`` /
+        ``tpot``, with the request id) and there is no recovery pair
+        or escalation run: the step budget remains the escalation
+        channel.  Returns the breach records raised."""
+        events: list[dict] = []
+        for target, measured, budget in (
+                ("ttft", ttft_ms, self.slo.ttft_ms),
+                ("tpot", tpot_ms, self.slo.tpot_ms)):
+            if budget is None or measured is None:
+                continue
+            if measured > budget:
+                self.metrics.count("slo.breaches")
+                events.append(self.metrics.decision(
+                    "slo.breach", target=target, step=int(step),
+                    request=request_id,
+                    measured_ms=round(float(measured), 3),
+                    budget_ms=float(budget), consecutive=None))
+        return events
 
     def observe_step(self, step: int, step_ms: float,
                      phases: dict | None = None) -> list[dict]:

@@ -1,0 +1,664 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+One fixed decode batch of ``max_batch`` slots; requests join and leave
+per step (continuous batching) instead of padding a static batch to the
+slowest member:
+
+* **admission** — queued requests whose (seeded-trace) arrival step has
+  passed take a free slot when the page pool can hold their prompt:
+  single-pass batched prefill (:func:`flashmoe_tpu.models.generate.
+  prefill_forward`) writes their pages in one shot, ``serve.admit``;
+* **decode** — one jitted step advances every active slot: sample from
+  each slot's pending logits (greedy / temperature / top-k / top-p,
+  per-request), feed the sampled tokens, paged attention over each
+  slot's block table, MoE FFN on the batch rows;
+* **retirement** — a slot leaves when it emits a stop token or its
+  ``max_new_tokens``-th token (``serve.retire`` with TTFT/TPOT); its
+  pages return to the pool and the next admission reuses them;
+* **eviction** — when decode needs a page and the pool is dry, the
+  youngest active request is preempted back to the queue head
+  (``serve.evict``): its pages free immediately, its already-delivered
+  tokens stand, and it later re-prefills prompt+generated and
+  continues.
+
+Everything host-side is a pure function of the submitted requests and
+their arrival steps, and the page allocator is LIFO — so a seeded drill
+replays bit-identically on CPU, which is what makes the engine
+CI-testable (tests/test_serving.py asserts engine outputs token-equal
+to the same prompts decoded one at a time through ``generate()``).
+
+Jit policy: the pool shape is fixed; prefill compiles once per padded
+prompt bucket and decode once per bucketed context length
+(:func:`flashmoe_tpu.serving.kvcache.ctx_pages_bucket`) — requests
+joining mid-flight reuse existing compilations.
+
+The planner runs in DECODE mode for the step path
+(``resolve_moe_plan(mode='decode', decode_tokens=max_batch)``): decode
+steps move ``max_batch`` tokens (x ``top_k`` exchange rows), not B x S,
+so the training-shaped schedule sweep is the wrong question to ask —
+the resolved (prefill, decode) plans land in one ``serve.plan``
+decision (the reference's inference-mode Decider specialization,
+``decider.cuh:177-268``, surfaces through the same call — see
+:mod:`flashmoe_tpu.serving.pools` for the pool split).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.models.generate import (
+    init_cache, lm_logits, prefill_forward,
+)
+from flashmoe_tpu.models.transformer import rms_norm, _rope
+from flashmoe_tpu.ops.moe import moe_layer
+from flashmoe_tpu.serving.kvcache import (
+    SCRATCH_PAGE, PagePool, ctx_pages_bucket, gather_ctx,
+    init_paged_cache, prompt_pad, store_prefill, store_token,
+)
+from flashmoe_tpu.utils.telemetry import metrics as _global_metrics
+from flashmoe_tpu.utils.telemetry import trace_span
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One generation request.  ``seed`` keys the per-request sampler
+    (folded with the token index, so sampling is independent of batch
+    composition); ``stop_tokens`` retire the request the step one is
+    emitted (the stop token itself is delivered)."""
+
+    rid: int
+    prompt: tuple
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    stop_tokens: tuple = ()
+    seed: int = 0
+
+    def __post_init__(self):
+        if not self.prompt:
+            raise ValueError(f"request {self.rid}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(f"request {self.rid}: max_new_tokens must "
+                             f"be >= 1")
+        if not 0 < self.top_p <= 1.0:
+            raise ValueError(f"request {self.rid}: top_p must be in "
+                             f"(0, 1]")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine shape knobs (all static: they size the jitted steps).
+
+    ``num_pages`` includes the reserved scratch page; ``prompt_bucket``
+    must be a multiple of ``page_size`` (prefilled pages are written
+    whole); ``ctx_bucket_pages`` is the decode-gather granularity —
+    the bucketed-length jit policy's bucket."""
+
+    max_batch: int = 8
+    page_size: int = 8
+    num_pages: int = 64
+    max_pages_per_slot: int = 8
+    ctx_bucket_pages: int = 2
+    prompt_bucket: int = 8
+    pad_token: int = 0
+    max_steps: int = 10_000
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        if self.num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the "
+                             "scratch page)")
+        if not 1 <= self.ctx_bucket_pages <= self.max_pages_per_slot:
+            raise ValueError("ctx_bucket_pages must be in "
+                             "[1, max_pages_per_slot]")
+        if self.prompt_bucket < self.page_size \
+                or self.prompt_bucket % self.page_size:
+            raise ValueError(
+                f"prompt_bucket={self.prompt_bucket} must be a "
+                f"positive multiple of page_size={self.page_size} "
+                f"(prefill writes whole pages)")
+
+    @property
+    def max_context(self) -> int:
+        return self.max_pages_per_slot * self.page_size
+
+
+@dataclasses.dataclass
+class _QueueEntry:
+    """One queued (or evicted-and-requeued) request."""
+
+    arrival_step: int
+    req: Request                   # current incarnation (prompt grows
+                                   # across evictions)
+    orig: Request                  # pre-eviction identity (output key)
+    arrival_s: float | None        # wall clock when the trace arrival
+                                   # step was reached (TTFT base); None
+                                   # until then — a future arrival must
+                                   # not accrue synthetic queue wait
+    first_token_s: float | None    # survives eviction: the client
+                                   # already holds the first token
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Host-side state of one occupied batch slot."""
+
+    req: Request
+    orig: Request                  # pre-eviction identity (output key)
+    pages: list
+    length: int                    # cache positions written (prompt+fed)
+    emitted: list                  # tokens delivered THIS incarnation
+    admit_step: int
+    arrival_s: float               # wall clock at trace arrival
+    first_token_s: float | None
+
+
+# ----------------------------------------------------------------------
+# Jitted kernels (module-level so every engine instance shares caches)
+# ----------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _prefill_padded(params, cfg: MoEConfig, prompt_padded, true_len):
+    """Prefill one padded prompt: [1, T_pad] int32 -> (logits [V] at
+    the true last position, k_seq/v_seq [L, N_kv, T_pad, D]).  Pad
+    positions compute garbage no causal query before them ever sees;
+    their K/V rows land in pages the length mask never exposes."""
+    t_pad = prompt_padded.shape[1]
+    cache = init_cache(cfg, 1, t_pad)
+    x, cache = prefill_forward(params, cfg, prompt_padded, cache)
+    h = jax.lax.dynamic_slice(
+        x, (0, true_len - 1, 0), (1, 1, x.shape[-1]))
+    logits = lm_logits(params, cfg, h)[0]                    # [V]
+    return logits, cache.k[:, 0], cache.v[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _paged_decode_step(params, cfg: MoEConfig, k_pages, v_pages, toks,
+                       block_tables, positions):
+    """One decode step for the whole slot grid.
+
+    toks: [B] int32 tokens to feed; block_tables: [B, n] page ids
+    (bucketed); positions: [B] write positions (= each slot's current
+    length; inactive slots pass 0 with an all-scratch table).  Returns
+    (logits [B, V] f32, k_pages, v_pages).  Mirrors
+    ``generate._decode_step``'s per-layer arithmetic with per-slot
+    positions and paged K/V."""
+    b = toks.shape[0]
+    nh, nkv, dh = (cfg.num_heads, cfg.resolved_num_kv_heads,
+                   cfg.resolved_head_dim)
+    page = k_pages.shape[3]
+    n_ctx = block_tables.shape[1] * page
+    x = params["embed"].astype(cfg.dtype)[toks][:, None, :]  # [B, 1, H]
+    page_ids = jnp.take_along_axis(
+        block_tables, (positions // page)[:, None], axis=1)[:, 0]
+    rows = positions % page
+    for li, layer in enumerate(params["layers"]):
+        h_in = rms_norm(x, layer["attn_norm"])
+        q = (h_in @ layer["wq"].astype(x.dtype)).reshape(b, 1, nh, dh)
+        k = (h_in @ layer["wk"].astype(x.dtype)).reshape(b, 1, nkv, dh)
+        v = (h_in @ layer["wv"].astype(x.dtype)).reshape(b, 1, nkv, dh)
+        q, k = _rope(q, k, positions[:, None], cfg.rope_theta)
+
+        k_pages = k_pages.at[li].set(
+            store_token(k_pages[li], k[:, 0], page_ids, rows))
+        v_pages = v_pages.at[li].set(
+            store_token(v_pages[li], v[:, 0], page_ids, rows))
+
+        kk = gather_ctx(k_pages[li], block_tables)  # [B, nkv, ctx, D]
+        vv = gather_ctx(v_pages[li], block_tables)
+        if nkv != nh:
+            rep = nh // nkv
+            kk = jnp.repeat(kk, rep, axis=1)
+            vv = jnp.repeat(vv, rep, axis=1)
+        qh = q.transpose(0, 2, 1, 3)                # [B, N, 1, D]
+        logits = jnp.einsum(
+            "bntd,bnsd->bnts", qh, kk, preferred_element_type=jnp.float32
+        ) * (dh ** -0.5)
+        mask = (jnp.arange(n_ctx)[None, :]
+                <= positions[:, None])[:, None, None, :]
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        ctx = jnp.einsum(
+            "bnts,bnsd->bntd", probs, vv, preferred_element_type=jnp.float32
+        ).transpose(0, 2, 1, 3).reshape(b, 1, nh * dh).astype(x.dtype)
+        x = x + ctx @ layer["wo"].astype(x.dtype)
+
+        f_in = rms_norm(x, layer["ffn_norm"])
+        layer_cfg = cfg if li in cfg.moe_layer_indices else cfg.replace(
+            num_experts=1, expert_top_k=1, num_shared_experts=0)
+        o = moe_layer(layer["moe"], f_in.reshape(b, -1), layer_cfg,
+                      use_pallas=False)
+        x = x + o.out.reshape(b, 1, -1).astype(x.dtype)
+
+    return lm_logits(params, cfg, x), k_pages, v_pages
+
+
+@jax.jit
+def _sample_dynamic(logits, keys, temps, top_ks, top_ps):
+    """Per-slot sampling with DYNAMIC per-request knobs (the engine's
+    batch mixes requests): temperature <= 0 rows take the exact argmax
+    (bit-equal to ``sample_tokens``' greedy arm); sampled rows apply
+    top-k then nucleus truncation, keyed per request."""
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    neg = jnp.asarray(-1e30, jnp.float32)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(
+        temps, 1e-6)[:, None]
+    sort_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        sort_desc, jnp.clip(top_ks - 1, 0, v - 1)[:, None], axis=1)
+    use_k = (top_ks > 0) & (top_ks < v)
+    scaled = jnp.where(use_k[:, None] & (scaled < kth), neg, scaled)
+    sort_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sort_desc, axis=-1)
+    csum = jnp.cumsum(probs, axis=-1)
+    keep = (csum - probs) < top_ps[:, None]
+    thresh = jnp.min(
+        jnp.where(keep, sort_desc, jnp.inf), axis=-1, keepdims=True)
+    scaled = jnp.where(scaled < thresh, neg, scaled)
+    sampled = jax.vmap(
+        lambda kk, ll: jax.random.categorical(kk, ll))(keys, scaled)
+    return jnp.where(temps <= 0.0, greedy, sampled.astype(jnp.int32))
+
+
+def _as_watchdog(slo):
+    if slo is None:
+        return None
+    from flashmoe_tpu.profiler.slo import SLOConfig, SLOWatchdog
+
+    return SLOWatchdog(slo) if isinstance(slo, SLOConfig) else slo
+
+
+class ServingEngine:
+    """Multi-request continuous-batching driver (host loop + jitted
+    steps).  See the module docstring for the lifecycle."""
+
+    def __init__(self, params, cfg: MoEConfig,
+                 serve: ServeConfig | None = None, *,
+                 recorder=None, slo=None, mesh=None, metrics_obj=None):
+        if cfg.drop_tokens:
+            raise ValueError(
+                "the serving engine requires a dropless config "
+                "(drop_tokens=False): inactive/retired batch slots "
+                "must never compete with live requests for capacity "
+                "slots, and decode batches are token-count-tiny anyway")
+        self.params = params
+        self.cfg = cfg
+        self.serve = serve if serve is not None else ServeConfig()
+        self.mesh = mesh
+        self.recorder = recorder
+        self.metrics = metrics_obj if metrics_obj is not None \
+            else _global_metrics
+        self.watchdog = _as_watchdog(slo)
+
+        self.cache = init_paged_cache(cfg, self.serve.num_pages,
+                                      self.serve.page_size)
+        self.pool = PagePool(self.serve.num_pages)
+        self.queue: deque = deque()       # (arrival_step, _Slot-seed)
+        self.slots: list[_Slot | None] = [None] * self.serve.max_batch
+        self._logits = jnp.zeros(
+            (self.serve.max_batch, cfg.vocab_size), jnp.float32)
+        self.step_idx = 0
+        self.outputs: dict[int, list] = {}
+        self.stats = {
+            "submitted": 0, "completed": 0, "evictions": 0,
+            "tokens": 0, "steps": 0, "max_queue_depth": 0,
+            "max_active": 0, "decode_buckets": set(),
+            "prefill_buckets": set(), "peak_occupancy": 0.0,
+        }
+        self._record_plan()
+
+    # ---- planner wiring ----------------------------------------------
+
+    def _record_plan(self) -> None:
+        """Resolve the prefill- and decode-priced execution plans once
+        and record them as one ``serve.plan`` decision — decode is
+        priced at per-step token counts (= the slot-grid width), the
+        regime where the training-shaped schedules are wrong."""
+        from flashmoe_tpu.planner.select import resolve_moe_plan
+
+        cfg = self.cfg
+        pre_b, pre_c = resolve_moe_plan(cfg, self.mesh, mode="prefill")
+        dec_b, dec_c = resolve_moe_plan(
+            cfg, self.mesh, mode="decode",
+            decode_tokens=self.serve.max_batch)
+        self.decode_plan = (dec_b, dec_c)
+        self.prefill_plan = (pre_b, pre_c)
+        self.metrics.decision(
+            "serve.plan",
+            prefill_backend=pre_b, prefill_chunks=pre_c or 1,
+            decode_backend=dec_b, decode_chunks=dec_c or 1,
+            decode_tokens=self.serve.max_batch,
+            heterogeneous=(pre_b, pre_c) != (dec_b, dec_c),
+            ep=cfg.ep, moe_backend=cfg.moe_backend)
+
+    # ---- submission --------------------------------------------------
+
+    def submit(self, req: Request, arrival_step: int = 0) -> None:
+        # the BUCKETED full lifetime must fit the slot context, so an
+        # evicted request's resumed (longer, re-bucketed) prompt plus
+        # its remaining budget is covered by the same bound
+        need = prompt_pad(len(req.prompt) + req.max_new_tokens,
+                          self.serve.prompt_bucket)
+        if need > self.serve.max_context:
+            raise ValueError(
+                f"request {req.rid}: bucketed prompt + max_new_tokens "
+                f"({need}) exceeds the slot context "
+                f"{self.serve.max_context} "
+                f"(max_pages_per_slot x page_size)")
+        # ... and the whole POOL: a request the allocator can never
+        # serve would otherwise park at the queue head and spin the
+        # engine through max_steps empty iterations
+        need_pages = need // self.serve.page_size
+        if need_pages > self.serve.num_pages - 1:
+            raise ValueError(
+                f"request {req.rid}: lifetime needs {need_pages} pages "
+                f"but the pool only holds {self.serve.num_pages - 1} "
+                f"allocatable pages")
+        self.queue.append(_QueueEntry(int(arrival_step), req, req,
+                                      None, None))
+        self.stats["submitted"] += 1
+
+    # ---- internals ---------------------------------------------------
+
+    def _active(self) -> list:
+        return [i for i, s in enumerate(self.slots) if s is not None]
+
+    def _arrived_head(self) -> bool:
+        return bool(self.queue) \
+            and self.queue[0].arrival_step <= self.step_idx
+
+    def _mark_arrivals(self) -> None:
+        """Stamp the wall clock on every queue entry whose trace
+        arrival step has been reached — the TTFT base.  A future
+        arrival accrues no synthetic queue wait."""
+        now = time.monotonic()
+        for entry in self.queue:
+            if entry.arrival_s is None \
+                    and entry.arrival_step <= self.step_idx:
+                entry.arrival_s = now
+
+    def _admit(self) -> None:
+        while self._arrived_head() and None in self.slots:
+            entry = self.queue[0]
+            req, orig = entry.req, entry.orig
+            t0 = len(req.prompt)
+            t_pad = prompt_pad(t0, self.serve.prompt_bucket)
+            n_pages = t_pad // self.serve.page_size
+            pages = self.pool.alloc(n_pages)
+            if pages is None:
+                break                      # head-of-line: deterministic
+            self.queue.popleft()
+            slot = self.slots.index(None)
+            prompt = jnp.asarray(req.prompt, jnp.int32)[None, :]
+            if t_pad > t0:
+                prompt = jnp.pad(prompt, ((0, 0), (0, t_pad - t0)),
+                                 constant_values=self.serve.pad_token)
+            with trace_span("serve.prefill"):
+                logits, k_seq, v_seq = _prefill_padded(
+                    self.params, self.cfg, prompt, jnp.int32(t0))
+                page_ids = jnp.asarray(pages, jnp.int32)
+                self.cache = self.cache._replace(
+                    k_pages=store_prefill(self.cache.k_pages, k_seq,
+                                          page_ids),
+                    v_pages=store_prefill(self.cache.v_pages, v_seq,
+                                          page_ids))
+            self._logits = self._logits.at[slot].set(logits)
+            self.slots[slot] = _Slot(
+                req=req, orig=orig, pages=list(pages), length=t0,
+                emitted=[], admit_step=self.step_idx,
+                arrival_s=entry.arrival_s,
+                first_token_s=entry.first_token_s)
+            self.stats["prefill_buckets"].add(t_pad)
+            self.metrics.decision(
+                "serve.admit", rid=orig.rid, step=self.step_idx,
+                slot=slot, prompt_tokens=t0, pages=n_pages,
+                resumed=req is not orig,
+                queue_depth=len(self.queue))
+
+    def _evict_youngest(self) -> bool:
+        """Preempt the most recently admitted request back to the
+        queue head; its pages free immediately.  Returns False when no
+        active slot remains to evict."""
+        active = self._active()
+        if not active:
+            return False
+        victim = max(active, key=lambda i: (self.slots[i].admit_step,
+                                            self.slots[i].req.rid))
+        s = self.slots[victim]
+        self.pool.free(s.pages)
+        delivered = self._delivered(s)
+        remaining = s.orig.max_new_tokens - delivered
+        # the resumed prompt carries EVERY delivered token (across any
+        # number of evictions): the previous resumed prompt plus this
+        # incarnation's emissions
+        resumed = dataclasses.replace(
+            s.req,
+            prompt=tuple(s.req.prompt) + tuple(s.emitted),
+            max_new_tokens=max(remaining, 1))
+        # re-queue at the FRONT: the evictee is the next admission;
+        # arrival AND first-token clocks survive (the client already
+        # holds the delivered tokens — TTFT/TPOT must not restart)
+        self.queue.appendleft(_QueueEntry(
+            self.step_idx, resumed, s.orig, s.arrival_s,
+            s.first_token_s))
+        self.slots[victim] = None
+        self.stats["evictions"] += 1
+        self.metrics.count("serve.evictions")
+        self.metrics.decision(
+            "serve.evict", rid=s.orig.rid, step=self.step_idx,
+            slot=victim, freed_pages=len(s.pages),
+            emitted=delivered)
+        return True
+
+    def _delivered(self, s: _Slot) -> int:
+        """Tokens delivered across incarnations (an evicted request's
+        resumed prompt carries its earlier output)."""
+        return len(s.req.prompt) - len(s.orig.prompt) + len(s.emitted)
+
+    def _grow_pages(self) -> None:
+        """Allocate the next page for every active slot whose write
+        position crosses its allocated frontier, evicting the youngest
+        request when the pool runs dry."""
+        for i in list(self._active()):
+            s = self.slots[i]
+            if s is None:
+                continue
+            need_idx = s.length // self.serve.page_size
+            while need_idx >= len(s.pages):
+                got = self.pool.alloc(1)
+                if got is not None:
+                    s.pages.extend(got)
+                    continue
+                if not self._evict_youngest():
+                    raise RuntimeError("page pool exhausted with no "
+                                       "evictable request")
+                if self.slots[i] is None:   # we evicted ourselves
+                    break
+
+    def _retire(self, slot: int, s: _Slot) -> None:
+        now = time.monotonic()
+        self.pool.free(s.pages)
+        self.slots[slot] = None
+        out = (list(s.orig.prompt)
+               + list(s.req.prompt[len(s.orig.prompt):])
+               + list(s.emitted))
+        self.outputs[s.orig.rid] = out
+        self.stats["completed"] += 1
+        n_tok = self._delivered(s)
+        ttft_ms = ((s.first_token_s - s.arrival_s) * 1e3
+                   if s.first_token_s is not None else None)
+        tpot_ms = None
+        if s.first_token_s is not None and n_tok > 1:
+            tpot_ms = (now - s.first_token_s) * 1e3 / (n_tok - 1)
+        self.metrics.decision(
+            "serve.retire", rid=s.orig.rid, step=self.step_idx,
+            slot=slot, tokens=n_tok,
+            ttft_ms=round(ttft_ms, 3) if ttft_ms is not None else None,
+            tpot_ms=round(tpot_ms, 3) if tpot_ms is not None else None)
+        if self.recorder is not None:
+            self.recorder.record(
+                kind="serve_request", step=self.step_idx,
+                rid=s.orig.rid, tokens=n_tok, ttft_ms=ttft_ms,
+                tpot_ms=tpot_ms)
+        if self.watchdog is not None:
+            self.watchdog.observe_request(
+                self.step_idx, s.orig.rid, ttft_ms=ttft_ms,
+                tpot_ms=tpot_ms)
+
+    # ---- the engine step ---------------------------------------------
+
+    def step(self) -> dict:
+        """One engine iteration: admit -> sample/retire -> decode.
+        Returns the step's flight record (also appended to the
+        recorder when one is attached)."""
+        t0_s = time.monotonic()
+        sv = self.serve
+        self._mark_arrivals()
+        self._admit()
+
+        # sample each active slot's next token from its pending logits
+        emitted_now = 0
+        active = self._active()
+        if active:
+            temps = np.zeros((sv.max_batch,), np.float32)
+            tks = np.zeros((sv.max_batch,), np.int32)
+            tps = np.ones((sv.max_batch,), np.float32)
+            keys = np.zeros((sv.max_batch, 2), np.uint32)
+            for i in active:
+                r = self.slots[i].req
+                temps[i] = r.temperature
+                tks[i] = r.top_k
+                tps[i] = r.top_p
+                keys[i] = np.asarray(jax.random.fold_in(
+                    jax.random.PRNGKey(r.seed),
+                    self._delivered(self.slots[i])))
+            toks = np.asarray(_sample_dynamic(
+                self._logits, jnp.asarray(keys),
+                jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps)))
+            now = time.monotonic()
+            for i in active:
+                s = self.slots[i]
+                tok = int(toks[i])
+                s.emitted.append(tok)
+                emitted_now += 1
+                if s.first_token_s is None:
+                    s.first_token_s = now
+                done = (tok in s.req.stop_tokens
+                        or self._delivered(s) >= s.orig.max_new_tokens)
+                if done:
+                    self._retire(i, s)
+        self.stats["tokens"] += emitted_now
+
+        # feed the survivors one decode step
+        active = self._active()
+        if active:
+            self._grow_pages()
+            active = self._active()
+        if active:
+            feed = np.full((sv.max_batch,), sv.pad_token, np.int32)
+            positions = np.zeros((sv.max_batch,), np.int32)
+            tables = np.full((sv.max_batch, sv.max_pages_per_slot),
+                             SCRATCH_PAGE, np.int32)
+            longest = 1
+            for i in active:
+                s = self.slots[i]
+                feed[i] = s.emitted[-1]
+                positions[i] = s.length
+                tables[i, :len(s.pages)] = s.pages
+                longest = max(longest, s.length + 1)
+            n_ctx = ctx_pages_bucket(longest, sv.page_size,
+                                     sv.ctx_bucket_pages,
+                                     sv.max_pages_per_slot)
+            self.stats["decode_buckets"].add(n_ctx)
+            with trace_span("serve.decode"):
+                logits, kp, vp = _paged_decode_step(
+                    self.params, self.cfg, self.cache.k_pages,
+                    self.cache.v_pages, jnp.asarray(feed),
+                    jnp.asarray(tables[:, :n_ctx]),
+                    jnp.asarray(positions))
+            self._logits = logits
+            self.cache = self.cache._replace(k_pages=kp, v_pages=vp)
+            for i in active:
+                self.slots[i].length += 1
+
+        # telemetry
+        step_ms = (time.monotonic() - t0_s) * 1e3
+        n_active = len(self._active())
+        qd = len(self.queue)
+        occ = self.pool.occupancy
+        self.stats["steps"] += 1
+        self.stats["max_queue_depth"] = max(self.stats["max_queue_depth"],
+                                            qd)
+        self.stats["max_active"] = max(self.stats["max_active"], n_active)
+        self.stats["peak_occupancy"] = max(self.stats["peak_occupancy"],
+                                           occ)
+        self.metrics.gauge("serve.queue_depth", qd)
+        self.metrics.gauge("serve.active_requests", n_active)
+        self.metrics.gauge("serve.cache_occupancy", occ)
+        rec = {
+            "kind": "serve_step", "step": self.step_idx,
+            "active": n_active, "queue_depth": qd,
+            "pages_used": self.pool.used_pages,
+            "cache_occupancy": round(occ, 4),
+            "tokens": emitted_now,
+            "completed": self.stats["completed"],
+            "step_ms": round(step_ms, 3),
+        }
+        if self.recorder is not None:
+            self.recorder.record(**rec)
+        if self.watchdog is not None:
+            self.watchdog.observe_step(self.step_idx, step_ms)
+        self.step_idx += 1
+        return rec
+
+    # ---- drivers -----------------------------------------------------
+
+    def pending(self) -> bool:
+        return bool(self.queue) or bool(self._active())
+
+    def run(self, requests=None, arrivals=None) -> dict:
+        """Drive to completion.  ``requests``: iterable of
+        :class:`Request`; ``arrivals``: matching arrival steps (default
+        all 0 — the seeded arrival trace of a drill).  Returns
+        {rid: full token list (prompt + generated)}."""
+        for idx, req in enumerate(requests or ()):
+            self.submit(req, int(arrivals[idx]) if arrivals else 0)
+        while self.pending():
+            if self.step_idx >= self.serve.max_steps:
+                raise RuntimeError(
+                    f"engine exceeded max_steps={self.serve.max_steps} "
+                    f"with work pending")
+            self.step()
+        return dict(self.outputs)
+
+    def summary(self) -> dict:
+        s = dict(self.stats)
+        s["decode_buckets"] = sorted(s["decode_buckets"])
+        s["prefill_buckets"] = sorted(s["prefill_buckets"])
+        retires = [d for d in self.metrics.decisions
+                   if d.get("decision") == "serve.retire"]
+        ttfts = [d["ttft_ms"] for d in retires
+                 if d.get("ttft_ms") is not None]
+        tpots = [d["tpot_ms"] for d in retires
+                 if d.get("tpot_ms") is not None]
+        if ttfts:
+            s["ttft_ms_mean"] = round(sum(ttfts) / len(ttfts), 3)
+            s["ttft_ms_max"] = round(max(ttfts), 3)
+        if tpots:
+            s["tpot_ms_mean"] = round(sum(tpots) / len(tpots), 3)
+        s["decode_plan"] = list(self.decode_plan)
+        s["prefill_plan"] = list(self.prefill_plan)
+        return s

@@ -1,0 +1,202 @@
+"""Paged ragged KV cache: block-table indirection over a fixed page
+pool.
+
+``generate.py``'s dense monolith allocates ``[L, B, N_kv, T_max, D]``
+up front — every request pays the longest request's context, and a
+retiring request's memory cannot be reused without reshaping the whole
+cache (a recompile).  This module replaces it with the serving-standard
+paged layout (vLLM-style, built on the same row-major "static shapes,
+dynamic indices" machinery as :mod:`flashmoe_tpu.ops.ragged`):
+
+* the device holds one fixed pool ``[L, P, N_kv, page, D]`` of KV
+  pages (:class:`PagedKVCache`) — its shape never changes, so joining
+  and retiring requests never force a recompile;
+* each request owns a list of page ids (the *block table*); position
+  ``t`` of a request lives in page ``table[t // page]``, row
+  ``t % page`` — pure integer indirection, gathered/scattered with
+  static shapes and dynamic indices;
+* a host-side free-list allocator (:class:`PagePool`) hands pages out
+  and takes them back on retirement/eviction — LIFO, so page reuse is
+  deterministic and a drill replays bit-identically;
+* attention reads a *bucketed* number of pages
+  (:func:`ctx_pages_bucket`): the gather length is rounded up to a
+  page-bucket granularity, so the decode step jit-compiles once per
+  bucket instead of once per context length.
+
+Page 0 is the **scratch page** (:data:`SCRATCH_PAGE`): never allocated,
+it absorbs the KV writes of inactive batch slots (their block tables
+point every entry at it) and backs the out-of-range block-table entries
+of active requests — which the per-request length mask guarantees are
+read back with exactly-zero attention weight.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from flashmoe_tpu.config import MoEConfig
+
+#: page id reserved as the write target of inactive slots and the
+#: backing of unallocated block-table entries — never handed out by
+#: :class:`PagePool`, never read back with non-zero attention weight.
+SCRATCH_PAGE = 0
+
+
+class PagedKVCache(NamedTuple):
+    """The device-side page pool.  ``k_pages`` / ``v_pages``:
+    ``[L, P, N_kv, page, D]``.  Block tables and lengths live on the
+    host (the engine's slot state) and ride into each jitted step as
+    ordinary array arguments — values change, shapes never do."""
+
+    k_pages: jax.Array
+    v_pages: jax.Array
+
+    @property
+    def num_pages(self) -> int:
+        return self.k_pages.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[3]
+
+
+def init_paged_cache(cfg: MoEConfig, num_pages: int,
+                     page_size: int) -> PagedKVCache:
+    """Allocate the pool.  ``num_pages`` includes the scratch page."""
+    if num_pages < 2:
+        raise ValueError(f"num_pages={num_pages} must be >= 2 (page 0 "
+                         f"is the reserved scratch page)")
+    if page_size < 1:
+        raise ValueError(f"page_size={page_size} must be >= 1")
+    nkv, dh = cfg.resolved_num_kv_heads, cfg.resolved_head_dim
+    shape = (cfg.num_layers, num_pages, nkv, page_size, dh)
+    return PagedKVCache(jnp.zeros(shape, cfg.dtype),
+                        jnp.zeros(shape, cfg.dtype))
+
+
+# ----------------------------------------------------------------------
+# In-graph page ops (called inside the engine's jitted step)
+# ----------------------------------------------------------------------
+
+def store_token(pages, token_kv, page_ids, rows):
+    """Scatter one decode step's per-slot K (or V) into its pages.
+
+    pages: ``[P, N_kv, page, D]`` (one layer's pool); token_kv:
+    ``[B, N_kv, D]``; page_ids/rows: ``[B]`` int32 (inactive slots pass
+    ``SCRATCH_PAGE`` / 0 — duplicate scratch writes race, but scratch
+    content is never read back with non-zero weight)."""
+    return pages.at[page_ids, :, rows, :].set(token_kv)
+
+
+def gather_ctx(pages, block_tables):
+    """Gather each slot's context window from its pages.
+
+    pages: ``[P, N_kv, page, D]``; block_tables: ``[B, n]`` page ids
+    (already sliced to the bucketed page count).  Returns
+    ``[B, N_kv, n * page, D]`` — rows past a request's length are
+    scratch/garbage and MUST be masked by the caller's length mask."""
+    b, n = block_tables.shape
+    g = pages[block_tables]                    # [B, n, N_kv, page, D]
+    _, _, nkv, page, d = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, nkv, n * page, d)
+
+
+def store_prefill(pages, seq_kv, page_ids):
+    """Scatter a prefilled dense K (or V) run into freshly-allocated
+    pages, all layers at once.
+
+    pages: ``[L, P, N_kv, page, D]``; seq_kv: ``[L, N_kv, T_pad, D]``
+    with ``T_pad = len(page_ids) * page``; page_ids: ``[n]`` int32.
+    Positions past the true prompt length write garbage rows the
+    length mask never exposes."""
+    l, nkv, t_pad, d = seq_kv.shape
+    n = page_ids.shape[0]
+    page = pages.shape[3]
+    if t_pad != n * page:
+        raise ValueError(f"prefill run of {t_pad} rows does not fill "
+                         f"{n} pages of {page}")
+    # [L, N_kv, n, page, D] -> [L, n, N_kv, page, D]
+    chunks = seq_kv.reshape(l, nkv, n, page, d).transpose(0, 2, 1, 3, 4)
+    return pages.at[:, page_ids].set(chunks)
+
+
+# ----------------------------------------------------------------------
+# Bucketed-length jit policy
+# ----------------------------------------------------------------------
+
+def ctx_pages_bucket(max_tokens: int, page_size: int, bucket_pages: int,
+                     max_pages: int) -> int:
+    """The (static) number of pages the decode step gathers for a batch
+    whose longest request spans ``max_tokens`` written positions:
+    rounded up to ``bucket_pages`` granularity so a request joining
+    with a slightly longer context reuses the previous compilation —
+    the bucketed-length jit policy.  Clamped to ``max_pages``."""
+    if max_tokens < 1:
+        max_tokens = 1
+    pages = -(-max_tokens // page_size)
+    pages = -(-pages // bucket_pages) * bucket_pages
+    return min(max(pages, bucket_pages), max_pages)
+
+
+def prompt_pad(t0: int, bucket: int) -> int:
+    """Prompt length padded to the prefill bucket (one compilation per
+    padded length, not per prompt length)."""
+    return -(-max(t0, 1) // bucket) * bucket
+
+
+# ----------------------------------------------------------------------
+# Host-side page allocator
+# ----------------------------------------------------------------------
+
+class PagePool:
+    """Deterministic LIFO free-list over pages ``1..num_pages-1``
+    (page 0 is scratch).  All host-side Python: allocation order is a
+    pure function of the alloc/free call sequence, which the engine
+    derives from its seeded arrival trace — so a drill's page
+    placement (and therefore its jitted gathers) replays exactly."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError(f"num_pages={num_pages} must be >= 2")
+        self.num_pages = num_pages
+        # LIFO: lowest ids on top first, and freed pages come back on
+        # top — eviction's pages are the next admission's pages
+        self._free = list(range(num_pages - 1, 0, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        """Allocated fraction of the allocatable pool (scratch page
+        excluded) — the cache-occupancy gauge the engine reports."""
+        total = self.num_pages - 1
+        return self.used_pages / total if total else 0.0
+
+    def alloc(self, n: int) -> list[int] | None:
+        """Pop ``n`` pages, or ``None`` (no partial allocation) when
+        fewer remain — the caller then defers admission or evicts."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, pages) -> None:
+        """Return pages to the pool (reverse order, so re-allocating
+        the same count yields the same ids the evictee held)."""
+        for p in reversed(list(pages)):
+            if not 0 < p < self.num_pages:
+                raise ValueError(f"page id {p} out of range")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+            self._free.append(p)

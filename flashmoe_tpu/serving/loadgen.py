@@ -1,0 +1,130 @@
+"""Seeded load generation + the offered-load sweep behind
+``bench.py --serve``.
+
+Offered load is expressed as the arrival gap of the seeded trace
+(requests arrive in pairs every ``arrival_every`` engine steps —
+smaller gap = higher load).  Each sweep point drives a fresh engine on
+a CPU-sized model and emits one bench record: throughput
+(tokens/sec), TTFT/TPOT percentiles, queue depth, cache occupancy,
+evictions — the latency/throughput curve a capacity plan reads off.
+"""
+
+from __future__ import annotations
+
+from flashmoe_tpu.config import MoEConfig
+
+
+def tiny_config(*, hidden: int = 64, experts: int = 4, layers: int = 2,
+                vocab: int = 256) -> MoEConfig:
+    """The CPU-sized serving drill model (dropless — the engine's
+    requirement)."""
+    import jax.numpy as jnp
+
+    return MoEConfig(
+        num_experts=experts, expert_top_k=min(2, experts),
+        hidden_size=hidden, intermediate_size=2 * hidden,
+        sequence_len=128, num_layers=layers, moe_frequency=2,
+        vocab_size=vocab, num_heads=2, drop_tokens=False,
+        dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def build_requests(n: int, *, vocab: int, prompt_len: int,
+                   max_new: int, seed: int, arrival_every: int,
+                   temperature: float = 0.0):
+    """The seeded trace: ``n`` requests with deterministic prompts and
+    staggered arrivals (one PAIR of arrivals every ``arrival_every``
+    engine steps)."""
+    import jax
+
+    from flashmoe_tpu.serving.engine import Request
+
+    toks = jax.random.randint(
+        jax.random.PRNGKey(seed), (n, prompt_len), 0, vocab)
+    reqs = [Request(rid=i, prompt=tuple(int(t) for t in toks[i]),
+                    max_new_tokens=max_new, temperature=temperature,
+                    seed=seed + i)
+            for i in range(n)]
+    arrivals = [(i // 2) * arrival_every for i in range(n)]
+    return reqs, arrivals
+
+
+def pctl(values, q: float):
+    """Nearest-rank percentile (None on empty) — THE serving
+    percentile: `bench.py --serve` records and the `observe --serving`
+    report both use this one definition, so the two surfaces can never
+    disagree about what p99 means."""
+    if not values:
+        return None
+    v = sorted(values)
+    return round(v[min(len(v) - 1, int(q * len(v)))], 3)
+
+
+def serve_load_sweep(loads, *, n_requests: int = 8, max_batch: int = 4,
+                     prompt_len: int = 8, max_new: int = 6,
+                     seed: int = 0, page_size: int = 8,
+                     num_pages: int = 64) -> list[dict]:
+    """One bench record per offered-load point (``loads``: arrival
+    gaps in engine steps, descending = rising load).  ``vs_baseline``
+    is each point's throughput relative to the LIGHTEST load measured
+    — the saturation curve.  Deterministic token streams per seed;
+    latency numbers are wall-clock."""
+    import time
+
+    import jax
+
+    from flashmoe_tpu.models.transformer import init_params
+    from flashmoe_tpu.serving.engine import ServeConfig, ServingEngine
+    from flashmoe_tpu.utils.telemetry import Metrics
+
+    cfg = tiny_config()
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    serve = ServeConfig(
+        max_batch=max_batch, page_size=page_size, num_pages=num_pages,
+        max_pages_per_slot=max(
+            2, -(-(prompt_len + max_new) // page_size) + 1),
+        ctx_bucket_pages=1, prompt_bucket=page_size)
+    records = []
+    base_tps = None
+    for every in loads:
+        if every < 1:
+            raise ValueError(f"offered-load gap {every} must be >= 1 "
+                             f"engine step")
+        reqs, arrivals = build_requests(
+            n_requests, vocab=cfg.vocab_size, prompt_len=prompt_len,
+            max_new=max_new, seed=seed, arrival_every=int(every))
+        mx = Metrics()   # private stream per point: clean retire stats
+        engine = ServingEngine(params, cfg, serve, metrics_obj=mx)
+        t0 = time.monotonic()
+        engine.run(reqs, arrivals)
+        wall_s = max(time.monotonic() - t0, 1e-9)
+        s = engine.summary()
+        tps = s["tokens"] / wall_s
+        base_tps = base_tps if base_tps is not None else tps
+        retires = [d for d in mx.decisions
+                   if d.get("decision") == "serve.retire"]
+        ttfts = [d["ttft_ms"] for d in retires
+                 if d.get("ttft_ms") is not None]
+        tpots = [d["tpot_ms"] for d in retires
+                 if d.get("tpot_ms") is not None]
+        records.append({
+            "metric": f"serve_load[every={every},B={max_batch},"
+                      f"req={n_requests}]",
+            "value": round(tps, 1),
+            "unit": "tokens_per_sec",
+            "vs_baseline": round(tps / base_tps, 3) if base_tps
+            else None,
+            "offered_every_steps": int(every),
+            "completed": s["completed"],
+            "tokens": s["tokens"],
+            "steps": s["steps"],
+            "ttft_ms_p50": pctl(ttfts, 0.5),
+            "ttft_ms_p99": pctl(ttfts, 0.99),
+            "tpot_ms_p50": pctl(tpots, 0.5),
+            "tpot_ms_p99": pctl(tpots, 0.99),
+            "queue_depth_max": s["max_queue_depth"],
+            "cache_occupancy_peak": round(s["peak_occupancy"], 4),
+            "evictions": s["evictions"],
+            "decode_plan": s["decode_plan"],
+            "backend": jax.default_backend(),
+        })
+    return records

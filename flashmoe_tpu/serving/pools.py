@@ -1,0 +1,124 @@
+"""Prefill/decode pool formation: heterogeneous inference-mode Decider
+groups.
+
+Prefill steps are compute-bound full-sequence forwards (B x S tokens);
+decode steps move a tiny per-step batch and are latency-bound.  A
+disaggregated serving deployment therefore runs them on SEPARATE device
+pools — and sizing those pools is exactly the reference Decider's
+inference-mode specialization (``decider.cuh:177-268``: the group
+objective with NO gradient-allreduce term), applied twice with
+different per-step workloads.
+
+:func:`plan_serving_pools` is that split: devices are partitioned into
+a decode pool (the fastest devices — decode is the latency-critical
+phase) and a prefill pool, sized so the decode pool's throughput share
+matches the offered decode compute share; each pool is then priced with
+the inference objective (:func:`flashmoe_tpu.parallel.decider.
+group_objective`, ``allreduce_ms=0``) at ITS OWN token count — prefill
+at the full sequence, decode at the per-step decode batch (the same
+decode shape the planner's ``mode='decode'`` prices).  This is the
+stepping stone to ROADMAP item 5's multi-slice disaggregation, where
+the pools become Decider groups over a measured DCN topology.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.parallel.decider import CostArgs, group_objective
+from flashmoe_tpu.utils.telemetry import metrics as _metrics
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolPlan:
+    """The split: device id lists per pool plus each pool's priced
+    per-step objective (ms, inference mode — no allreduce term)."""
+
+    prefill_devices: tuple
+    decode_devices: tuple
+    prefill_ms: float
+    decode_ms: float
+    decode_share: float
+
+
+def _pool_objective(members, rates, adj, cfg: MoEConfig,
+                    tokens: int) -> float:
+    """Inference-mode objective of one pool at its per-step token
+    count: expert compute split over the pool's rate + the worst
+    intra-pool activation transfer (``decider._intra_comm_ms``'s
+    shrinking-slab rule), allreduce = 0 (``decider.cuh:177-268``)."""
+    from flashmoe_tpu.parallel.decider import _intra_comm_ms
+
+    import jax.numpy as jnp
+
+    act_mb = tokens * cfg.hidden_size \
+        * jnp.dtype(cfg.param_dtype).itemsize / 1e6
+    gamma = max(1, cfg.num_layers // max(1, cfg.moe_frequency))
+    args = CostArgs(
+        total_expert_cost_ms=cfg.num_experts / max(
+            min(rates[m] for m in members), 1e-9),
+        comm_mbytes=act_mb, grad_buffer_mb=0.0, gamma=gamma)
+    intra = _intra_comm_ms(members, adj, act_mb) if len(members) > 1 \
+        else 0.0
+    return group_objective(members, rates, intra, args,
+                           allreduce_ms=0.0)
+
+
+def plan_serving_pools(adj, workers, cfg: MoEConfig, *,
+                       decode_share: float = 0.5,
+                       decode_tokens: int | None = None,
+                       record: bool = True) -> PoolPlan:
+    """Partition the world into (prefill, decode) pools.
+
+    ``decode_share``: fraction of total compute the decode phase is
+    expected to consume (an offered-load property); the decode pool
+    takes the FASTEST devices, throughput-greedy, until its rate share
+    reaches it — decode is the latency-critical phase, so it gets the
+    best silicon, and the assignment is deterministic (throughput
+    descending, device id ascending).  Both pools must be non-empty
+    (>= 2 devices total).  ``decode_tokens``: the decode pool's
+    per-step token count (default
+    ``planner.model.DECODE_TOKENS_DEFAULT``); prefill prices at the
+    config's full ``cfg.tokens``.
+    """
+    from flashmoe_tpu.planner.model import DECODE_TOKENS_DEFAULT
+
+    n = adj.n
+    if n < 2:
+        raise ValueError(
+            f"pool split needs >= 2 devices, got {n} (run the engine "
+            f"co-located instead)")
+    if not 0.0 < decode_share < 1.0:
+        raise ValueError(f"decode_share={decode_share} must be in "
+                         f"(0, 1)")
+    rates = [w.throughput for w in workers]
+    total_rate = float(np.sum(rates))
+    order = sorted(range(n), key=lambda d: (-rates[d], d))
+    decode: list = []
+    acc = 0.0
+    for d in order:
+        if len(decode) >= n - 1:
+            break
+        if acc / total_rate >= decode_share and decode:
+            break
+        decode.append(d)
+        acc += rates[d]
+    prefill = [d for d in range(n) if d not in decode]
+    decode.sort()
+
+    toks = int(decode_tokens or DECODE_TOKENS_DEFAULT)
+    prefill_ms = _pool_objective(prefill, rates, adj, cfg, cfg.tokens)
+    decode_ms = _pool_objective(decode, rates, adj, cfg, toks)
+    plan = PoolPlan(tuple(prefill), tuple(decode), prefill_ms,
+                    decode_ms, decode_share)
+    if record:
+        _metrics.decision(
+            "serve.pools", prefill_devices=list(plan.prefill_devices),
+            decode_devices=list(plan.decode_devices),
+            prefill_ms=round(prefill_ms, 4),
+            decode_ms=round(decode_ms, 4),
+            decode_share=decode_share, decode_tokens=toks)
+    return plan

@@ -139,7 +139,14 @@ def _parse(path: str):
 
 
 def _iter_py(root: str):
-    for dirpath, _dirnames, filenames in os.walk(root):
+    for dirpath, dirnames, filenames in os.walk(root):
+        # sort the traversal in place: os.walk yields subdirectories
+        # in FILESYSTEM order, and the in-graph rule's bare-name index
+        # resolves duplicate function names to the first file seen —
+        # an unsorted walk made the lint verdict depend on checkout
+        # inode order (found when a fresh container flagged a chain a
+        # dev tree never built)
+        dirnames.sort()
         if "__pycache__" in dirpath:
             continue
         for name in sorted(filenames):

@@ -173,6 +173,12 @@ SELECTOR_FIELDS = {
                    "ragged / auto); invalid combinations rejected at "
                    "config time, auto resolution covered by "
                    "tests/test_planner.py",
+    "serving_mode": "planner pricing-regime selector (None = training "
+                    "shape / 'prefill' / 'decode'); only changes which "
+                    "path moe_backend='auto' resolves to — the traced "
+                    "graph is identical for every value; invalid names "
+                    "rejected at config time, decode-mode selection "
+                    "covered by tests/test_serving.py",
 }
 
 #: model/job *shape* fields: changing one changes the problem, not a

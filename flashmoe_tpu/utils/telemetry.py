@@ -80,6 +80,20 @@ DECISION_NAMES: dict[str, str] = {
         "one MoE phase's measured time compared against its prediction",
     "postmortem.saved":
         "a crash postmortem bundle was written (dir, error, step)",
+    "serve.admit":
+        "the serving engine admitted a request into the decode batch",
+    "serve.evict":
+        "page pressure preempted the youngest request back to the "
+        "queue (its pages freed, delivered tokens stand)",
+    "serve.plan":
+        "the engine resolved its prefill- and decode-priced execution "
+        "plans (decode priced at per-step token counts)",
+    "serve.pools":
+        "prefill/decode pool split over the inference-mode Decider "
+        "(heterogeneous groups, no allreduce term)",
+    "serve.retire":
+        "a request completed (stop token or max length) with its "
+        "TTFT/TPOT",
     "slo.breach":
         "a step/phase time exceeded its SLO budget",
     "slo.recovered":
@@ -109,6 +123,10 @@ SPAN_NAMES: dict[str, str] = {
         "return all-to-all (``.k`` suffix = pipeline chunk k)",
     "moe.combine": "weighted gather back to token order",
     "moe.fused_kernel": "fused RDMA kernel (dispatch+FFN in one launch)",
+    "serve.prefill":
+        "serving engine: single-pass prompt prefill into cache pages",
+    "serve.decode":
+        "serving engine: one continuous-batching decode step",
     "train.data_pull": "host wait on the data iterator",
     "train.step": "one train step: dispatch + device execution",
     "train.checkpoint": "checkpoint save on the step loop",

@@ -151,6 +151,69 @@ def test_cli_profile_plumbs_ledger_matrix(monkeypatch, capsys, tmp_path):
     assert mirrored == [rec]
 
 
+def test_cli_serve_plumbs_load_sweep(monkeypatch, capsys, tmp_path):
+    """`bench.py --serve` is the CLI face of
+    serving.loadgen.serve_load_sweep (gated end-to-end by
+    tests/test_serving.py): the arg plumbing must parse the load list,
+    hand through requests/batch, print each record as a JSON line with
+    the TTFT/TPOT fields, and mirror into --obs-dir."""
+    import sys as _sys
+
+    import bench
+    from flashmoe_tpu.serving import loadgen
+
+    seen = {}
+
+    def fake_sweep(loads, *, n_requests=8, max_batch=4, **kw):
+        seen.update(loads=list(loads), n=n_requests, b=max_batch)
+        return [{"metric": "serve_load[every=2,B=2,req=3]",
+                 "value": 120.0, "unit": "tokens_per_sec",
+                 "vs_baseline": 1.0, "ttft_ms_p50": 5.0,
+                 "tpot_ms_p50": 1.0, "completed": 3}]
+
+    monkeypatch.setattr(loadgen, "serve_load_sweep", fake_sweep)
+    obs = tmp_path / "obs"
+    monkeypatch.setattr(_sys, "argv",
+                        ["bench.py", "--serve", "--serve-loads", "4,2",
+                         "--serve-requests", "3", "--serve-batch", "2",
+                         "--obs-dir", str(obs), "--deadline", "0"])
+    bench.main()
+    assert seen == {"loads": [4, 2], "n": 3, "b": 2}
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"].startswith("serve_load[")
+    assert "ttft_ms_p50" in rec and "tpot_ms_p50" in rec
+    mirrored = [json.loads(line) for line in
+                (obs / "bench_records.jsonl").read_text().splitlines()]
+    assert mirrored == [rec]
+
+
+def test_cli_serve_flag_exclusivity(monkeypatch, capsys):
+    """--serve fail-fasts on modes/knobs it would silently ignore
+    (the --profile/--ckpt contract), and its own flags are rejected
+    without --serve."""
+    import sys as _sys
+
+    import bench
+
+    cases = [
+        ["bench.py", "--serve", "--ckpt"],
+        ["bench.py", "--serve", "--overlap", "4"],
+        ["bench.py", "--serve", "--sweep", "ep"],
+        ["bench.py", "--serve", "--wire-dtype", "e4m3"],
+        ["bench.py", "--serve", "--a2a-chunks", "2"],
+        ["bench.py", "--serve", "--serve-loads", "2,zero"],
+        ["bench.py", "--serve", "--serve-loads", "0"],
+        ["bench.py", "--serve-requests", "4"],      # needs --serve
+        ["bench.py", "--profile-quick", "--serve"],
+    ]
+    for argv in cases:
+        monkeypatch.setattr(_sys, "argv", argv)
+        with pytest.raises(SystemExit) as e:
+            bench.main()
+        assert e.value.code == 2, argv
+        capsys.readouterr()
+
+
 def test_cli_emits_json_error_fast_when_backend_dead():
     """With the backend guaranteed dead (bogus platform — the probe
     subprocess fails deterministically, unlike relying on probe-timeout
