@@ -647,6 +647,130 @@ def _sweep_ep(trials: int, wire_dtype: str | None = None,
         print(json.dumps(rec), flush=True)
 
 
+def _bench_tiles(cfg: MoEConfig, name: str, trials: int, chain: int):
+    """Per-tile-choice records of the row-windowed fused schedule
+    (ISSUE 12): every feasible K-window of the IO-aware chooser's grid
+    (``parallel/fused.py:rowwin_sweep_candidates`` — one point per kw,
+    at its widest feasible row tile) is
+    forced through a throwaway ``fused_tiles`` table, timed through the
+    fused layer on a 1-rank mesh (the geometry being tuned is
+    transfer-free), and emitted as its own JSON record through the
+    planner drift monitor — each record carries the byte model's
+    roofline prediction FOR THAT TILE PAIR, so a tiles sweep doubles as
+    a calibration run for the IO model the chooser minimizes.  The
+    fastest candidate is what ``tune_sweep.py --stage tiles`` would
+    commit."""
+    from flashmoe_tpu import tuning
+    from flashmoe_tpu.analysis import path_costs
+    from flashmoe_tpu.models.reference import init_moe_params as _init
+    from flashmoe_tpu.parallel.fused import (
+        fused_ep_moe_layer, rowwin_sweep_candidates,
+    )
+    from flashmoe_tpu.parallel.mesh import make_mesh
+    from flashmoe_tpu.parallel.topology import (
+        _PEAK_TFLOPS, chip_spec, tpu_generation,
+    )
+
+    cfg = cfg.replace(ep=1, tp=1, fused_schedule="rowwin",
+                      moe_backend="fused")
+    h, i = cfg.hidden_size, cfg.intermediate_size
+    dt = jnp.dtype(cfg.dtype).itemsize
+    cap_pad = -(-cfg.capacity_for(cfg.tokens) // 32) * 32
+    # the kernel's own candidate grid, one point per feasible K-window
+    # at its widest feasible row tile — the sweeps and the chooser can
+    # never enumerate different pairs (code-review finding)
+    cands = rowwin_sweep_candidates(cap_pad, h, i, dt, cfg.gated_ffn,
+                                    False, cfg.expert_top_k)
+    if len(cands) < 2:
+        print(json.dumps({
+            "metric": f"fused_tiles_ms[{name}]", "value": None,
+            "unit": "ms", "skipped": True,
+            "reason": f"{len(cands)} feasible (cm, kw) rowwin "
+                      f"candidates at this shape",
+        }), flush=True)
+        return
+    gen = tpu_generation(jax.devices()[0])
+    if gen not in _PEAK_TFLOPS:
+        gen = os.environ.get("FLASHMOE_TPU_GEN", "")
+    peak_hbm = None
+    if gen in _PEAK_TFLOPS:
+        peak_tf, hbm_gb = chip_spec(gen)
+        if dt >= 4:
+            peak_tf /= 2.0
+        peak_hbm = (peak_tf * 1e12, hbm_gb * 1e9)
+    params = _init(jax.random.PRNGKey(0), cfg)
+    params = jax.tree_util.tree_map(lambda p: p.astype(cfg.dtype), params)
+    x = jax.random.normal(jax.random.PRNGKey(1),
+                          (cfg.tokens, cfg.hidden_size), cfg.dtype)
+    mesh = make_mesh(cfg, dp=1, devices=jax.devices()[:1])
+    tmp = "/tmp/flashmoe_bench_tiles_candidate.json"
+    best = None
+    try:
+        for cm, kw in cands:
+            with open(tmp, "w") as f:
+                json.dump({"entries": [{
+                    "kernel": "fused_tiles",
+                    "match": {"h": h, "i": i,
+                              "dtype": jnp.dtype(cfg.dtype).name},
+                    "set": {"cm": cm, "kw": kw},
+                }]}, f)
+            os.environ["FLASHMOE_TUNING_FILE"] = tmp
+            tuning._load.cache_clear()
+
+            def layer(c):
+                return fused_ep_moe_layer(params, c, cfg, mesh).out
+
+            def chained(n):
+                def run(p_unused, xx):
+                    def body(c, _):
+                        return layer(c).astype(c.dtype), None
+                    c, _ = jax.lax.scan(body, xx, None, length=n)
+                    return c.astype(jnp.float32).sum()
+                return jax.jit(run)
+
+            t1 = _time_chain(chained(1), None, x, trials)
+            tn = _time_chain(chained(chain), None, x, trials)
+            t = max(tn - t1, 1e-9) / (chain - 1)
+            rec = {
+                "metric": f"fused_tiles_ms[{name}:cm={cm},kw={kw},"
+                          f"{jnp.dtype(cfg.dtype).name}]",
+                "value": round(t * 1e3, 3), "unit": "ms",
+                "cm": cm, "kw": kw, "schedule": "rowwin", "d": 1,
+                "backend": jax.default_backend(),
+            }
+            # byte-model roofline FOR THIS TILE PAIR (the forced table
+            # is live, so path_costs prices this candidate's window
+            # count), through the drift monitor like every other bench
+            # calibration point
+            if peak_hbm is not None:
+                try:
+                    cost = path_costs(cfg, "fused", d_world=1,
+                                      schedule="rowwin")
+                    pred = max(cost.flops / peak_hbm[0],
+                               cost.total_bytes / peak_hbm[1]) * 1e3
+                    rec["planner_gen"] = gen
+                    rec["predicted_ms"] = round(pred, 3)
+                    rec["prediction_error"] = round(
+                        t * 1e3 / pred - 1.0, 3)
+                    from flashmoe_tpu.planner.drift import record_drift
+
+                    dr = record_drift(cfg, "fused", t * 1e3, d=1,
+                                      gen=gen, predicted_ms=pred,
+                                      warn=False)
+                    rec["drift_exceeded"] = dr.exceeded
+                except Exception as e:  # noqa: BLE001 — keep the record
+                    rec["planner_error"] = (f"{type(e).__name__}: "
+                                            f"{str(e)[:120]}")
+            if best is None or t < best[0]:
+                best = (t, cm, kw)
+            rec["best_so_far"] = best[1:] == (cm, kw)
+            print(json.dumps(rec), flush=True)
+            _flush_observability(rec)
+    finally:
+        os.environ.pop("FLASHMOE_TUNING_FILE", None)
+        tuning._load.cache_clear()
+
+
 def _probe_backend(timeout_s: int):
     """Run one trivial op on the default backend in a subprocess with a hard
     timeout.  The tunneled TPU backend can wedge so that even ``jax.devices()``
@@ -719,6 +843,13 @@ def main():
     ap.add_argument("--overlap", type=int, default=0, metavar="EP",
                     help="measure overlap efficiency on an EP-way mesh "
                          "instead of the latency bench")
+    ap.add_argument("--tiles", action="store_true",
+                    help="sweep the row-windowed fused schedule's "
+                         "(cm, kw) tile candidates at --config instead "
+                         "of the latency bench — one JSON record per "
+                         "tile choice through the planner drift "
+                         "monitor (the measured counterpart of the "
+                         "IO-aware chooser; see docs/PERF.md)")
     ap.add_argument("--ckpt", action="store_true",
                     help="measure step-loop checkpoint blocking time, "
                          "sync vs async save, instead of the latency "
@@ -789,9 +920,14 @@ def main():
     args = ap.parse_args()
     _OBS[0] = args.obs_dir
 
+    # the headline record's identity follows the mode, so a tiles-sweep
+    # skip/error is machine-distinguishable from a latency-bench one
+    headline_metric = (f"fused_tiles_ms[{args.config}]" if args.tiles
+                       else f"moe_layer_fwd_ms[{args.config}]")
+
     def emit_error(msg, code=2):
         print(json.dumps({
-            "metric": f"moe_layer_fwd_ms[{args.config}]",
+            "metric": headline_metric,
             "value": -1, "unit": "ms", "vs_baseline": 0,
             "error": msg,
         }), flush=True)
@@ -832,6 +968,19 @@ def main():
                  "not --ckpt")
     if args.a2a_chunks is not None and args.a2a_chunks < 1:
         ap.error("--a2a-chunks must be >= 1")
+    if args.tiles:
+        # the --profile/--ckpt fail-fast contract: refuse knobs/modes
+        # this mode would silently ignore — the tiles sweep pins its
+        # own (fused, rowwin, ep=1) execution and the RDMA transport
+        # composes with neither wire compression nor chunking
+        if args.wire_dtype or args.wire_combine or args.a2a_chunks:
+            ap.error("--tiles sweeps the fused rowwin kernel; "
+                     "--wire-dtype/--wire-combine/--a2a-chunks do not "
+                     "apply")
+        if args.overlap or args.ckpt or args.sweep or args.serve \
+                or args.profile or args.profile_quick:
+            ap.error("--tiles is its own mode; drop "
+                     "--overlap/--ckpt/--sweep/--serve/--profile")
     if not args.serve and (args.serve_requests != 8
                            or args.serve_batch != 4
                            or args.serve_loads != "4,2,1"):
@@ -916,7 +1065,7 @@ def main():
             # instead of an error record (BENCH_r05: 309 s of retries
             # for an rc=2 the driver could not distinguish from a bug)
             print(json.dumps({
-                "metric": f"moe_layer_fwd_ms[{args.config}]",
+                "metric": headline_metric,
                 "value": None, "unit": "ms", "vs_baseline": None,
                 "skipped": True, "reason": info,
             }), flush=True)
@@ -938,6 +1087,13 @@ def main():
     if args.a2a_chunks and args.a2a_chunks > 1:
         cfg = cfg.replace(a2a_chunks=args.a2a_chunks)  # ValueError if
         # the count cannot divide this config's local-expert axis
+
+    if args.tiles:
+        try:
+            _bench_tiles(cfg, args.config, args.trials, args.chain)
+        except Exception as e:  # noqa: BLE001 — always leave a record
+            emit_error(f"{type(e).__name__}: {str(e)[:300]}")
+        return
 
     try:
         if args.sweep == "tokens":

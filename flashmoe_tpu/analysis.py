@@ -114,42 +114,30 @@ class PathCost:
 def _geom(cfg: MoEConfig, d_world: int, fuse_combine: bool = False,
           schedule: str | None = None):
     """Shared geometry: local tokens, per-(rank, expert) capacity, row
-    tiling, and the fused kernel's FFN schedule, resolved exactly as the
-    kernels resolve them — ``fuse_combine`` must mirror the path being
+    tiling, and the fused kernel's FFN schedule — resolved through the
+    kernel's own public :func:`flashmoe_tpu.parallel.fused.
+    schedule_table` (ISSUE 12 satellite: this module used to import the
+    private ``_fused_schedule``/``_resolve_tiles`` helpers directly, so
+    analysis/planner/census could drift from the geometry the kernel
+    actually launches).  ``fuse_combine`` must mirror the path being
     priced, because the combine chunks claim VMEM the schedule gate
     accounts for (a mismatch here once under-charged the fused_combine
     table 4x; code-review r5 pass 2 finding #2).
 
     ``schedule`` overrides the kernel's own resolution ('batched',
-    'resident', 'stream') so the planner can price every schedule, not
-    just the one the heuristics would pick; None keeps the kernel's
-    choice."""
-    from flashmoe_tpu.parallel.ep import local_capacity
-    from flashmoe_tpu.parallel.fused import _fused_schedule, _resolve_tiles
-    from flashmoe_tpu import tuning
+    'resident', 'stream', 'rowwin') so the planner can price every
+    schedule, not just the one the heuristics would pick; None keeps the
+    kernel's choice.  For rowwin, ``bi`` is the IO-aware chooser's
+    K-window width and ``n_i_chunks`` the window count."""
+    from flashmoe_tpu.parallel.fused import schedule_table
 
-    s_loc = cfg.tokens // d_world
-    h, i = cfg.hidden_size, cfg.intermediate_size
-    dt = jnp.dtype(cfg.dtype).itemsize
-    cap = local_capacity(cfg, s_loc)
-    cap_pad = -(-cap // 32) * 32
-    cm, bi = _resolve_tiles(cap_pad, h, i, jnp.dtype(cfg.dtype).name,
-                            fuse_combine)
-    gated = cfg.gated_ffn
-    resolved, _bh = _fused_schedule(
-        cap_pad, h, i, dt, gated, cm, bi, fuse_combine,
-        cfg.expert_top_k, d_world,
-        tuning.lookup("fused_ep", h=h, i=i,
-                      dtype=jnp.dtype(cfg.dtype).name))
-    if schedule is not None:
-        if schedule not in ("batched", "resident", "stream"):
-            raise ValueError(f"unknown fused schedule {schedule!r}")
-        resolved = schedule
-    n_row_tiles = cap_pad // cm
-    n_i_chunks = i // bi
-    return dict(s_loc=s_loc, h=h, i=i, dt=dt, cap=cap_pad, cap_raw=cap,
-                cm=cm, bi=bi, gated=gated, schedule=resolved,
-                n_row_tiles=n_row_tiles, n_i_chunks=n_i_chunks)
+    t = schedule_table(cfg, d_world, fuse_combine=fuse_combine,
+                       schedule=schedule)
+    return dict(s_loc=t["s_loc"], h=t["h"], i=t["i"], dt=t["dt"],
+                cap=t["cap"], cap_raw=t["cap_raw"], cm=t["cm"],
+                bi=t["bi"], gated=t["gated"], schedule=t["priced"],
+                n_row_tiles=t["n_row_tiles"],
+                n_i_chunks=t["n_i_chunks"])
 
 
 def path_costs(cfg: MoEConfig, path: str, d_world: int = 1,
@@ -204,10 +192,18 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1,
     #     the own slab at step 0 and every remote slab expert-major at
     #     the final step, streaming weights exactly TWICE.  The d_world
     #     factor was this model's headline finding (BASELINE.md round-5
-    #     reading #2) and motivated the batched schedule.
+    #     reading #2) and motivated the batched schedule.  The
+    #     row-windowed schedule (ISSUE 12) makes the same 2-pass
+    #     guarantee WITHOUT holding anything weights-once in VMEM:
+    #     window-major / row-minor order streams each K-window once per
+    #     pass (own slab at step 0, batched remotes at the final step),
+    #     so its weight column matches batched — the d x n_row_tiles
+    #     collapse that rescues mixtral-width experts from the 40x
+    #     stream column (BASELINE.md's updated caveat).
     fused_streams = {
         "batched": 2 if d_world > 1 else 1,
         "resident": d_world,
+        "rowwin": 2 if d_world > 1 else 1,
         "stream": d_world * g["n_row_tiles"],
     }[g["schedule"]]
     gate_bytes = s * h * dt + h * e * dt
@@ -272,6 +268,15 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1,
         x_refactor = (g["n_i_chunks"] if g["schedule"] != "stream" else 1)
         act_bytes = (gate_bytes + slots * h * dt * x_refactor
                      + slots * h * dt)                # x_recv reads + y_stage
+        if g["schedule"] == "rowwin":
+            # the honest price of window-major row-windowing: every
+            # resident row round-trips its f32 partial sum through the
+            # HBM accumulator at each INTERIOR window boundary (the
+            # first window starts from zero, the last folds straight
+            # into y_stage) — 4 B read + 4 B write per element per
+            # boundary.  This is the term BASELINE.md's caveat demanded
+            # the model charge before believing the 2x weight column.
+            act_bytes += (g["n_i_chunks"] - 1) * slots * h * 8.0
         if path == "fused_combine":
             # sorted per-row returns carry only the rows actually routed
             # (dispatch.sorted_return_maps): rows*h out + rows*h in — the

@@ -274,11 +274,17 @@ def _run_controller_drill(fault: str, *, num_steps: int,
     ``slow_device``: one device degrades to a fraction of its rate
     mid-job while the workload's hot expert sits on it (the wrap_step
     stall is priced from the controller's LIVE placement: ``sleep_s *
-    device_load_share(slow)/rate``).  Recovery = a
-    ``controller.replace`` — the Decider's rate-proportional assignment
-    moves the hot expert onto a fast device (replicating it onto a dead
-    slot when that improves the makespan), the stall collapses, and the
-    armed SLO watchdog records the step time returning under budget
+    device_load_share(slow)/rate``).  The controller runs its DEFAULT
+    ``rates_fn`` — the production per-device throughput re-probe
+    (``runtime/throughput.device_rates``; ISSUE 12 satellite) — with
+    the drill's degraded rates armed at the ``probe_rates`` injection
+    seam, the reading a genuinely slow chip would hand the probe (the
+    host-sleep stall this drill injects is invisible to a real CPU
+    probe).  Recovery = a ``controller.replace`` carrying the PROBED
+    rates — the Decider's rate-proportional assignment moves the hot
+    expert onto a fast device (replicating it onto a dead slot when
+    that improves the makespan), the stall collapses, and the armed
+    SLO watchdog records the step time returning under budget
     (``slo.recovered``)."""
     from flashmoe_tpu.profiler.slo import SLOConfig
     from flashmoe_tpu.runtime.controller import (
@@ -305,6 +311,12 @@ def _run_controller_drill(fault: str, *, num_steps: int,
 
     n_dev = 4 if slow else 1
     rates = np.array([0.25, 1.0, 1.0, 1.0]) if slow else None
+    if slow:
+        # the controller keeps its DEFAULT rates_fn (the live
+        # per-device re-probe); the drill degrades what the probe READS
+        # via the chaos seam, so the production path — trigger ->
+        # re-probe -> rate-proportional re-placement — is what recovers
+        inject.arm("probe_rates", rates=tuple(float(r) for r in rates))
     ccfg = ControllerConfig(
         enable_morph=not slow, enable_replace=slow,
         debounce_steps=2, cooldown_steps=3, baseline_steps=2,
@@ -312,8 +324,7 @@ def _run_controller_drill(fault: str, *, num_steps: int,
         slow_factor=1.5)
     metrics = Metrics()
     controller = RuntimeController(
-        cfg, ccfg, metrics=metrics, n_devices=n_dev,
-        rates_fn=(lambda: rates) if slow else None)
+        cfg, ccfg, metrics=metrics, n_devices=n_dev)
 
     mesh = make_mesh(cfg, dp=1, devices=jax.devices()[:1])
     guard = GradGuardConfig(warmup_steps=2, spike_factor=10.0)
@@ -450,6 +461,13 @@ def _run_controller_drill(fault: str, *, num_steps: int,
                  "replicas)")
             need(bool(act.get("replicas")),
                  "hot expert was not replicated onto a dead slot")
+            # ISSUE 12 satellite: the re-placement must have consumed
+            # the PROBED rates (the controller's default rates_fn
+            # through the probe_rates chaos seam), not drill-injected
+            # ones — the decision record carries what the probe read
+            need(act.get("rates") == [float(r) for r in rates],
+                 f"controller.replace did not carry the probed rates "
+                 f"(got {act.get('rates')})")
             pre = [s for i, s in enumerate(step_wall)
                    if plan.step <= i < act_step]
             post = step_wall[act_step + 1:]  # skip the re-jit step

@@ -27,12 +27,21 @@ point              hook site                                   spec keys
 ``nan_grad``       trainer gradients at one step               step
 ``grad_spike``     trainer gradients at one step               step,
                                                                scale
+``probe_rates``    per-device throughput probe                 rates
+                   (runtime/throughput.py device_rates —
+                   host-side, not in-graph: supplies the
+                   reading a degraded chip WOULD produce,
+                   so the slow_device drill exercises the
+                   controller's production re-probe path)
 =================  ==========================================  =========
 
 Host-level faults (``slow_step``, ``corrupt_ckpt``, ``path_raise``,
 ``preempt``, ``device_loss``) do not live here — they ride
 :func:`flashmoe_tpu.chaos.make_injector` /
-:func:`flashmoe_tpu.chaos.wrap_step` instead.
+:func:`flashmoe_tpu.chaos.wrap_step` instead (``probe_rates`` is the
+one host-side point in this registry: the probe it poisons is itself a
+host-side measurement consulted at a step boundary, so the arm/disarm
+lifecycle — not wrap_step — is the right seam).
 """
 
 from __future__ import annotations
@@ -41,7 +50,8 @@ import jax.numpy as jnp
 
 _ARMED: dict[str, dict] = {}
 
-POINTS = ("nan_expert", "skewed_routing", "nan_grad", "grad_spike")
+POINTS = ("nan_expert", "skewed_routing", "nan_grad", "grad_spike",
+          "probe_rates")
 
 
 def arm(point: str, **spec) -> None:

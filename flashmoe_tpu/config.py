@@ -224,6 +224,22 @@ class MoEConfig:
     # staticcheck/registry.py SELECTOR_FIELDS).
     serving_mode: str | None = None
 
+    # Forced FFN schedule of the fused RDMA kernel
+    # (parallel/fused.py:_fused_schedule): None = auto (the IO-aware
+    # resolution — arrival-batched when the hidden slab fits VMEM,
+    # per-source resident when its byte trade wins, row-windowed
+    # ('rowwin') when it beats per-row-tile streaming, 'stream'
+    # otherwise); or one of 'batched' / 'resident' / 'stream' /
+    # 'rowwin' to pin the schedule.  A forced schedule still faces the
+    # hard VMEM feasibility gate — the kernel raises a clear ValueError
+    # rather than launching an infeasible geometry, and the planner
+    # marks the matching fused[<schedule>] row infeasible with the
+    # reason.  Pure selector: every value computes the same function
+    # (bit-identity across schedules asserted by tests/test_fused.py);
+    # only execution geometry changes (registered in
+    # staticcheck/registry.py SELECTOR_FIELDS).
+    fused_schedule: str | None = None
+
     # Inference-only: fuse the dispatch gather into the FFN kernel
     # (ops/expert.py:grouped_ffn_tokens — no [E, C, H] HBM buffer).
     # None = auto: follow the FLASHMOE_GATHER_FUSED env var, else stay on
@@ -251,6 +267,12 @@ class MoEConfig:
             raise ValueError(
                 f"moe_backend {self.moe_backend!r} not in "
                 f"('collective', 'fused', 'ragged', 'auto')"
+            )
+        if self.fused_schedule not in (None, "batched", "resident",
+                                       "stream", "rowwin"):
+            raise ValueError(
+                f"fused_schedule {self.fused_schedule!r} not in "
+                f"(None, 'batched', 'resident', 'stream', 'rowwin')"
             )
         # reject combinations the specialized transports cannot serve
         # rather than silently falling back to the collective path

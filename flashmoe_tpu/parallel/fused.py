@@ -24,14 +24,22 @@ over the ``ep`` mesh axis:
     reference's ``SignalPayload``), run the local experts' up/act/down
     GEMM chain on arrived rows, and RDMA the results back to the source.
     Compute overlaps the in-flight transfers of later slabs —
-    payload-granularity overlap, which is the paper's core claim.  THREE
+    payload-granularity overlap, which is the paper's core claim.  FOUR
     FFN schedules (:func:`_fused_schedule`): per-source streaming,
-    per-source weights-resident, and the arrival-batched default at
+    per-source weights-resident, the arrival-batched default at
     ep >= 3 — own slab computed at step 0 while remote slabs fly, all
     remote slabs computed expert-major at the final step so each weight
     byte streams twice total instead of once per source (the round-5
     cost model showed the per-source schedules' d x weight re-streaming
-    dominates every other byte at multi-chip scale — see BASELINE.md);
+    dominates every other byte at multi-chip scale — see BASELINE.md) —
+    and the row-windowed ``rowwin`` schedule for experts too wide for
+    any weights-once residency (mixtral's i=14336): weights stream in
+    VMEM-sized K-windows, window-major / row-minor, partial sums parked
+    in an HBM f32 accumulator, bounding weight traffic at ~2 streams
+    total at the cost of per-window activation re-streaming (ISSUE 12 /
+    ROADMAP item 4; tiles picked by the IO-aware chooser
+    :func:`_rowwin_tiles`, overridable by measured ``fused_tiles``
+    tuning entries);
   * phase 2.5 — in-kernel combine: result rows return via RDMA directly
     into a TOKEN-SORTED buffer (each occupied slab slot is pre-assigned
     the row ``token*k + j`` XLA-side, :func:`flashmoe_tpu.ops.dispatch.
@@ -116,6 +124,9 @@ def _fused_kernel(
                                           #   [rows_pad, H] return buffer when
                                           #   fusing; out: [s_out_pad, H] f32,
                                           #   None when combine stays in XLA)
+    acc_hbm,                              # [D, nLx, C, H] f32 HBM partial
+                                          #   sums of the rowwin window
+                                          #   loop (None otherwise)
     xs_vmem, wup_vmem, wdn_vmem, acc, yv, # VMEM scratch (wdn/acc/yv are
                                           #   [2,bi,h]/[cm,h]/[cm,h] when
                                           #   streaming, [2,i,bh]/[cm,bh]/
@@ -575,6 +586,143 @@ def _fused_kernel(
 
             jax.lax.fori_loop(0, n_srcs, src_ret, 0)
 
+        def rowwin_expert(first_q, n_srcs):
+            """Row-windowed K-streamed schedule, WINDOW-major / row-minor
+            (ISSUE 12 / ROADMAP item 4; SonicMoE's IO-aware stance,
+            arXiv 2512.14080): the expert's weights stream along the
+            intermediate dimension in ``bi``-wide VMEM windows (w_up
+            columns + the matching w_down rows, double-buffered), and
+            every present row tile of EVERY source in the pass flows
+            through the resident window before the next is fetched —
+            so each weight element streams once per pass, bounding
+            weight traffic at ~2 streams total (own-slab pass at step 0
+            + the arrival-batched remote pass at the final step)
+            regardless of d or the row-tile count.  This is exactly the
+            loop order BASELINE.md's round-5 caveat said naive
+            row-windowing misses: a ROW-major window loop re-streams
+            every window per row tile and degenerates to the stream
+            schedule's bytes.
+
+            The price is per-window activation re-streaming: each row
+            tile re-reads its x tile per window and round-trips its f32
+            partial sum through the HBM accumulator ``acc_hbm`` at every
+            interior window boundary (the [cm, h] f32 state of ALL
+            resident rows can never be VMEM-resident at the shapes this
+            schedule exists for) — both priced in
+            flashmoe_tpu/analysis.py.  The final window folds in the
+            down bias, stages the finished tile, and issues its return
+            immediately (per-TILE return granularity — finer than the
+            batched schedule's per-expert returns)."""
+            def src_of(q):
+                return src_order[my, first_q + q]
+
+            wu_dma(0, 0).start()
+            wd_dma(0, 0).start()
+
+            def win_body(j, carry_c):
+                slot = jax.lax.rem(j, 2)
+
+                @pl.when(j + 1 < n_i_chunks)
+                def _prefetch():
+                    wu_dma(j + 1, 1 - slot).start()
+                    wd_dma(j + 1, 1 - slot).start()
+
+                wu_dma(j, slot).wait()
+                wd_dma(j, slot).wait()
+
+                def src_body(q, c1):
+                    sq = src_of(q)
+                    ntq = tiles_of(recv_cnt[sq, e])
+
+                    def tile_body(t, c2):
+                        @pl.when(t < ntq)
+                        def _():
+                            xd = pltpu.make_async_copy(
+                                x_recv.at[sq, e, pl.ds(t * cm, cm), :],
+                                xs_vmem, copy_sems.at[0],
+                            )
+                            xd.start()
+
+                            # resume this tile's partial sum (interior
+                            # windows; window 0 starts from zero)
+                            @pl.when(j > 0)
+                            def _resume():
+                                ad = pltpu.make_async_copy(
+                                    acc_hbm.at[sq, e,
+                                               pl.ds(t * cm, cm), :],
+                                    acc, copy_sems.at[1],
+                                )
+                                ad.start()
+                                ad.wait()
+
+                            @pl.when(j == 0)
+                            def _zero():
+                                acc[:] = jnp.zeros_like(acc)
+
+                            xd.wait()
+                            if gated:
+                                g = jnp.dot(
+                                    xs_vmem[:], wup_vmem[slot, :, :bi],
+                                    preferred_element_type=jnp.float32,
+                                )
+                                up = jnp.dot(
+                                    xs_vmem[:], wup_vmem[slot, :, bi:],
+                                    preferred_element_type=jnp.float32,
+                                ) + bup_vmem[0, pl.ds(j * bi, bi)].astype(
+                                    jnp.float32)
+                                hidden = (act(g) * up).astype(
+                                    xs_vmem.dtype)
+                            else:
+                                up = jnp.dot(
+                                    xs_vmem[:], wup_vmem[slot],
+                                    preferred_element_type=jnp.float32,
+                                ) + bup_vmem[0, pl.ds(j * bi, bi)].astype(
+                                    jnp.float32)
+                                hidden = act(up).astype(xs_vmem.dtype)
+                            acc[:] += jnp.dot(
+                                hidden, wdn_vmem[slot],
+                                preferred_element_type=jnp.float32,
+                            )
+
+                            # interior windows park the partial sum in
+                            # HBM; the last window finishes the tile and
+                            # returns it immediately
+                            @pl.when(j + 1 < n_i_chunks)
+                            def _spill():
+                                sd = pltpu.make_async_copy(
+                                    acc,
+                                    acc_hbm.at[sq, e,
+                                               pl.ds(t * cm, cm), :],
+                                    copy_sems.at[1],
+                                )
+                                sd.start()
+                                sd.wait()
+
+                            @pl.when(j + 1 == n_i_chunks)
+                            def _finish():
+                                yv[:] = (
+                                    acc[:] + bdn_vmem[0].astype(
+                                        jnp.float32)
+                                ).astype(yv.dtype)
+                                st2 = pltpu.make_async_copy(
+                                    yv,
+                                    y_stage.at[sq, e,
+                                               pl.ds(t * cm, cm), :],
+                                    copy_sems.at[0],
+                                )
+                                st2.start()
+                                st2.wait()
+                                send_back(sq, t)
+                        return c2
+
+                    return jax.lax.fori_loop(0, n_row_tiles, tile_body,
+                                             c1)
+
+                jax.lax.fori_loop(0, n_srcs, src_body, 0)
+                return carry_c
+
+            jax.lax.fori_loop(0, n_i_chunks, win_body, 0)
+
         def rows_present(first_q, n_srcs):
             """Total routed rows this expert holds across the sources —
             gates the weight streams so empty (source-set, expert) pairs
@@ -587,18 +735,22 @@ def _fused_kernel(
         # only the row tiles the step's source(s) actually routed here
         # (tiles_of(cnt) <= n_row_tiles by construction: counts are clamped
         # to cap and cap % cm == 0)
-        if schedule == "batched":
+        if schedule in ("batched", "rowwin"):
             # own slab at step 0 (overlapping remote arrivals), every
             # remote source batched at the final step with weights
-            # streamed once
+            # streamed once per pass (VMEM-resident hidden for batched,
+            # K-windowed with the HBM accumulator for rowwin)
+            pass_fn = (resident_expert if schedule == "batched"
+                       else rowwin_expert)
+
             @pl.when((s == 0) & (rows_present(0, 1) > 0))
             def _own():
-                resident_expert(0, 1)
+                pass_fn(0, 1)
 
             @pl.when((s == d_world - 1)
                      & (rows_present(1, d_world - 1) > 0))
             def _remote():
-                resident_expert(1, d_world - 1)
+                pass_fn(1, d_world - 1)
         elif schedule == "resident":
             @pl.when(rows_present(s, 1) > 0)
             def _nonempty():
@@ -608,7 +760,7 @@ def _fused_kernel(
                               0)
         return _
 
-    if schedule == "batched":
+    if schedule in ("batched", "rowwin"):
         # intermediate steps only consume arrivals (phase-2 waits above);
         # the expert loop runs at the endpoints
         @pl.when((s == 0) | (s == d_world - 1))
@@ -835,10 +987,169 @@ def _resident_budget_ok(cap, h, i_dim, dt_size, gated, cm, bi,
     return True, bh
 
 
+#: K-window width candidates of the row-windowed schedule, widest first
+#: (wider window = fewer activation re-streams; the IO-aware chooser
+#: maximizes it under the VMEM budget)
+_KW_CANDIDATES = (2048, 1024, 512, 256, 128, 64, 32, 16, 8)
+
+
+def _rowwin_budget_ok(cap: int, h: int, i_dim: int, dt_size: int,
+                      gated: bool, cm: int, kw: int, fuse_combine: bool,
+                      k: int) -> bool:
+    """VMEM feasibility of the row-windowed schedule at (cm row tile,
+    kw K-window): the double-buffered window pair (w_up [h, kw] — or
+    [h, 2*kw] gated — plus w_down [kw, h]) + one x row tile + the f32
+    partial-sum accumulator tile + the full-width output tile.  The
+    cross-window state lives in HBM (``acc_hbm``), so — unlike the
+    weights-once schedules — NOTHING here scales with the capacity or
+    the source count: this is the schedule that stays feasible when the
+    expert is simply bigger than VMEM (mixtral's i=14336)."""
+    wu2 = 2 * h * (2 * kw if gated else kw) * dt_size
+    wd2 = 2 * kw * h * dt_size
+    tiles = cm * h * dt_size + cm * h * 4 + cm * h * dt_size  # xs+acc+yv
+    bias = i_dim * 4 + h * 4
+    chunk = (_combine_chunk_rows(k) * k * (h * dt_size + 4)
+             + _combine_chunk_rows(k) * h * 4) if fuse_combine else 0
+    return wu2 + wd2 + tiles + bias + chunk <= 15 * 2**20
+
+
+def rowwin_tile_candidates(cap: int, h: int, i_dim: int, dt_size: int,
+                           gated: bool, fuse_combine: bool,
+                           k: int) -> list[tuple[int, int]]:
+    """Every VMEM-feasible (cm row tile, kw K-window) pair of the
+    rowwin schedule at this shape — THE candidate grid shared by the
+    IO-aware chooser (:func:`_rowwin_tiles`), ``bench.py --tiles`` and
+    ``tune_sweep.py --stage tiles`` (via
+    :func:`rowwin_sweep_candidates`), and the contract tests, so the
+    measured sweeps can never silently drift from the pairs the
+    chooser can actually pick."""
+    return [
+        (cm, kw)
+        for cm in (256, 128, 64, 32, 16, 8) if cap % cm == 0
+        for kw in _KW_CANDIDATES if i_dim % kw == 0
+        and _rowwin_budget_ok(cap, h, i_dim, dt_size, gated, cm, kw,
+                              fuse_combine, k)
+    ]
+
+
+def rowwin_sweep_candidates(cap: int, h: int, i_dim: int, dt_size: int,
+                            gated: bool, fuse_combine: bool,
+                            k: int) -> list[tuple[int, int]]:
+    """The measurement subset of :func:`rowwin_tile_candidates` the
+    tiles sweeps time: ONE candidate per feasible K-window, at its
+    widest feasible row tile.  cm moves no modeled HBM bytes (the
+    chooser always prefers the widest feasible cm for whatever kw it
+    picks), so per-kw best-cm covers every pair the analytic chooser
+    can select while keeping a hardware sweep to a handful of timed
+    points instead of the full grid."""
+    best_cm: dict[int, int] = {}
+    for cm, kw in rowwin_tile_candidates(cap, h, i_dim, dt_size, gated,
+                                         fuse_combine, k):
+        best_cm[kw] = max(best_cm.get(kw, 0), cm)
+    return sorted(((cm, kw) for kw, cm in best_cm.items()),
+                  key=lambda t: -t[1])
+
+
+def _rowwin_tiles(cap: int, h: int, i_dim: int, dt_size: int,
+                  dtype_name: str | None, gated: bool,
+                  fuse_combine: bool, k: int) -> tuple[int | None,
+                                                       int | None]:
+    """IO-aware (row tile, K-window) chooser for the rowwin schedule:
+    among VMEM-feasible (cm, kw) pairs, minimize the schedule's modeled
+    HBM traffic (the SonicMoE stance, arXiv 2512.14080: optimize bytes,
+    not FLOPs).  Weight bytes are tile-independent — window-major order
+    streams each window exactly once per pass — so the objective is the
+    activation term the window loop re-streams: per K-window every
+    resident row re-reads its x tile (``n_win * h * dt``) and
+    round-trips its f32 partial sum at every interior window boundary
+    (``(n_win - 1) * h * 8``).  Traffic falls monotonically with kw, so
+    the chooser takes the widest feasible window and spends the VMEM
+    that remains on the largest row tile (cm moves no HBM bytes; bigger
+    tiles mean fewer DMA issues and better MXU occupancy).
+
+    A measured ``fused_tiles`` tuning entry
+    (:mod:`flashmoe_tpu.tuning`; swept by ``scripts/tune_sweep.py
+    --stage tiles`` / ``bench.py --tiles``) overrides the analytic pick
+    when it still divides the shapes — the VMEM gate is never
+    overridable.  Returns ``(cm, kw)``, or ``(None, None)`` when no
+    pair fits the budget."""
+    best = None  # (modeled activation bytes/row, -cm, cm, kw)
+    for cm, kw in rowwin_tile_candidates(cap, h, i_dim, dt_size, gated,
+                                         fuse_combine, k):
+        n_win = i_dim // kw
+        bytes_per_row = n_win * h * dt_size + (n_win - 1) * h * 8
+        cand = (bytes_per_row, -cm, cm, kw)
+        if best is None or cand < best:
+            best = cand
+    if best is None:
+        return None, None
+    cm, kw = best[2], best[3]
+    if dtype_name is not None:
+        from flashmoe_tpu import tuning
+
+        tuned = tuning.lookup("fused_tiles", h=h, i=i_dim,
+                              dtype=dtype_name)
+        tcm, tkw = tuned.get("cm"), tuned.get("kw")
+        if (tcm and tkw and cap % tcm == 0 and i_dim % tkw == 0
+                and _rowwin_budget_ok(cap, h, i_dim, dt_size, gated,
+                                      tcm, tkw, fuse_combine, k)):
+            cm, kw = tcm, tkw
+    return cm, kw
+
+
+def _rowwin_choice(cap: int, h: int, i_dim: int, dt_size: int,
+                   dtype_name: str | None, gated: bool, cm_stream: int,
+                   fuse_combine: bool, k: int, d_world: int,
+                   tuned: dict) -> tuple[bool, int | None]:
+    """Static stream-vs-rowwin decision (both are the fallbacks when no
+    weights-once schedule fits VMEM).  Byte crossover, per local
+    expert: weight streams saved by row-windowing — stream pays
+    ``d_world * n_row_tiles`` streams, rowwin pays one pass for the own
+    slab plus one for the batched remotes — must exceed the activation
+    re-streaming the window loop adds (x re-reads + f32 partial-sum
+    round-trips over the ~``d_world * cap`` resident rows).  A measured
+    ``rowwin`` bit in the ``fused_ep`` tuning entry overrides the
+    heuristic; ``FLASHMOE_FUSED_ROWWIN=0`` disables outright; the VMEM
+    gate (the chooser finding any feasible pair) is never overridable.
+    Rowwin IS a batched-pass schedule (own slab at step 0, all remotes
+    in one pass at the final grid step), so the batched kill-switches —
+    ``FLASHMOE_FUSED_BATCHED=0`` and a measured ``batched: false``
+    entry — disable the auto choice too: a caller who asked for
+    per-source arrival processing must get it (a ``rowwin: true`` entry
+    or ``MoEConfig.fused_schedule='rowwin'`` still forces past them).
+    Returns ``(enabled, kw)``."""
+    cm, kw = _rowwin_tiles(cap, h, i_dim, dt_size, dtype_name, gated,
+                           fuse_combine, k)
+    if cm is None:
+        return False, None
+    if os.environ.get("FLASHMOE_FUSED_ROWWIN") == "0":
+        return False, None
+    knob = tuned.get("rowwin")
+    if knob is False:
+        return False, None
+    if knob is not True and (
+            os.environ.get("FLASHMOE_FUSED_BATCHED") == "0"
+            or tuned.get("batched") is False):
+        return False, None
+    if knob is not True:
+        n_row_tiles = cap // cm_stream
+        passes = 2 if d_world > 1 else 1
+        streams_saved = d_world * n_row_tiles - passes
+        wu_mult = 3 if gated else 2
+        saved = streams_saved * wu_mult * h * i_dim * dt_size
+        n_win = i_dim // kw
+        rows = d_world * cap
+        extra = rows * h * ((n_win - 1) * dt_size + (n_win - 1) * 8)
+        if saved <= extra:
+            return False, None
+    return True, kw
+
+
 def _fused_schedule(cap: int, h: int, i_dim: int, dt_size: int,
                     gated: bool, cm: int, bi: int, fuse_combine: bool,
                     k: int, d_world: int,
-                    tuned: dict) -> tuple[str, int | None]:
+                    tuned: dict, *, dtype_name: str | None = None,
+                    forced: str | None = None) -> tuple[str, int | None]:
     """Static FFN-schedule choice for the fused kernel:
 
       batched    own slab at step 0, ALL remote slabs expert-major at the
@@ -850,11 +1161,61 @@ def _fused_schedule(cap: int, h: int, i_dim: int, dt_size: int,
                  and per-source keeps finer overlap.
       resident   per-source two-pass (kills the n_row_tiles x factor,
                  VERDICT r4 weak #4) when its byte trade wins.
+      rowwin     row-windowed K-dim streaming, window-major / row-minor
+                 (ISSUE 12 / ROADMAP item 4): expert weights stream in
+                 VMEM-sized K-windows and every resident row tile —
+                 batched across ALL the pass's source slabs, like the
+                 arrival-batched schedule — passes through a window
+                 before the next is fetched, partial sums parked in an
+                 HBM f32 accumulator.  ~2 weight streams total
+                 regardless of d, at the cost of per-window activation
+                 re-streaming — the schedule that serves wide experts
+                 (mixtral i=14336) whose hidden slab can never be VMEM
+                 resident.  Chosen over stream when its byte trade wins
+                 (:func:`_rowwin_choice`).
       stream     per-row-tile weight streaming (the round-<=4 schedule).
 
     ``FLASHMOE_FUSED_BATCHED=0`` or a ``batched: false`` tuning entry
     disables the batched schedule; a ``batched: true`` entry forces it
-    past the d>=3 heuristic (never past the VMEM gate)."""
+    past the d>=3 heuristic (never past the VMEM gate).  ``rowwin``
+    tuning bits / ``FLASHMOE_FUSED_ROWWIN=0`` gate rowwin the same way.
+
+    ``forced`` (``MoEConfig.fused_schedule``) pins the schedule; a
+    forced schedule still faces the hard VMEM gate — ValueError with
+    the reason rather than an infeasible launch.  The second return
+    value is the output-column chunk ``bh`` for batched/resident, the
+    K-window ``kw`` for rowwin, None for stream."""
+    if forced is not None:
+        if forced == "stream":
+            return "stream", None
+        if forced in ("batched", "resident"):
+            if forced == "batched" and d_world < 2:
+                raise ValueError(
+                    "fused_schedule='batched' needs an ep world of >= 2 "
+                    "ranks (there is no remote batch at d_world=1)")
+            hid_rows = ((d_world - 1) * cap if forced == "batched"
+                        else cap)
+            ok, bh = _resident_budget_ok(
+                cap, h, i_dim, dt_size, gated, cm, bi, fuse_combine, k,
+                hid_rows=hid_rows)
+            if not ok:
+                raise ValueError(
+                    f"fused_schedule={forced!r} is VMEM-infeasible at "
+                    f"this shape: the {hid_rows}-row hidden slab plus "
+                    f"the double-buffered weight chunks exceed the "
+                    f"budget (see BASELINE.md; 'rowwin' or 'stream' "
+                    f"stay feasible)")
+            return forced, bh
+        if forced == "rowwin":
+            cmr, kwr = _rowwin_tiles(cap, h, i_dim, dt_size, dtype_name,
+                                     gated, fuse_combine, k)
+            if cmr is None:
+                raise ValueError(
+                    "fused_schedule='rowwin' is VMEM-infeasible at this "
+                    "shape: no (row tile, K-window) pair fits the "
+                    "window double-buffer + accumulator budget")
+            return "rowwin", kwr
+        raise ValueError(f"unknown fused schedule {forced!r}")
     knob = tuned.get("batched")
     env_off = os.environ.get("FLASHMOE_FUSED_BATCHED") == "0"
     want_batched = (knob if knob is not None
@@ -867,48 +1228,112 @@ def _fused_schedule(cap: int, h: int, i_dim: int, dt_size: int,
             return "batched", bh
     resident, bh = _weights_resident_choice(
         cap, h, i_dim, dt_size, gated, cm, bi, fuse_combine, k, tuned)
-    return ("resident", bh) if resident else ("stream", None)
+    if resident:
+        return "resident", bh
+    rowwin, kw = _rowwin_choice(cap, h, i_dim, dt_size, dtype_name,
+                                gated, cm, fuse_combine, k, d_world,
+                                tuned)
+    if rowwin:
+        return "rowwin", kw
+    return "stream", None
 
 
-def schedule_metadata(cfg: MoEConfig, d_world: int, *,
-                      fuse_combine: bool = False) -> dict:
-    """Resolved execution geometry of the fused kernel at (cfg, d_world)
-    — the schedule the kernel would actually run plus the VMEM
-    feasibility of every schedule, for consumers that price alternatives
-    (the analytical planner, :mod:`flashmoe_tpu.planner`) rather than
-    launch the kernel.
+def schedule_table(cfg: MoEConfig, d_world: int, *,
+                   fuse_combine: bool = False,
+                   schedule: str | None = None) -> dict:
+    """Public resolution of the fused kernel's execution geometry at
+    ``(cfg, d_world)`` — THE single function behind the kernel launch,
+    the byte model (``analysis._geom``), the planner's per-schedule
+    feasibility rows, and the collective census, so no consumer can
+    resolve a different geometry than the kernel actually runs (ISSUE
+    12 satellite: the planner once imported the private helpers
+    directly and could drift).
 
-    Returns ``{schedule, feasible: {batched, resident, stream}, cap, cm,
-    bi, n_row_tiles, n_i_chunks}``.  ``schedule`` honors the same tuning
-    entries / env knobs as the launch path; ``feasible`` reports only the
-    hard VMEM gates (a schedule can be feasible yet not chosen)."""
+    ``schedule`` forces which schedule's geometry is REPORTED (the
+    planner prices every schedule, not just the resolved one) without
+    touching the resolution; None reports the resolved schedule's.
+    ``cfg.fused_schedule`` is honored by the resolution; when the
+    forced schedule is VMEM-infeasible the table falls back to the auto
+    choice and records the reason under ``forced_infeasible`` (the
+    LAUNCH path raises instead — see :func:`_fused_schedule`).
+
+    Returns::
+
+        schedule       the schedule the kernel would run
+        priced         the schedule this table's geometry describes
+                       (= ``schedule`` arg or the resolved one)
+        feasible       {batched, resident, stream, rowwin}: hard VMEM
+                       gates only (a schedule can be feasible yet not
+                       chosen)
+        cap, cap_raw   32-padded / raw per-(rank, expert) capacity
+        cm, bi         row tile and weight-chunk width at ``priced``
+                       (for rowwin, ``bi`` IS the K-window ``kw`` — the
+                       IO-aware chooser's pick)
+        kw             the K-window when ``priced == 'rowwin'``, None
+                       otherwise
+        n_row_tiles, n_i_chunks   derived loop extents (for rowwin,
+                       ``n_i_chunks`` is the window count)
+        s_loc, h, i, dt, gated    shared shape facts
+        forced_infeasible         reason string, or None
+    """
     from flashmoe_tpu import tuning
 
     s_loc = cfg.tokens // d_world
     h, i_dim = cfg.hidden_size, cfg.intermediate_size
     dt = jnp.dtype(cfg.dtype).itemsize
-    cap = -(-local_capacity(cfg, s_loc) // 32) * 32
-    cm, bi = _resolve_tiles(cap, h, i_dim, jnp.dtype(cfg.dtype).name,
-                            fuse_combine)
+    name = jnp.dtype(cfg.dtype).name
+    cap_raw = local_capacity(cfg, s_loc)
+    cap = -(-cap_raw // 32) * 32
+    cm, bi = _resolve_tiles(cap, h, i_dim, name, fuse_combine)
     gated = cfg.gated_ffn
     k = cfg.expert_top_k
-    tuned = tuning.lookup("fused_ep", h=h, i=i_dim,
-                          dtype=jnp.dtype(cfg.dtype).name)
-    schedule, _ = _fused_schedule(cap, h, i_dim, dt, gated, cm, bi,
-                                  fuse_combine, k, d_world, tuned)
+    tuned = tuning.lookup("fused_ep", h=h, i=i_dim, dtype=name)
     batched_ok = d_world >= 2 and _resident_budget_ok(
         cap, h, i_dim, dt, gated, cm, bi, fuse_combine, k,
         hid_rows=(d_world - 1) * cap)[0]
     resident_ok = cap // cm > 1 and _resident_budget_ok(
         cap, h, i_dim, dt, gated, cm, bi, fuse_combine, k,
         hid_rows=cap)[0]
+    rw_cm, rw_kw = _rowwin_tiles(cap, h, i_dim, dt, name, gated,
+                                 fuse_combine, k)
+    feasible = {"batched": batched_ok, "resident": resident_ok,
+                "stream": True, "rowwin": rw_cm is not None}
+    forced_infeasible = None
+    try:
+        resolved, _aux = _fused_schedule(
+            cap, h, i_dim, dt, gated, cm, bi, fuse_combine, k, d_world,
+            tuned, dtype_name=name, forced=cfg.fused_schedule)
+    except ValueError as e:
+        forced_infeasible = str(e)
+        resolved, _aux = _fused_schedule(
+            cap, h, i_dim, dt, gated, cm, bi, fuse_combine, k, d_world,
+            tuned, dtype_name=name)
+    priced = schedule if schedule is not None else resolved
+    if priced not in feasible:
+        raise ValueError(
+            f"unknown fused schedule {priced!r}; choose from "
+            f"{tuple(sorted(feasible))}")
+    if priced == "rowwin" and rw_cm is not None:
+        cm, bi = rw_cm, rw_kw
     return {
-        "schedule": schedule,
-        "feasible": {"batched": batched_ok, "resident": resident_ok,
-                     "stream": True},
-        "cap": cap, "cm": cm, "bi": bi,
+        "schedule": resolved, "priced": priced, "feasible": feasible,
+        "cap": cap, "cap_raw": cap_raw, "cm": cm, "bi": bi,
+        "kw": rw_kw if priced == "rowwin" else None,
         "n_row_tiles": cap // cm, "n_i_chunks": i_dim // bi,
+        "s_loc": s_loc, "h": h, "i": i_dim, "dt": dt, "gated": gated,
+        "forced_infeasible": forced_infeasible,
     }
+
+
+def schedule_metadata(cfg: MoEConfig, d_world: int, *,
+                      fuse_combine: bool = False) -> dict:
+    """Back-compat view of :func:`schedule_table`: ``{schedule,
+    feasible, cap, cm, bi, n_row_tiles, n_i_chunks}`` — the keys PR-1
+    consumers read.  New code should call :func:`schedule_table`, which
+    adds the rowwin geometry and the forced-schedule surface."""
+    t = schedule_table(cfg, d_world, fuse_combine=fuse_combine)
+    return {k: t[k] for k in ("schedule", "feasible", "cap", "cm", "bi",
+                              "n_row_tiles", "n_i_chunks")}
 
 
 def _fused_shard(send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
@@ -928,18 +1353,30 @@ def _fused_shard(send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
     # one resolution of (cm, bi) shared with the combine budget gate, so
     # the VMEM estimate that approved the opt-in describes the kernel that
     # actually launches (advisor r4 #1)
-    cm, bi = _resolve_tiles(cap, h, i_dim, jnp.dtype(x_send.dtype).name,
-                            fuse_combine)
-    if i_dim % bi:
-        raise ValueError(f"intermediate {i_dim} not divisible by {bi}")
+    dt_name = jnp.dtype(x_send.dtype).name
+    dt_size = jnp.dtype(x_send.dtype).itemsize
+    cm, bi = _resolve_tiles(cap, h, i_dim, dt_name, fuse_combine)
     from flashmoe_tpu import tuning
 
-    schedule, bh = _fused_schedule(
-        cap, h, i_dim, jnp.dtype(x_send.dtype).itemsize, gated, cm, bi,
+    schedule, aux = _fused_schedule(
+        cap, h, i_dim, dt_size, gated, cm, bi,
         fuse_combine, k, d_world,
-        tuning.lookup("fused_ep", h=h, i=i_dim,
-                      dtype=jnp.dtype(x_send.dtype).name),
+        tuning.lookup("fused_ep", h=h, i=i_dim, dtype=dt_name),
+        dtype_name=dt_name, forced=cfg.fused_schedule,
     )
+    bh = None
+    if schedule == "rowwin":
+        # the IO-aware chooser owns BOTH tiles on the rowwin schedule:
+        # bi becomes the K-window width (aux == kw by construction), so
+        # every bi-keyed mechanism below — the gated gate|up interleave,
+        # the wu/wd window DMAs, the [2, bi, h] w_down slots — windows
+        # the K dimension without a second code path
+        cm, bi = _rowwin_tiles(cap, h, i_dim, dt_size, dt_name, gated,
+                               fuse_combine, k)
+    else:
+        bh = aux
+    if i_dim % bi:
+        raise ValueError(f"intermediate {i_dim} not divisible by {bi}")
     if gated:
         # interleave per-chunk: [nlx, H, nj*2*bi] as [gate_chunk | up_chunk]
         nj = i_dim // bi
@@ -984,6 +1421,16 @@ def _fused_shard(send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
         out_shapes.append(
             jax.ShapeDtypeStruct((rows_pad // k, h), jnp.float32))  # out
         out_specs.append(any_spec)
+    if schedule == "rowwin":
+        # HBM f32 partial-sum accumulator of the window loop: scratch
+        # that must persist across K-windows for EVERY resident row, so
+        # it cannot live in VMEM (that infeasibility is the whole
+        # reason this schedule exists) and Pallas scratch shapes are
+        # VMEM/SMEM-only — it rides as an extra ANY-space output the
+        # caller discards
+        out_shapes.append(jax.ShapeDtypeStruct(
+            (d_world, nlx, cap, h), jnp.float32))
+        out_specs.append(any_spec)
     in_specs += [any_spec] * 5
     inputs += [x_send, w_up, b_up, w_down, b_down]
 
@@ -1006,27 +1453,33 @@ def _fused_shard(send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
         if fuse_combine:
             out_ = refs[i0]
             i0 += 1
+        acc_hbm_ = None
+        if schedule == "rowwin":
+            acc_hbm_ = refs[i0]
+            i0 += 1
         xs, wup, wdn, acc_, yv_, bup, bdn = refs[i0:i0 + 7]
         i0 += 7
         ys = ws = ov = hid = None
         if fuse_combine:
             ys, ws, ov = refs[i0:i0 + 3]
             i0 += 3
-        if schedule != "stream":
+        if schedule in ("resident", "batched"):
             hid = refs[i0]
             i0 += 1
         unified(send_cnt_, recv_cnt_, src_order_, recv_pos_, w_sorted_,
-                *xw, x_recv_, y_back_, y_stage_, out_,
+                *xw, x_recv_, y_back_, y_stage_, out_, acc_hbm_,
                 xs, wup, wdn, acc_, yv_, bup, bdn, ys, ws, ov, hid,
                 *refs[i0:])
 
-    # streaming schedule: wdn holds [bi, h] row chunks, acc/yv full-width
-    # row tiles.  resident/batched schedules: wdn holds [i, bh] COLUMN
-    # chunks, acc/yv are [cm, bh] output blocks, and the activated hidden
+    # streaming/rowwin schedules: wdn holds [bi, h] row chunks, acc/yv
+    # full-width row tiles (for rowwin bi IS the K-window and the
+    # cross-window acc state spills to the HBM accumulator above).
+    # resident/batched schedules: wdn holds [i, bh] COLUMN chunks,
+    # acc/yv are [cm, bh] output blocks, and the activated hidden
     # lives in the chunk-major hid slab (sized for one source per-source,
     # for all remote sources when batched).
     n_i_chunks = i_dim // bi
-    two_pass = schedule != "stream"
+    two_pass = schedule in ("resident", "batched")
     scratch = [
         pltpu.VMEM((cm, h), x_send.dtype),        # xs
         pltpu.VMEM((2, h, 2 * bi if gated else bi),
@@ -1081,6 +1534,8 @@ def _fused_shard(send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
         ),
         interpret=interp,
     )(*inputs)
+    if schedule == "rowwin":
+        results = results[:-1]  # drop the HBM accumulator scratch
     if fuse_combine:
         _, y_sorted, _, out = results
         return out, y_sorted

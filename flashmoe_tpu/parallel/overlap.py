@@ -197,15 +197,21 @@ def overlap_bound(cfg: MoEConfig, d: int, gen: str = "v5e", *,
              per_source — step 0 computes the own slab while remote
                slabs fly, step s>=1 waits slab s:
                T = max(C, t_x + C/d) + tail;
-             batched — the own slab (C/d) is the only compute that can
-               hide arrivals; the remaining (d-1)/d of C runs
-               expert-major after the last arrival:
+             batched / rowwin — the own slab (C/d) is the only compute
+               that can hide arrivals; the remaining (d-1)/d of C runs
+               after the last arrival (expert-major with VMEM-resident
+               hidden for batched, K-windowed with the HBM accumulator
+               for rowwin):
                T = max(C/d, t_x) + (d-1)/d * C + tail.
       tail   the last returns can only start after their compute
              finishes: per_source — the LAST SLAB's rows, t_x/(d-1);
              batched — the LAST EXPERT's rows (returns issue per expert
              after its pass 2), t_x/nlx, which is the coarser wait
-             whenever nlx < d-1.
+             whenever nlx < d-1; rowwin — the last WINDOW finishes each
+             row tile and returns it immediately, so only the final
+             row tile's rows trail: t_x/(nlx * n_row_tiles), the
+             finest return granularity of the batched-pass schedules
+             (geometry from ``fused.schedule_table``).
       OE     (C + 2*t_x) / T  — the operational metric's numerator is
              the serialized sum of the compute-only leg and BOTH
              all-to-alls (x out, y back).
@@ -240,6 +246,17 @@ def overlap_bound(cfg: MoEConfig, d: int, gen: str = "v5e", *,
     nlx = max(cfg.num_experts // d, 1)
     if schedule == "batched":
         tail = t_x / nlx
+        t_over = max(c_s / d, t_x) + (d - 1) / d * c_s + tail
+        compute_bound = c_s / d >= t_x
+    elif schedule == "rowwin":
+        # batched-pass makespan with per-row-tile return granularity:
+        # the last K-window finishes (and returns) one row tile at a
+        # time, so only the final tile's rows trail the compute
+        from flashmoe_tpu.parallel.fused import schedule_table
+
+        n_row_tiles = schedule_table(cfg, d, fuse_combine=fuse_combine,
+                                     schedule="rowwin")["n_row_tiles"]
+        tail = t_x / max(nlx * n_row_tiles, 1)
         t_over = max(c_s / d, t_x) + (d - 1) / d * c_s + tail
         compute_bound = c_s / d >= t_x
     else:

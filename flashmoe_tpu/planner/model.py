@@ -58,6 +58,10 @@ ranks, uniform routing):
                    T = max(chip, t_x + chip/d) + t_x/(d-1)
                  arrival-batched:
                    T = max(chip/d, t_x) + (d-1)/d * chip + t_x/nLx
+                 row-windowed (rowwin): the batched makespan with the
+                 finer per-row-tile return tail
+                   T = max(chip/d, t_x) + (d-1)/d * chip
+                       + t_x/(nLx * n_row_tiles)
                  where t_x is the one-direction egress serialization.
 
 Every path the framework can execute is a row; rows the configuration
@@ -85,6 +89,7 @@ BACKEND_OF = {
     "fused[batched]": "fused",
     "fused[resident]": "fused",
     "fused[stream]": "fused",
+    "fused[rowwin]": "fused",
     "fused_combine": "fused",
     # single-chip paths (d == 1): ops/moe.py dispatch, not an ep backend
     "xla": "local",
@@ -294,7 +299,7 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
         rows.sort(key=lambda r: (not r.feasible, r.total_ms))
         return rows
 
-    from flashmoe_tpu.parallel.fused import schedule_metadata
+    from flashmoe_tpu.parallel.fused import schedule_table
 
     def one_leg(slab, kind):
         return a2a_leg_ms(slab, kind, d=d, gen=gen, slices=slices,
@@ -347,7 +352,13 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
             "uniform-routing expectation; skew moves more" + wire_note)
 
     # --- fused RDMA: one row per FFN schedule -------------------------
-    meta = schedule_metadata(cfg, d)
+    meta = schedule_table(cfg, d)
+    # rowwin geometry resolved ONCE (its tile search + tuning lookup is
+    # the priciest resolution); reused by the fused[rowwin] row and a
+    # rowwin-resolved fused_combine row alike
+    nrt_rowwin = (meta if meta["priced"] == "rowwin"
+                  else schedule_table(cfg, d,
+                                      schedule="rowwin"))["n_row_tiles"]
     nlx = max(cfg.num_experts // d, 1)
     # the fused kernel RDMAs 32-padded slabs (analysis._geom pricing)
     pslab = slab_bytes(cfg, d, padded=True)
@@ -358,20 +369,37 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
         chip = max(compute_ms, cost.total_bytes / hbm_bs * 1e3)
         if sched == "batched":
             return (max(chip / d, t_x) + (d - 1) / d * chip + t_x / nlx)
+        if sched == "rowwin":
+            # batched-pass makespan; the last K-window returns row tiles
+            # as it finishes them, so only the final tile's rows trail
+            return (max(chip / d, t_x) + (d - 1) / d * chip
+                    + t_x / max(nlx * nrt_rowwin, 1))
         return max(chip, t_x + chip / d) + t_x / max(d - 1, 1)
 
-    def fused_why_out():
+    def fused_why_out(sched=None):
         if wire_on:
             # the in-kernel RDMA moves raw slabs; config.py rejects the
             # combination outright, so the planner must never pick it
             return "wire-dtype compression is XLA-transport only"
-        return ("fused RDMA is intra-slice only" if slices > 1
-                else "VMEM budget exceeded")
+        if slices > 1:
+            return "fused RDMA is intra-slice only"
+        if sched == "rowwin":
+            # the one schedule whose VMEM footprint is capacity- and
+            # width-independent: infeasibility means even the minimum
+            # (row tile, K-window) pair cannot fit
+            return ("rowwin infeasible: no (row tile, K-window) pair "
+                    "fits the window double-buffer + accumulator "
+                    "VMEM budget")
+        if sched in ("batched", "resident"):
+            return (f"{sched} infeasible: the weights-once hidden slab "
+                    f"exceeds the VMEM budget (rowwin/stream remain)")
+        return "VMEM budget exceeded"
 
-    for sched in ("batched", "resident", "stream"):
+    for sched in ("batched", "resident", "stream", "rowwin"):
         cost = path_costs(cfg, "fused", d_world=d, schedule=sched)
         ok = meta["feasible"][sched] and slices == 1 and not wire_on
-        note = "in-kernel arrival overlap" if ok else fused_why_out()
+        note = ("in-kernel arrival overlap" if ok
+                else fused_why_out(sched))
         mk(f"fused[{sched}]", cost, 2 * t_x, 0.0,
            total_ms=fused_total(cost, sched), schedule=sched,
            feasible=ok, note=note)
@@ -383,7 +411,7 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
     mk("fused_combine", cost, 2 * t_x, 0.0,
        total_ms=fused_total(cost, sched), schedule=sched, feasible=ok,
        note=("sorted per-row returns; combine off the critical path"
-             if ok else fused_why_out()))
+             if ok else fused_why_out(sched)))
 
     rows.sort(key=lambda r: (not r.feasible, r.total_ms))
     return rows

@@ -19,8 +19,12 @@ The policy (VERDICT r3 #4 "measured-winner", applied framework-wide):
      postmortem can always answer "why did this run take this path".
 
 Measurements are keyed at path-family granularity ('fused', not
-'fused[batched]') because that is what a wall-clock measurement of the
-kernel observes — the kernel resolves its own schedule.
+'fused[batched]' / 'fused[rowwin]') because that is what a wall-clock
+measurement of the kernel observes — the kernel resolves its own
+schedule (``MoEConfig.fused_schedule`` pins it when a measurement must
+target one schedule; the per-TILE geometry inside the rowwin schedule
+is measured separately, as ``fused_tiles`` tuning entries swept by
+``bench.py --tiles`` / ``tune_sweep.py --stage tiles``).
 """
 
 from __future__ import annotations

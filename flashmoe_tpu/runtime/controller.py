@@ -208,10 +208,18 @@ class RuntimeController:
     :attr:`cfg_overrides` when ``action.needs_rebuild``).
 
     ``n_devices``: the device count the placement math targets (the EP
-    width; defaults to ``cfg.ep`` or 1).  ``rates_fn``: optional
-    callable returning per-device throughput (e.g. a re-run of the
-    bootstrap probe, or the chaos drill's simulated rates); None prices
-    devices uniformly.  ``d`` / ``gen``: the planner width/generation
+    width; defaults to ``cfg.ep`` or 1).  ``rates_fn``: callable
+    returning per-device throughput; the DEFAULT (None) is a live
+    re-probe through :func:`flashmoe_tpu.runtime.throughput.
+    device_rates` — each slow-device trigger re-measures every device's
+    expert throughput (fresh, cache-dropped) so the Decider's
+    rate-proportional assignment sees today's silicon, not
+    bootstrap's (ROADMAP item 3 follow-up; the chaos drill exercises
+    this exact path through the ``probe_rates`` injection seam).  Pass
+    an explicit callable to override, or one returning None to price
+    devices uniformly; a probe that raises degrades to uniform rates
+    with a ``controller.probe_error`` decision rather than blocking the
+    step boundary.  ``d`` / ``gen``: the planner width/generation
     morphs re-select at (default ``n_devices`` / the trace-time pin).
     """
 
@@ -223,7 +231,8 @@ class RuntimeController:
         self.cfg = cfg
         self.ccfg = ccfg or ControllerConfig()
         self.metrics = metrics if metrics is not None else _global
-        self.rates_fn = rates_fn
+        self.rates_fn = (rates_fn if rates_fn is not None
+                         else self._probe_rates)
         self.n_devices = int(n_devices or max(cfg.ep, 1))
         if cfg.num_experts % self.n_devices:
             raise ValueError(
@@ -443,6 +452,17 @@ class RuntimeController:
             reason=plan.reason)
         return MorphAction(dict(plan.overrides), "skew", plan.reason)
 
+    def _probe_rates(self):
+        """Default ``rates_fn``: live per-device throughput re-probe
+        (:func:`flashmoe_tpu.runtime.throughput.device_rates`,
+        ``fresh=True`` so a RE-trigger measures today's silicon).
+        Consulted only when a slow-device re-placement is actually
+        being planned — never in the step loop."""
+        from flashmoe_tpu.runtime import throughput
+
+        return throughput.device_rates(self._current_cfg(),
+                                       self.n_devices, fresh=True)
+
     def _plan_replace(self, step: int):
         from flashmoe_tpu.parallel.decider import (
             placement_permutation, rebalance_placement,
@@ -450,8 +470,16 @@ class RuntimeController:
 
         if self.load_ema is None or float(self.load_ema.sum()) <= 0:
             return None  # no load signal yet: nothing to re-place on
-        rates = (np.asarray(self.rates_fn(), dtype=np.float64)
-                 if self.rates_fn is not None else None)
+        rates = None
+        if self.rates_fn is not None:
+            try:
+                r = self.rates_fn()
+            except Exception as e:  # noqa: BLE001 — degrade, don't block
+                self._decide("controller.probe_error", step=step,
+                             reason=f"{type(e).__name__}: {str(e)[:200]}")
+                r = None
+            if r is not None:
+                rates = np.asarray(r, dtype=np.float64)
         placement = rebalance_placement(
             self.load_ema, self.n_devices, self.cfg, rates=rates,
             replicate=self.ccfg.replicate, cold_eps=self.ccfg.cold_eps)

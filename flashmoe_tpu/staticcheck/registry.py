@@ -179,6 +179,15 @@ SELECTOR_FIELDS = {
                     "graph is identical for every value; invalid names "
                     "rejected at config time, decode-mode selection "
                     "covered by tests/test_serving.py",
+    "fused_schedule": "fused-kernel FFN-schedule selector (None = auto "
+                      "/ 'batched' / 'resident' / 'stream' / 'rowwin'); "
+                      "every value computes the same function on a "
+                      "different execution geometry — invalid names "
+                      "rejected at config time, VMEM-infeasible forced "
+                      "schedules raise at launch, cross-schedule "
+                      "bit-identity asserted by tests/test_fused.py and "
+                      "the planner's per-schedule rows by "
+                      "tests/test_planner.py",
 }
 
 #: model/job *shape* fields: changing one changes the problem, not a

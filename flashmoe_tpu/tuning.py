@@ -20,8 +20,23 @@ Knobs per kernel family:
   fused_ep       cm (slab row tile), bi_cap (streamed-weight chunk cap),
                  weights_resident (bool: per-source two-pass schedule),
                  batched (bool: arrival-batched expert-major schedule —
-                 overrides the d>=3 default either way) of the fused
-                 RDMA kernel (``parallel/fused.py:_fused_schedule``).
+                 overrides the d>=3 default either way), rowwin (bool:
+                 row-windowed K-streamed schedule — overrides the
+                 stream-vs-rowwin byte heuristic either way) of the
+                 fused RDMA kernel (``parallel/fused.py:
+                 _fused_schedule``).
+  fused_tiles    cm (row tile), kw (K-window width) of the rowwin
+                 schedule's IO-aware tile chooser
+                 (``parallel/fused.py:_rowwin_tiles``) — a measured
+                 entry overrides the analytic minimum-HBM-traffic pick
+                 when it still divides the shapes; the VMEM budget gate
+                 is never overridable.  Swept by ``tune_sweep.py
+                 --stage tiles`` / ``bench.py --tiles``.
+
+Committed tables must pass :func:`validate_entries` — a malformed table
+fails ``tests/test_tuning.py`` in CI instead of being silently ignored
+at trace time (the runtime ``_load`` stays lenient so a corrupt file on
+a production host degrades to heuristics, never to a crash).
 """
 
 from __future__ import annotations
@@ -125,6 +140,127 @@ def measured_path_latencies(gen: str | None = None, **shape) -> dict:
             if path not in best or len(m) > best[path][0]:
                 best[path] = (len(m), float(ms))
     return {p: ms for p, (_, ms) in best.items()}
+
+
+#: known kernel families and the knob keys their ``set`` dict may carry
+#: (``path_latency`` entries carry the timing in ``measured_ms`` and the
+#: path identity inside ``match`` instead of a ``set``)
+ENTRY_SCHEMA = {
+    "capacity_ffn": {"block_m", "block_i"},
+    "fused_ep": {"cm", "bi_cap", "weights_resident", "batched",
+                 "rowwin"},
+    "fused_tiles": {"cm", "kw"},
+    "path_latency": set(),
+}
+
+#: keys an entry ``match`` dict may constrain (shape facts + the
+#: measurement-identity knobs the lookups compare strictly)
+MATCH_KEYS = {"h", "i", "e", "k", "s", "d", "cap", "dtype", "path",
+              "wire", "wire_combine", "chunks"}
+
+
+def validate_entries(doc) -> list[str]:
+    """Schema-validate a tuning table document (the parsed JSON of a
+    ``tuning_data/<gen>.json`` file).  Returns a list of problem
+    strings, empty when the table is well-formed.  CI runs this over
+    every committed table (``tests/test_tuning.py``) so a malformed
+    entry — unknown kernel, misspelled knob, non-numeric measurement —
+    fails review instead of being silently ignored by the lenient
+    runtime loader."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"table must be a JSON object, got {type(doc).__name__}"]
+    entries = doc.get("entries")
+    if not isinstance(entries, list):
+        return ["table must carry an 'entries' list"]
+    if "generation" in doc and not isinstance(doc["generation"], str):
+        problems.append("'generation' must be a string")
+    for n, ent in enumerate(entries):
+        where = f"entries[{n}]"
+        if not isinstance(ent, dict):
+            problems.append(f"{where}: entry must be an object")
+            continue
+        kernel = ent.get("kernel")
+        if kernel not in ENTRY_SCHEMA:
+            problems.append(
+                f"{where}: unknown kernel {kernel!r}; known: "
+                f"{sorted(ENTRY_SCHEMA)}")
+            continue
+        match = ent.get("match", {})
+        if not isinstance(match, dict):
+            problems.append(f"{where}: 'match' must be an object")
+            match = {}
+        for mk, mv in match.items():
+            if mk not in MATCH_KEYS:
+                problems.append(
+                    f"{where}: unknown match key {mk!r}; known: "
+                    f"{sorted(MATCH_KEYS)}")
+            elif mk in ("dtype", "path", "wire", "wire_combine"):
+                if not isinstance(mv, str):
+                    problems.append(
+                        f"{where}: match.{mk} must be a string, got "
+                        f"{mv!r}")
+            elif not isinstance(mv, int) or isinstance(mv, bool) \
+                    or mv < 1:
+                problems.append(
+                    f"{where}: match.{mk} must be a positive int, got "
+                    f"{mv!r}")
+        ms = ent.get("measured_ms",
+                     ent.get("set", {}).get("measured_ms")
+                     if isinstance(ent.get("set"), dict) else None)
+        if kernel == "path_latency":
+            if "path" not in match:
+                problems.append(
+                    f"{where}: path_latency needs match.path")
+            if not isinstance(ms, (int, float)) or ms <= 0:
+                problems.append(
+                    f"{where}: path_latency needs a positive "
+                    f"measured_ms, got {ms!r}")
+            continue
+        st = ent.get("set")
+        if not isinstance(st, dict) or not st:
+            problems.append(
+                f"{where}: {kernel} needs a non-empty 'set' object")
+            continue
+        allowed = ENTRY_SCHEMA[kernel]
+        for sk, sv in st.items():
+            if sk not in allowed:
+                problems.append(
+                    f"{where}: unknown {kernel} knob {sk!r}; known: "
+                    f"{sorted(allowed)}")
+            elif sk in ("weights_resident", "batched", "rowwin"):
+                if not isinstance(sv, bool):
+                    problems.append(
+                        f"{where}: set.{sk} must be a bool, got {sv!r}")
+            elif not isinstance(sv, int) or isinstance(sv, bool) \
+                    or sv < 1:
+                problems.append(
+                    f"{where}: set.{sk} must be a positive int, got "
+                    f"{sv!r}")
+        if kernel == "fused_tiles" and not {"cm", "kw"} <= set(st):
+            problems.append(
+                f"{where}: fused_tiles must set both cm and kw (a "
+                f"half-specified tile pair cannot override the "
+                f"IO-aware chooser)")
+        if "measured_ms" in ent and (
+                not isinstance(ent["measured_ms"], (int, float))
+                or ent["measured_ms"] <= 0):
+            problems.append(
+                f"{where}: measured_ms must be a positive number, got "
+                f"{ent['measured_ms']!r}")
+    return problems
+
+
+def validate_table(path: str) -> list[str]:
+    """:func:`validate_entries` over a table file; unreadable/unparsable
+    files are themselves a problem (CI-facing — the runtime loader's
+    lenient warning stance is unchanged)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable table {path}: {e}"]
+    return validate_entries(doc)
 
 
 def save_entries(gen: str, entries: list, path: str | None = None) -> str:

@@ -59,6 +59,9 @@ DECISION_NAMES: dict[str, str] = {
         "a restart cleared path demotions earned on the dead topology",
     "controller.morph":
         "the self-healing controller re-selected the MoE path mid-job",
+    "controller.probe_error":
+        "the slow-trigger throughput re-probe failed; re-placement "
+        "degraded to uniform rates",
     "controller.replace":
         "the self-healing controller re-placed/replicated experts "
         "mid-job",

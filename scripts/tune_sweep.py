@@ -8,13 +8,29 @@ Sweeps, on the real chip:
     kernel at each bench shape;
   * fused_ep     — (cm, bi_cap) of the fused RDMA kernel's compute loop
     (swept on a 1-rank mesh: transfer legs vanish, the streamed-weight /
-    row-tile geometry being tuned is identical).
+    row-tile geometry being tuned is identical);
+  * fused_tiles  — (cm row tile, kw K-window) of the row-windowed
+    schedule's IO-aware chooser (``--stage tiles``; the rowwin schedule
+    is pinned via ``MoEConfig.fused_schedule`` and each candidate pair
+    forced through a throwaway ``fused_tiles`` table, same 1-rank-mesh
+    rationale — the window/accumulator traffic being tuned is
+    transfer-free).
 
 Winners are written to ``flashmoe_tpu/tuning_data/<gen>.json`` (one
 ``{"kernel", "match", "set", "measured_ms"}`` entry per shape), which
 ships with the package and is consulted at trace time.
 
+Probe contract (the bench.py fail-fast contract, extended here per
+ISSUE 12): before any non-``--interpret`` sweep the backend is probed
+in an expendable subprocess with the same
+``FLASHMOE_PROBE_ATTEMPTS`` / ``FLASHMOE_PROBE_TIMEOUT`` /
+``FLASHMOE_PROBE_BUDGET`` bounds; a backend that never answers yields
+ONE well-formed ``skipped: true`` JSON record and exit code 0
+(machine-distinguishable from an error, rc 2), instead of wedging the
+driver the way BENCH_r0* rounds did.
+
 Usage: python scripts/tune_sweep.py [--trials 3] [--chain 8] [--dry]
+                                    [--stage all|capacity|fused|tiles]
 Prints one JSON line per (kernel, shape, candidate) measurement.
 """
 
@@ -179,7 +195,77 @@ def sweep_fused(shape, dtype, trials, chain, interpret=False):
             "set": winner, "measured_ms": round(best[0] * 1e3, 4)}
 
 
-def main():
+def sweep_tiles(shape, dtype, trials, chain, interpret=False):
+    """Measure (cm, kw) candidates of the row-windowed schedule's
+    IO-aware tile chooser at ``shape`` and return the winning
+    ``fused_tiles`` entry, or None when the shape has no feasible
+    rowwin geometry / fewer than two candidates worth ranking.  Each
+    candidate pair is forced through a throwaway table +
+    ``fused_schedule='rowwin'`` so the measurement times exactly the
+    geometry the committed entry would select."""
+    from flashmoe_tpu.parallel.fused import (
+        fused_ep_moe_layer, rowwin_sweep_candidates,
+    )
+    from flashmoe_tpu.parallel.mesh import make_mesh
+
+    h, i, e = shape["h"], shape["i"], shape["e"]
+    cfg = MoEConfig(num_experts=e, expert_top_k=2, hidden_size=h,
+                    intermediate_size=i, sequence_len=2048,
+                    capacity_factor=1.0, drop_tokens=True, ep=1,
+                    fused_schedule="rowwin",
+                    dtype=dtype, param_dtype=jnp.float32)
+    cap_pad = -(-cfg.capacity_for(cfg.tokens) // 32) * 32
+    dt = jnp.dtype(dtype).itemsize
+    # the kernel's own grid, per-kw best-cm (see fused.py) — shared
+    # with bench.py --tiles so the enumerations cannot drift
+    cands = rowwin_sweep_candidates(cap_pad, h, i, dt, cfg.gated_ffn,
+                                    False, cfg.expert_top_k)
+    if len(cands) < 2:
+        print(json.dumps({"kernel": "fused_tiles", "h": h, "i": i,
+                          "skipped": True,
+                          "reason": f"{len(cands)} feasible (cm, kw) "
+                                    f"candidates at this shape"}),
+              flush=True)
+        return None
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    params = jax.tree_util.tree_map(lambda p: p.astype(dtype), params)
+    x = jax.random.normal(jax.random.PRNGKey(1), (cfg.tokens, h), dtype)
+    mesh = make_mesh(cfg, dp=1, devices=jax.devices()[:1])
+    tmp = "/tmp/flashmoe_tune_tiles_candidate.json"
+    best = None
+    try:
+        for cm, kw in cands:
+            with open(tmp, "w") as f:
+                json.dump({"entries": [{
+                    "kernel": "fused_tiles",
+                    "match": {"h": h, "i": i,
+                              "dtype": jnp.dtype(dtype).name},
+                    "set": {"cm": cm, "kw": kw},
+                }]}, f)
+            os.environ["FLASHMOE_TUNING_FILE"] = tmp
+            tuning._load.cache_clear()
+
+            def fn(xx):
+                return fused_ep_moe_layer(
+                    params, xx, cfg, mesh,
+                    interpret=interpret).out.astype(jnp.float32).sum()
+
+            t = _chain_time(fn, (x,), trials, chain)
+            row = {"kernel": "fused_tiles", "h": h, "i": i, "cm": cm,
+                   "kw": kw, "schedule": "rowwin",
+                   "ms": round(t * 1e3, 4)}
+            print(json.dumps(row), flush=True)
+            if best is None or t < best[0]:
+                best = (t, {"cm": cm, "kw": kw})
+    finally:
+        os.environ.pop("FLASHMOE_TUNING_FILE", None)
+        tuning._load.cache_clear()
+    return {"kernel": "fused_tiles",
+            "match": {"h": h, "i": i, "dtype": jnp.dtype(dtype).name},
+            "set": best[1], "measured_ms": round(best[0] * 1e3, 4)}
+
+
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--chain", type=int, default=8)
@@ -188,16 +274,69 @@ def main():
     ap.add_argument("--interpret", action="store_true",
                     help="interpret-mode structural dry run (timings "
                          "meaningless; implies --dry)")
-    args = ap.parse_args()
+    ap.add_argument("--stage", default="all",
+                    choices=["all", "capacity", "fused", "tiles"],
+                    help="which kernel family to sweep (tiles = the "
+                         "rowwin schedule's fused_tiles (cm, kw) pairs)")
+    ap.add_argument("--probe-budget", type=int,
+                    default=int(os.environ.get("FLASHMOE_PROBE_BUDGET",
+                                               300)),
+                    help="how long to keep retrying the backend probe "
+                         "(s) before giving up")
+    ap.add_argument("--probe-attempts", type=int,
+                    default=int(os.environ.get("FLASHMOE_PROBE_ATTEMPTS",
+                                               0)),
+                    help="max probe attempts (0 = budget-bounded only); "
+                         "a probe that never answers yields a "
+                         "well-formed skipped:true record with rc 0")
+    ap.add_argument("--probe-timeout", type=int,
+                    default=int(os.environ.get("FLASHMOE_PROBE_TIMEOUT",
+                                               90)),
+                    help="per-attempt probe timeout (s)")
+    args = ap.parse_args(argv)
     if args.interpret:
         args.dry = True
+
+    if not args.interpret:
+        # the bench.py probe contract, shared verbatim: an expendable
+        # subprocess answers "is the backend alive" with a hard bound,
+        # and a tunnel that never answers becomes a machine-readable
+        # skip instead of a wedged sweep
+        import bench as _bench
+
+        ok, info, hung = _bench._probe_backend_retry(
+            args.probe_budget, each_s=max(args.probe_timeout, 10),
+            max_attempts=args.probe_attempts)
+        if not ok:
+            if hung:
+                print(json.dumps({
+                    "metric": f"tune_sweep[{args.stage}]",
+                    "value": None, "unit": "ms",
+                    "skipped": True, "reason": info,
+                }), flush=True)
+                sys.exit(0)
+            print(json.dumps({
+                "metric": f"tune_sweep[{args.stage}]",
+                "value": -1, "unit": "ms", "error": info,
+            }), flush=True)
+            sys.exit(2)
+        print(f"# backend up: {info}", file=sys.stderr, flush=True)
+
     dtype = jnp.bfloat16
     entries = []
     for shape in SHAPES:
-        entries.append(sweep_capacity(shape, dtype, args.trials,
-                                      args.chain))
-        entries.append(sweep_fused(shape, dtype, args.trials, args.chain,
-                                   interpret=args.interpret))
+        if args.stage in ("all", "capacity"):
+            entries.append(sweep_capacity(shape, dtype, args.trials,
+                                          args.chain))
+        if args.stage in ("all", "fused"):
+            entries.append(sweep_fused(shape, dtype, args.trials,
+                                       args.chain,
+                                       interpret=args.interpret))
+        if args.stage in ("all", "tiles"):
+            ent = sweep_tiles(shape, dtype, args.trials, args.chain,
+                              interpret=args.interpret)
+            if ent is not None:
+                entries.append(ent)
     gen = tuning.generation()
     if args.dry:
         print(json.dumps({"generation": gen, "entries": entries}))

@@ -214,6 +214,138 @@ def test_cli_serve_flag_exclusivity(monkeypatch, capsys):
         capsys.readouterr()
 
 
+def test_cli_tiles_flag_exclusivity(monkeypatch, capsys):
+    """--tiles fail-fasts on knobs/modes the rowwin tile sweep would
+    silently ignore (the --profile/--ckpt/--serve contract)."""
+    import sys as _sys
+
+    import bench
+
+    cases = [
+        ["bench.py", "--tiles", "--wire-dtype", "e4m3"],
+        ["bench.py", "--tiles", "--a2a-chunks", "2"],
+        ["bench.py", "--tiles", "--sweep", "ep"],
+        ["bench.py", "--tiles", "--overlap", "4"],
+        ["bench.py", "--tiles", "--ckpt"],
+        ["bench.py", "--tiles", "--serve"],
+        ["bench.py", "--tiles", "--profile"],
+    ]
+    for argv in cases:
+        monkeypatch.setattr(_sys, "argv", argv)
+        with pytest.raises(SystemExit) as e:
+            bench.main()
+        assert e.value.code == 2, argv
+        capsys.readouterr()
+
+
+def test_cli_tiles_emits_skipped_record_when_probe_hangs(monkeypatch,
+                                                         capsys):
+    """ISSUE 12 satellite: the --tiles stage inherits the bench probe
+    fail-fast contract — a backend that never answers yields ONE
+    well-formed skipped:true record under the TILES metric (so the
+    driver files it against the right measurement) and rc 0."""
+    import sys as _sys
+
+    import bench
+
+    monkeypatch.setattr(
+        bench, "_probe_backend_retry",
+        lambda budget_s, each_s=90, max_attempts=0:
+        (False, "backend probe hung >10s after 2 attempts / 20s", True))
+    monkeypatch.setattr(_sys, "argv",
+                        ["bench.py", "--tiles", "--config", "mixtral",
+                         "--probe-attempts", "2"])
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "fused_tiles_ms[mixtral]"
+    assert rec["skipped"] is True and rec["value"] is None
+    assert "hung" in rec["reason"]
+
+
+def _load_tune_sweep():
+    import importlib.util as ilu
+    import os
+
+    spec = ilu.spec_from_file_location(
+        "tune_sweep", os.path.join(os.path.dirname(__file__), "..",
+                                   "scripts", "tune_sweep.py"))
+    mod = ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_tune_sweep_tiles_probe_contract(monkeypatch, capsys):
+    """tune_sweep.py shares bench's probe contract verbatim (ISSUE 12
+    satellite): a hung probe yields a skipped:true record + rc 0, a
+    dead-but-answering backend an error record + rc 2 — bounded by the
+    same FLASHMOE_PROBE_ATTEMPTS/TIMEOUT knobs."""
+    import bench
+
+    ts = _load_tune_sweep()
+    monkeypatch.setattr(
+        bench, "_probe_backend_retry",
+        lambda budget_s, each_s=90, max_attempts=0:
+        (False, "backend probe hung >30s after 1 attempts / 30s", True))
+    with pytest.raises(SystemExit) as e:
+        ts.main(["--stage", "tiles", "--probe-attempts", "1"])
+    assert e.value.code == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "tune_sweep[tiles]"
+    assert rec["skipped"] is True and "hung" in rec["reason"]
+
+    monkeypatch.setattr(
+        bench, "_probe_backend_retry",
+        lambda budget_s, each_s=90, max_attempts=0:
+        (False, "backend probe rc=1: boom", False))
+    with pytest.raises(SystemExit) as e:
+        ts.main(["--stage", "tiles", "--probe-attempts", "1"])
+    assert e.value.code == 2
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == -1 and "boom" in rec["error"]
+
+
+def test_tune_sweep_tiles_candidates_are_feasible():
+    """The tiles stages measure THE kernel's own candidate grid
+    (fused.rowwin_sweep_candidates — code-review finding: the sweeps
+    once hand-copied a narrower cm list that silently diverged from
+    the chooser): every measured pair divides the shapes, fits the
+    VMEM window budget, covers every feasible K-window at its widest
+    feasible row tile — including the pair the analytic chooser picks
+    — and the wide (mixtral-FFN) shape offers at least two candidates,
+    so the sweep cannot be vacuous at the shape the schedule exists
+    for."""
+    import jax.numpy as jnp
+
+    from flashmoe_tpu.config import MoEConfig
+    from flashmoe_tpu.parallel.fused import (
+        _rowwin_budget_ok, _rowwin_tiles, rowwin_sweep_candidates,
+        rowwin_tile_candidates,
+    )
+
+    h, i, e = 4096, 14336, 8
+    cfg = MoEConfig(num_experts=e, expert_top_k=2, hidden_size=h,
+                    intermediate_size=i, sequence_len=2048,
+                    capacity_factor=1.0, drop_tokens=True, ep=1,
+                    dtype=jnp.bfloat16)
+    cap_pad = -(-cfg.capacity_for(cfg.tokens) // 32) * 32
+    full = rowwin_tile_candidates(cap_pad, h, i, 2, False, False, 2)
+    cands = rowwin_sweep_candidates(cap_pad, h, i, 2, False, False, 2)
+    assert len(cands) >= 2
+    assert set(cands) <= set(full)
+    assert {kw for _, kw in cands} == {kw for _, kw in full}
+    for cm, kw in cands:
+        assert cap_pad % cm == 0 and i % kw == 0
+        assert _rowwin_budget_ok(cap_pad, h, i, 2, False, cm, kw,
+                                 False, 2)
+        # widest feasible row tile for this kw
+        assert cm == max(c for c, k2 in full if k2 == kw)
+    # the analytic chooser's pick is itself a measured candidate
+    assert _rowwin_tiles(cap_pad, h, i, 2, None, False, False,
+                         2) in cands
+
+
 def test_cli_emits_json_error_fast_when_backend_dead():
     """With the backend guaranteed dead (bogus platform — the probe
     subprocess fails deterministically, unlike relying on probe-timeout

@@ -894,6 +894,12 @@ def test_drill_slow_device_triggers_replacement():
     assert "controller.replace" in names
     # the hot expert was replicated onto a dead slot
     assert r.evidence["action"]["replicas"]
+    # ISSUE 12 satellite: the re-placement consumed the controller's
+    # DEFAULT rates_fn — the live per-device throughput re-probe
+    # (runtime/throughput.device_rates, degraded through the
+    # probe_rates chaos seam) — so the decision record carries the
+    # probed 0.25x slow-chip reading, not drill-injected rates
+    assert r.evidence["action"]["rates"] == [0.25, 1.0, 1.0, 1.0]
     # the SLO watchdog narrated degradation AND recovery
     assert "slo.breach" in names and "slo.recovered" in names
     # measured step time collapsed after the re-placement

@@ -177,6 +177,69 @@ def test_slow_trigger_plans_replacement_with_rates():
     assert rec["trigger"] == "slow"
 
 
+def test_default_rates_fn_is_live_probe_with_chaos_seam():
+    """ISSUE 12 satellite: a controller constructed WITHOUT rates_fn
+    must re-probe per-device throughput on the slow trigger
+    (runtime/throughput.device_rates).  The probe_rates chaos seam
+    supplies the degraded reading (what a genuinely slow chip would
+    hand the probe), and the resulting re-placement must consume it —
+    the decision record carries the probed vector."""
+    from flashmoe_tpu.chaos import inject
+    from flashmoe_tpu.runtime import throughput
+
+    inject.arm("probe_rates", rates=(0.25, 1.0, 1.0, 1.0))
+    try:
+        # the seam short-circuits before any backend work
+        rates = throughput.device_rates(_cfg(), 4)
+        assert list(rates) == [0.25, 1.0, 1.0, 1.0]
+        c, m = _ctrl(cfg=_cfg(expert_top_k=1),
+                     ccfg=ControllerConfig(
+                         debounce_steps=2, cooldown_steps=4,
+                         baseline_steps=2, ema_decay=0.5,
+                         enable_morph=False),
+                     n_devices=4)          # NO rates_fn: default probe
+        hot = {"moe_stats": [_stats([64, 0, 0, 0, 0, 0, 0, 0])]}
+        c.observe_step(0, 10.0, hot)
+        c.observe_step(1, 10.0, hot)
+        c.observe_step(2, 900.0, hot)
+        c.observe_step(3, 900.0, hot)
+        act = c.maybe_act(4)
+        assert isinstance(act, ReplaceAction)
+        # hot expert leaves the probed-slow device (slots 0..1)
+        assert act.perm.index(0) // 2 != 0
+        rec = m.last_decision("controller.replace")
+        assert rec["rates"] == [0.25, 1.0, 1.0, 1.0]
+    finally:
+        inject.disarm("probe_rates")
+
+
+def test_probe_failure_degrades_to_uniform_rates(monkeypatch):
+    """A raising probe must never block the step boundary: re-placement
+    degrades to uniform rates and records controller.probe_error."""
+    from flashmoe_tpu.runtime import throughput
+
+    def boom(*a, **kw):
+        raise RuntimeError("wedged tunnel")
+
+    monkeypatch.setattr(throughput, "device_rates", boom)
+    c, m = _ctrl(cfg=_cfg(expert_top_k=1),
+                 ccfg=ControllerConfig(
+                     debounce_steps=2, cooldown_steps=4,
+                     baseline_steps=2, ema_decay=0.5,
+                     enable_morph=False),
+                 n_devices=4)
+    hot = {"moe_stats": [_stats([64, 0, 0, 0, 0, 0, 0, 0])]}
+    c.observe_step(0, 10.0, hot)
+    c.observe_step(1, 10.0, hot)
+    c.observe_step(2, 900.0, hot)
+    c.observe_step(3, 900.0, hot)
+    act = c.maybe_act(4)
+    assert isinstance(act, ReplaceAction)  # uniform-rate rebalance
+    err = m.last_decision("controller.probe_error")
+    assert err is not None and "wedged" in err["reason"]
+    assert m.last_decision("controller.replace")["rates"] is None
+
+
 def test_replace_noop_when_layout_already_balanced():
     c, m = _ctrl(ccfg=ControllerConfig(
         debounce_steps=2, cooldown_steps=4, baseline_steps=2,

@@ -206,22 +206,71 @@ def test_xla_dispatch_bytes_match_model():
 
 def test_schedule_resolution_decision_table(monkeypatch):
     """The BASELINE decision table: which FFN schedule each bench config
-    resolves to at d=8, and the mixtral warning — its 14336-wide expert
-    hidden slab exceeds VMEM for every weights-once schedule, so the
-    fused path degrades to stream (40x the collective path's weight
-    traffic) and the framework's guidance is to stay collective there."""
+    resolves to at d=8.  Since ISSUE 12 the mixtral row is the
+    row-windowed schedule's reason to exist: its 14336-wide expert
+    hidden slab exceeds VMEM for every weights-once schedule (batched /
+    resident stay infeasible), but the window-major rowwin schedule
+    bounds weight traffic at exactly 2x the collective path — the
+    ACCEPTANCE CRITERION pin: <= 2.5x, vs the 40x the stream fallback
+    pays (the pre-rowwin verdict BASELINE.md's caveat reconciles)."""
     from flashmoe_tpu.analysis import _geom
+    from flashmoe_tpu.parallel.fused import schedule_table
 
     monkeypatch.delenv("FLASHMOE_FUSED_BATCHED", raising=False)
+    monkeypatch.delenv("FLASHMOE_FUSED_ROWWIN", raising=False)
     assert _geom(REF, 8)["schedule"] == "batched"
     assert _geom(BENCH_CONFIGS["deepseek"], 8)["schedule"] == "batched"
     assert _geom(BENCH_CONFIGS["weak_scaling_256"], 8)["schedule"] == \
         "batched"
     mix = _geom(BENCH_CONFIGS["mixtral"], 8)
-    assert mix["schedule"] == "stream"
+    assert mix["schedule"] == "rowwin"
+    t = schedule_table(BENCH_CONFIGS["mixtral"], 8)
+    assert not t["feasible"]["batched"] and not t["feasible"]["resident"]
+    assert t["feasible"]["rowwin"] and t["kw"] is not None
     fused = path_costs(BENCH_CONFIGS["mixtral"], "fused", d_world=8)
     coll = path_costs(BENCH_CONFIGS["mixtral"], "xla", d_world=8)
-    assert fused.weight_bytes > 20 * coll.weight_bytes
+    # the ISSUE 12 acceptance bar: modeled mixtral-at-ep=8 fused weight
+    # traffic under rowwin <= 2.5x the collective path's (exactly 2x:
+    # one K-windowed pass for the own slab, one for the remote batch)
+    assert fused.weight_bytes <= 2.5 * coll.weight_bytes
+    assert fused.weight_bytes == 2 * coll.weight_bytes
+    # the stream fallback's honest 40x stays exposed, not hidden
+    stream = path_costs(BENCH_CONFIGS["mixtral"], "fused", d_world=8,
+                        schedule="stream")
+    assert stream.weight_bytes > 20 * coll.weight_bytes
+
+
+def test_rowwin_prices_activation_restreaming(monkeypatch):
+    """The rowwin schedule's byte trade must be charged, not hidden:
+    weight bytes collapse to the 2-pass bound, while the activation
+    column grows by the per-window x re-reads AND the f32 partial-sum
+    round-trips at every interior window boundary — the term
+    BASELINE.md's round-5 caveat demanded before believing any
+    row-windowed rescue."""
+    from flashmoe_tpu.analysis import _geom
+
+    monkeypatch.delenv("FLASHMOE_FUSED_BATCHED", raising=False)
+    mix = BENCH_CONFIGS["mixtral"]
+    g = _geom(mix, 8, schedule="rowwin")
+    n_win = g["n_i_chunks"]
+    assert n_win > 1  # i=14336 can never be one VMEM window
+    rw = path_costs(mix, "fused", d_world=8, schedule="rowwin")
+    st = path_costs(mix, "fused", d_world=8, schedule="stream")
+    assert rw.weight_bytes < st.weight_bytes
+    assert rw.activation_bytes > st.activation_bytes
+    slots = 8 * (mix.num_experts // 8) * g["cap"]
+    # the accumulator term is exactly (n_win - 1) read+write f32 passes
+    acc_bytes = (n_win - 1) * slots * g["h"] * 8.0
+    base = path_costs(mix, "fused", d_world=8, schedule="batched")
+    # batched at the same window count would re-read x the same number
+    # of times (n_i_chunks differs though); assert the rowwin total
+    # includes the acc term by reconstruction instead
+    x_reads = slots * g["h"] * g["dt"] * n_win
+    gate = mix.tokens // 8 * g["h"] * g["dt"] + g["h"] * mix.num_experts * g["dt"]
+    y_stage = slots * g["h"] * g["dt"]
+    assert rw.activation_bytes == pytest.approx(
+        gate + x_reads + y_stage + acc_bytes)
+    assert base.flops == rw.flops  # a data-movement schedule, not math
 
 
 def test_candidate_table_renders():

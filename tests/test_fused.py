@@ -418,6 +418,213 @@ def test_fused_custom_src_order_any_permutation(devices):
     )
 
 
+def _force_tiles(monkeypatch, tmp_path, cm, kw, h=128):
+    """Pin the rowwin (cm, kw) pair through a throwaway fused_tiles
+    table (the mechanism tune_sweep/bench --tiles force candidates
+    with)."""
+    import json
+
+    from flashmoe_tpu import tuning
+
+    p = tmp_path / "tiles.json"
+    p.write_text(json.dumps({"generation": "test", "entries": [{
+        "kernel": "fused_tiles", "match": {"h": h},
+        "set": {"cm": cm, "kw": kw}}]}))
+    monkeypatch.setenv("FLASHMOE_TUNING_FILE", str(p))
+    tuning._load.cache_clear()
+
+
+# The interpret-mode DMA/semaphore emulation this file's kernel tests
+# need is absent in some jax versions (the suite's documented 8
+# pre-existing environment failures).  NEW kernel-launch tests skip on
+# that gap instead of adding to it; the schedule algebra stays gated by
+# the emulation test below, which needs no kernel.
+from jax.experimental.pallas import tpu as _pltpu  # noqa: E402
+
+requires_interpret = pytest.mark.skipif(
+    not hasattr(_pltpu, "InterpretParams"),
+    reason="TPU interpret mode unavailable in this jax (pre-existing "
+           "environment gap; see ROADMAP.md suite trajectory)")
+
+
+@requires_interpret
+@pytest.mark.parametrize("ep", [1, 2, 4])
+def test_rowwin_matches_oracle(ep, monkeypatch, tmp_path, devices):
+    """The row-windowed schedule (ISSUE 12) across world sizes — forced
+    multi-window (kw=64 -> 4 K-windows, cm=32 -> multiple row tiles) so
+    the HBM partial-sum accumulator path is really exercised — must
+    match the dense oracle, with the race detector on."""
+    from flashmoe_tpu import tuning
+
+    _force_tiles(monkeypatch, tmp_path, cm=32, kw=64)
+    try:
+        cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                        intermediate_size=256, sequence_len=256,
+                        drop_tokens=False, ep=ep,
+                        fused_schedule="rowwin", **F32)
+        params, x = _setup(cfg)
+        mesh = make_mesh(cfg, dp=1, devices=devices[:ep])
+        out = fused_ep_moe_layer(params, x, cfg, mesh, interpret=True,
+                                 detect_races=True)
+        want, _ = reference_moe(params, x, cfg)
+        np.testing.assert_allclose(
+            np.asarray(out.out), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+    finally:
+        tuning._load.cache_clear()
+
+
+@requires_interpret
+@pytest.mark.parametrize("other", ["stream", "batched", "collective"])
+@pytest.mark.slow
+def test_rowwin_identity_across_schedules(other, monkeypatch, tmp_path,
+                                          devices):
+    """ISSUE 12 acceptance: rowwin output vs every mutually-feasible
+    alternative on the same shape — BIT-identical against the stream
+    schedule when the tile/window geometry matches (identical f32
+    partial-sum order: acc = sum_j act(x @ Wup_j) @ Wdn_j, the HBM
+    round-trip preserves f32 exactly), allclose against the batched
+    schedule and the collective path (different accumulation
+    geometry reassociates float adds).  Drops included."""
+    from flashmoe_tpu import tuning
+
+    cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=128,
+                    intermediate_size=256, sequence_len=512,
+                    capacity_factor=1.0, drop_tokens=True, ep=4, **F32)
+    params, x = _setup(cfg)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:4])
+    # rowwin at (cm=32, kw=64): 4 windows x multiple row tiles
+    _force_tiles(monkeypatch, tmp_path, cm=32, kw=64)
+    try:
+        rw = fused_ep_moe_layer(params, x,
+                                cfg.replace(fused_schedule="rowwin"),
+                                mesh, interpret=True, detect_races=True)
+        if other == "collective":
+            want = ep_moe_layer(params, x, cfg, mesh, use_pallas=False)
+            np.testing.assert_allclose(
+                np.asarray(rw.out), np.asarray(want.out),
+                rtol=2e-4, atol=2e-4)
+            np.testing.assert_array_equal(
+                np.asarray(rw.expert_counts),
+                np.asarray(want.expert_counts))
+        elif other == "batched":
+            got = fused_ep_moe_layer(
+                params, x, cfg.replace(fused_schedule="batched"), mesh,
+                interpret=True)
+            np.testing.assert_allclose(np.asarray(rw.out),
+                                       np.asarray(got.out),
+                                       rtol=1e-5, atol=1e-5)
+        else:
+            # stream at the SAME (cm, bi=kw) tiles: identical chunked
+            # f32 accumulation order -> bit-identical
+            import json
+
+            p = tmp_path / "stream.json"
+            p.write_text(json.dumps({"generation": "test", "entries": [{
+                "kernel": "fused_ep", "match": {"h": 128},
+                "set": {"cm": 32, "bi_cap": 64}}]}))
+            monkeypatch.setenv("FLASHMOE_TUNING_FILE", str(p))
+            tuning._load.cache_clear()
+            got = fused_ep_moe_layer(
+                params, x, cfg.replace(fused_schedule="stream"), mesh,
+                interpret=True)
+            np.testing.assert_array_equal(np.asarray(rw.out),
+                                          np.asarray(got.out))
+    finally:
+        tuning._load.cache_clear()
+
+
+def test_rowwin_window_major_emulation():
+    """Schedule-math gate that needs no kernel execution (the interpret
+    gap of this environment's jax must not leave the rowwin algebra
+    unasserted): emulate the window-major loop — per K-window compute
+    hidden_j = act(x @ Wup_j), fold acc += hidden_j @ Wdn_j through an
+    f32 round-trip buffer (the HBM accumulator) — and assert BIT
+    equality with the stream schedule's chunked accumulation and exact
+    closeness to the unchunked einsum."""
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    cm, h, i, kw = 32, 64, 256, 64
+    x = rng.randn(cm, h).astype(np.float32)
+    wu = rng.randn(h, i).astype(np.float32)
+    wd = rng.randn(i, h).astype(np.float32)
+
+    def relu(v):
+        return np.maximum(v, 0.0)
+
+    # stream schedule: VMEM-resident f32 acc over K-chunks
+    acc_stream = np.zeros((cm, h), np.float32)
+    for j in range(i // kw):
+        hid = relu(x @ wu[:, j * kw:(j + 1) * kw])
+        acc_stream += hid @ wd[j * kw:(j + 1) * kw, :]
+
+    # rowwin schedule: the SAME per-window algebra, but the partial sum
+    # round-trips through an f32 "HBM" buffer between windows
+    hbm = None
+    for j in range(i // kw):
+        acc = np.zeros((cm, h), np.float32) if j == 0 else hbm.copy()
+        hid = relu(x @ wu[:, j * kw:(j + 1) * kw])
+        acc += hid @ wd[j * kw:(j + 1) * kw, :]
+        hbm = acc.astype(np.float32)  # f32 -> f32: exact
+    np.testing.assert_array_equal(hbm, acc_stream)
+    # and both are the chunked form of the plain GEMM chain
+    dense = relu(x @ wu) @ wd
+    np.testing.assert_allclose(hbm, dense, rtol=1e-5, atol=1e-4)
+
+
+def test_forced_infeasible_schedule_raises():
+    """MoEConfig.fused_schedule pins a schedule past the heuristics but
+    never past the VMEM gate: forcing a weights-once schedule onto a
+    mixtral-width expert (or rowwin onto an absurd hidden size) must
+    raise a clear ValueError at resolution — the planner marks the
+    matching row infeasible instead (tests/test_planner.py)."""
+    from flashmoe_tpu.config import BENCH_CONFIGS
+    from flashmoe_tpu.parallel.fused import schedule_table
+
+    mix = BENCH_CONFIGS["mixtral"]
+    with pytest.raises(ValueError, match="VMEM-infeasible"):
+        from flashmoe_tpu.parallel.fused import (
+            _fused_schedule, _resolve_tiles,
+        )
+
+        cm, bi = _resolve_tiles(160, 4096, 14336, "bfloat16", False)
+        _fused_schedule(160, 4096, 14336, 2, True, cm, bi, False, 2, 8,
+                        {}, dtype_name="bfloat16", forced="batched")
+    # schedule_table never raises for planner consumers: the forced
+    # infeasibility surfaces as a reason + auto fallback
+    t = schedule_table(mix.replace(fused_schedule="batched"), 8)
+    assert t["forced_infeasible"] and "VMEM" in t["forced_infeasible"]
+    assert t["schedule"] == "rowwin"  # the auto choice stands in
+    # an absurd hidden size starves even the minimal rowwin window pair
+    from flashmoe_tpu.parallel.fused import _rowwin_tiles
+
+    assert _rowwin_tiles(32, 2 ** 17, 2 ** 17, 4, None, False, False,
+                         2) == (None, None)
+
+
+def test_rowwin_respects_batched_kill_switches(monkeypatch):
+    """rowwin is a batched-pass schedule: FLASHMOE_FUSED_BATCHED=0 (a
+    request for per-source arrival processing) must suppress the AUTO
+    rowwin choice too, while FLASHMOE_FUSED_ROWWIN=0 targets it
+    individually and an explicit fused_schedule='rowwin' forces past
+    both."""
+    from flashmoe_tpu.config import BENCH_CONFIGS
+    from flashmoe_tpu.parallel.fused import schedule_table
+
+    mix = BENCH_CONFIGS["mixtral"]
+    monkeypatch.delenv("FLASHMOE_FUSED_BATCHED", raising=False)
+    monkeypatch.delenv("FLASHMOE_FUSED_ROWWIN", raising=False)
+    assert schedule_table(mix, 8)["schedule"] == "rowwin"
+    monkeypatch.setenv("FLASHMOE_FUSED_ROWWIN", "0")
+    assert schedule_table(mix, 8)["schedule"] == "stream"
+    monkeypatch.delenv("FLASHMOE_FUSED_ROWWIN")
+    monkeypatch.setenv("FLASHMOE_FUSED_BATCHED", "0")
+    assert schedule_table(mix, 8)["schedule"] == "stream"
+    assert schedule_table(mix.replace(fused_schedule="rowwin"),
+                          8)["schedule"] == "rowwin"
+
+
 def test_arrival_order_and_skew_bounds():
     """The static arrival-order schedule (VERDICT r3 missing #2): on a
     homogeneous torus it reduces to ring order; rows are always own-first

@@ -145,6 +145,56 @@ def test_golden_tables_cover_chunk_dimension():
     assert "c4" in frozen["configs"]["reference"]["v5e"]["off"]
 
 
+def test_golden_tables_cover_schedule_dimension():
+    """CI gate for the fused-schedule axis (ISSUE 12): every golden
+    point must carry a row for EVERY fused schedule — batched,
+    resident, stream, AND rowwin — so a schedule added to the kernel
+    cannot silently skip the CI-gated tables; and the mixtral verdict
+    must be the recorded QUANTITATIVE race the rowwin schedule turned
+    it into (a feasible fused[rowwin] row priced against the collective
+    transports, whichever way selection lands), not the old categorical
+    'no weights-once schedule feasible'."""
+    frozen = load_golden()
+    want = {"fused[batched]", "fused[resident]", "fused[stream]",
+            "fused[rowwin]"}
+    for cname, gens in frozen["configs"].items():
+        for gen, wires in gens.items():
+            for wname, chunks in wires.items():
+                for chname, g in chunks.items():
+                    assert want <= set(g["paths"]), (cname, gen, wname,
+                                                     chname)
+    mix = frozen["configs"]["mixtral"]["v5e"]["off"]["serial"]["paths"]
+    assert mix["fused[rowwin]"]["feasible"]
+    assert not mix["fused[batched]"]["feasible"]
+    assert not mix["fused[resident]"]["feasible"]
+    # the race is quantitative: the rowwin row carries a real latency,
+    # and the recorded winner is whoever won it
+    assert mix["fused[rowwin]"]["total_ms"] > 0
+    winner = frozen["configs"]["mixtral"]["v5e"]["off"]["serial"]["winner"]
+    assert winner in ("collective", "ragged", "fused[rowwin]",
+                      "fused_combine")
+
+
+def test_planted_vmem_infeasible_rowwin_row():
+    """ISSUE 12 satellite: a config whose hidden size starves even the
+    minimal (row tile, K-window) pair must surface as an
+    infeasible-WITH-REASON fused[rowwin] planner row — never a crash,
+    never a silently missing row."""
+    cfg = MoEConfig(num_experts=8, expert_top_k=2,
+                    hidden_size=2 ** 17, intermediate_size=2 ** 17,
+                    sequence_len=128, capacity_factor=1.0,
+                    dtype=jnp.float32, param_dtype=jnp.float32)
+    preds = {p.path: p for p in predict_paths(cfg, 8, "v5e")}
+    row = preds["fused[rowwin]"]
+    assert not row.feasible
+    assert "rowwin infeasible" in row.note
+    assert "VMEM" in row.note
+    # every weights-once schedule is out too; the collective transports
+    # remain the feasible fallback
+    assert not preds["fused[batched]"].feasible
+    assert preds["collective"].feasible
+
+
 def test_d8_canonical_breakdown_all_generations():
     """The acceptance-criteria surface: at d=8 on every supported
     generation the reference config gets a full breakdown (compute,
@@ -153,7 +203,7 @@ def test_d8_canonical_breakdown_all_generations():
     for gen in GOLDEN_GENS:
         preds = predict_paths(REF, 8, gen)
         assert {"collective", "ragged", "fused[batched]",
-                "fused[resident]", "fused[stream]",
+                "fused[resident]", "fused[stream]", "fused[rowwin]",
                 "fused_combine"} <= {p.path for p in preds}
         winner = next(p for p in preds if p.feasible)
         assert winner.total_ms > 0
@@ -317,6 +367,7 @@ def test_planner_bytes_agree_with_analysis():
                  "fused[batched]": ("fused", "batched"),
                  "fused[resident]": ("fused", "resident"),
                  "fused[stream]": ("fused", "stream"),
+                 "fused[rowwin]": ("fused", "rowwin"),
                  "fused_combine": ("fused_combine", None)}
     for p in predict_paths(REF, d, "v5e", slices=2):
         ap, sched = byte_path[p.path]

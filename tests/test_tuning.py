@@ -50,6 +50,93 @@ def test_save_load_roundtrip_and_match(tmp_path, monkeypatch):
                          dtype="bfloat16")["block_m"] == 128
 
 
+def test_committed_tables_pass_schema_validation():
+    """ISSUE 12 satellite: every table committed under tuning_data/
+    must validate — a malformed entry fails CI here instead of being
+    silently ignored by the lenient runtime loader."""
+    import glob
+    import os
+
+    data_dir = os.path.join(os.path.dirname(tuning.__file__),
+                            "tuning_data")
+    for path in glob.glob(os.path.join(data_dir, "*.json")):
+        assert tuning.validate_table(path) == [], path
+
+
+def test_schema_validation_catches_malformed_entries(tmp_path):
+    ok = {"generation": "v5e", "entries": [
+        {"kernel": "fused_tiles",
+         "match": {"h": 4096, "i": 14336, "dtype": "bfloat16"},
+         "set": {"cm": 32, "kw": 256}, "measured_ms": 3.1},
+        {"kernel": "fused_ep", "match": {"h": 2048},
+         "set": {"cm": 256, "rowwin": True}},
+        {"kernel": "path_latency",
+         "match": {"path": "fused", "h": 2048, "d": 8},
+         "measured_ms": 2.71},
+    ]}
+    assert tuning.validate_entries(ok) == []
+
+    def bad(entry):
+        return tuning.validate_entries({"entries": [entry]})
+
+    assert bad({"kernel": "fuzed_ep", "match": {}, "set": {"cm": 1}})
+    assert bad({"kernel": "fused_ep", "match": {"hh": 2048},
+                "set": {"cm": 256}})                  # unknown match key
+    assert bad({"kernel": "fused_ep", "match": {},
+                "set": {"cmm": 256}})                 # misspelled knob
+    assert bad({"kernel": "fused_tiles", "match": {},
+                "set": {"cm": 32}})                   # half-specified pair
+    assert bad({"kernel": "fused_tiles", "match": {},
+                "set": {"cm": 32, "kw": "wide"}})     # non-int knob
+    assert bad({"kernel": "path_latency",
+                "match": {"h": 2048}, "measured_ms": 2.0})  # no path
+    assert bad({"kernel": "path_latency",
+                "match": {"path": "fused"},
+                "measured_ms": "fast"})               # non-numeric ms
+    assert bad({"kernel": "fused_ep", "match": {"h": 2048}})  # no set
+    assert tuning.validate_entries({"entries": "nope"})
+    assert tuning.validate_entries([])                # not an object
+    # CI-facing file validator reports unreadable files as problems
+    p = tmp_path / "corrupt.json"
+    p.write_text("{not json")
+    assert tuning.validate_table(str(p))
+
+
+def test_fused_tiles_entry_overrides_rowwin_chooser(tmp_path,
+                                                    monkeypatch):
+    """A measured fused_tiles entry overrides the IO-aware analytic
+    pick when it divides the shapes; a non-dividing or VMEM-infeasible
+    entry is discarded (the budget gate is never overridable)."""
+    from flashmoe_tpu.parallel.fused import _rowwin_tiles
+
+    analytic = _rowwin_tiles(256, 2048, 2048, 2, "bfloat16", False,
+                             False, 2)
+    assert analytic[0] is not None
+    path = str(tmp_path / "v5e.json")
+    tuning.save_entries("v5e", [{
+        "kernel": "fused_tiles",
+        "match": {"h": 2048, "i": 2048, "dtype": "bfloat16"},
+        "set": {"cm": 32, "kw": 128},
+    }], path=path)
+    monkeypatch.setenv("FLASHMOE_TUNING_FILE", path)
+    tuning._load.cache_clear()
+    assert _rowwin_tiles(256, 2048, 2048, 2, "bfloat16", False,
+                         False, 2) == (32, 128)
+    # a pair that stopped dividing the capacity falls back to analytic
+    cm, kw = _rowwin_tiles(48, 2048, 2048, 2, "bfloat16", False,
+                           False, 2)
+    assert 48 % cm == 0 and cm != 32
+    # an entry past the VMEM budget is likewise ignored
+    tuning.save_entries("v5e", [{
+        "kernel": "fused_tiles",
+        "match": {"h": 2048, "i": 2048, "dtype": "bfloat16"},
+        "set": {"cm": 256, "kw": 2048},
+    }], path=path)
+    tuning._load.cache_clear()
+    assert _rowwin_tiles(256, 2048, 2048, 2, "bfloat16", False,
+                         False, 2) == analytic
+
+
 def test_capacity_tiling_consults_table(tmp_path, monkeypatch):
     cfg = MoEConfig(num_experts=8, expert_top_k=2, hidden_size=2048,
                     intermediate_size=2048, dtype=jnp.bfloat16,
