@@ -647,6 +647,146 @@ def _sweep_ep(trials: int, wire_dtype: str | None = None,
         print(json.dumps(rec), flush=True)
 
 
+def _bench_scaling(trials: int, *, wire_dtype=None, wire_combine=None,
+                   wire_dcn=None, a2a_chunks=None):
+    """Weak-scaling sweep over mocked 1/2/4/8-slice meshes (ISSUE 13).
+
+    The 8-rank mesh (virtual CPU, or real chips under
+    FLASHMOE_OVERLAP_TPU=1) is partitioned into n "slices" per point
+    via ``FLASHMOE_MOCK_SLICES`` — the same detection path a real
+    multislice bootstrap runs (``topology.slice_structure``) — and the
+    collective layer runs the two-stage hierarchical exchange at
+    ``dcn_inner = 8 // n`` (flat at n=1, and at n=8 where one rank per
+    slice degenerates to flat).  Per point one JSON record carries the
+    measured per-step latency, the planner's slices=n prediction
+    through the drift monitor (generation pinned by the backend or
+    FLASHMOE_TPU_GEN; prediction fields absent otherwise, like the
+    headline bench), the modeled per-hop wire bytes (ICI vs DCN row
+    sizes — ``wire_dtype_dcn`` shrinks the dcn hop only) and DCN
+    message counts (flat vs hierarchical aggregation), and the
+    weak-scaling efficiency vs the 1-slice point."""
+    from flashmoe_tpu.analysis import a2a_transport_cost
+    from flashmoe_tpu.models.reference import init_moe_params
+    from flashmoe_tpu.parallel.ep import ep_moe_layer
+    from flashmoe_tpu.parallel.mesh import make_mesh
+    from flashmoe_tpu.parallel.overlap import _time_chained
+    from flashmoe_tpu.parallel.topology import (
+        _PEAK_TFLOPS, slice_structure, tpu_generation,
+    )
+    from flashmoe_tpu.planner.model import predict_paths, slab_bytes
+
+    on_tpu = os.environ.get("FLASHMOE_OVERLAP_TPU") == "1"
+    if not on_tpu:
+        from __graft_entry__ import _force_cpu_devices
+        _force_cpu_devices(8)
+        devs = jax.devices("cpu")[:8]
+    else:
+        devs = jax.devices()[:8]
+    d = len(devs)
+    gen = tpu_generation(devs[0])
+    if gen not in _PEAK_TFLOPS:
+        gen = os.environ.get("FLASHMOE_TPU_GEN", "")
+    chunks = (a2a_chunks if a2a_chunks and a2a_chunks > 1
+              and (16 // d) % a2a_chunks == 0 else None)
+    if a2a_chunks and a2a_chunks > 1 and chunks is None:
+        # the _sweep_ep convention: a dropped knob is announced, never
+        # silently measured serial
+        print(f"# --scaling: a2a_chunks={a2a_chunks} does not divide "
+              f"nLx={16 // d}; measuring serial", file=sys.stderr,
+              flush=True)
+    base_t = None
+    saved_mock = os.environ.get("FLASHMOE_MOCK_SLICES")
+    try:
+        for n_slices in (1, 2, 4, 8):
+            if d % n_slices:
+                continue
+            os.environ["FLASHMOE_MOCK_SLICES"] = str(n_slices)
+            ss = slice_structure(devs)
+            inner = ss[1] if ss else d
+            hier = 1 < inner < d
+            cfg = MoEConfig(
+                num_experts=16, expert_top_k=2, hidden_size=256,
+                intermediate_size=512, sequence_len=256 * d,
+                capacity_factor=1.0, drop_tokens=True, ep=d,
+                dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+                wire_dtype=wire_dtype, wire_dtype_combine=wire_combine,
+                wire_dtype_dcn=wire_dcn, a2a_chunks=chunks,
+            )
+            mesh = make_mesh(cfg, dp=1, devices=devs)
+            params = init_moe_params(jax.random.PRNGKey(0), cfg)
+            params = jax.tree_util.tree_map(
+                lambda p: p.astype(cfg.dtype), params)
+            x = jax.random.normal(
+                jax.random.PRNGKey(1), (cfg.tokens, cfg.hidden_size),
+                cfg.dtype)
+            fn = lambda c: ep_moe_layer(params, c, cfg, mesh,
+                                        use_pallas=on_tpu,
+                                        dcn_inner=inner if hier else 0).out
+            t = _time_chained(fn, x, trials=trials, chain=8)
+            base_t = base_t or t
+            path = "hierarchical" if hier else "collective"
+            tc = a2a_transport_cost(d, max(inner, 1),
+                                    slab_bytes(cfg, d, leg="dispatch"),
+                                    gen=gen if gen in _PEAK_TFLOPS
+                                    else "v5e",
+                                    dcn_slab_bytes=slab_bytes(
+                                        cfg, d, leg="dispatch",
+                                        hop="dcn"))
+            rec = {
+                "metric": f"scaling_ms[{path},slices={n_slices},ep={d},"
+                          f"tokens_per_rank=256,"
+                          f"{'tpu' if on_tpu else 'virtual_cpu'}]",
+                "value": round(t * 1e3, 3),
+                "unit": "ms",
+                # weak-scaling efficiency over the slice axis: per-rank
+                # work constant, only the transport topology changes
+                "vs_baseline": round(base_t / t, 3),
+                "slices": n_slices,
+                "dcn_inner": inner if hier else None,
+                "path": path,
+                "d": d,
+                "a2a_chunks": cfg.a2a_chunks or 1,
+                # modeled per-hop wire bytes of one dispatch leg slab
+                # (the dcn row shrinks under --wire-dcn) + the DCN
+                # message aggregation the two-stage exchange buys
+                "slab_ici_mb": round(
+                    slab_bytes(cfg, d, leg="dispatch") / 2**20, 4),
+                "slab_dcn_mb": round(
+                    slab_bytes(cfg, d, leg="dispatch", hop="dcn")
+                    / 2**20, 4),
+                "dcn_messages_flat": tc["flat"]["dcn_messages"],
+                "dcn_messages_hier": tc["hierarchical"]["dcn_messages"],
+            }
+            rec.update(_wire_fields(cfg))
+            rec["wire_dtype_dcn"] = wire_dcn or "off"
+            if gen in _PEAK_TFLOPS:
+                try:
+                    preds = {p.path: p for p in predict_paths(
+                        cfg, d, gen, slices=n_slices)}
+                    p = preds.get(path) or preds["collective"]
+                    rec["planner_gen"] = gen
+                    rec["predicted_ms"] = round(p.total_ms, 3)
+                    rec["prediction_error"] = round(
+                        t * 1e3 / p.total_ms - 1.0, 3)
+                    rec["predicted_dcn_ms"] = round(p.dcn_ms, 4)
+                    from flashmoe_tpu.planner.drift import record_drift
+
+                    dr = record_drift(cfg, path, t * 1e3, d=d, gen=gen,
+                                      predicted_ms=p.total_ms,
+                                      warn=False)
+                    rec["drift_exceeded"] = dr.exceeded
+                except Exception as e:  # noqa: BLE001 — keep the record
+                    rec["planner_error"] = (f"{type(e).__name__}: "
+                                            f"{str(e)[:120]}")
+            print(json.dumps(rec), flush=True)
+            _flush_observability(rec)
+    finally:
+        if saved_mock is None:
+            os.environ.pop("FLASHMOE_MOCK_SLICES", None)
+        else:
+            os.environ["FLASHMOE_MOCK_SLICES"] = saved_mock
+
+
 def _bench_tiles(cfg: MoEConfig, name: str, trials: int, chain: int):
     """Per-tile-choice records of the row-windowed fused schedule
     (ISSUE 12): every feasible K-window of the IO-aware chooser's grid
@@ -843,6 +983,14 @@ def main():
     ap.add_argument("--overlap", type=int, default=0, metavar="EP",
                     help="measure overlap efficiency on an EP-way mesh "
                          "instead of the latency bench")
+    ap.add_argument("--scaling", action="store_true",
+                    help="weak-scaling sweep over mocked 1/2/4/8-slice "
+                         "meshes (FLASHMOE_MOCK_SLICES + the two-stage "
+                         "hierarchical a2a): one JSON record per slice "
+                         "count with measured vs slices=n predicted "
+                         "latency through the drift monitor and the "
+                         "per-hop wire bytes (see docs/PERF.md "
+                         "'Multi-slice scale-out')")
     ap.add_argument("--tiles", action="store_true",
                     help="sweep the row-windowed fused schedule's "
                          "(cm, kw) tile candidates at --config instead "
@@ -906,6 +1054,11 @@ def main():
                          "on every emitted measurement")
     ap.add_argument("--wire-combine", default=None,
                     help="EP payload wire dtype for the combine leg")
+    ap.add_argument("--wire-dcn", default=None,
+                    help="per-hop wire dtype for the CROSS-SLICE (DCN) "
+                         "stage of the hierarchical a2a "
+                         "(MoEConfig.wire_dtype_dcn; --scaling only — "
+                         "the other modes have no DCN hop)")
     ap.add_argument("--a2a-chunks", type=int, default=None,
                     help="chunked double-buffered EP pipeline depth "
                          "(MoEConfig.a2a_chunks; default off = serial "
@@ -921,8 +1074,10 @@ def main():
     _OBS[0] = args.obs_dir
 
     # the headline record's identity follows the mode, so a tiles-sweep
-    # skip/error is machine-distinguishable from a latency-bench one
+    # or scaling-sweep skip/error is machine-distinguishable from a
+    # latency-bench one
     headline_metric = (f"fused_tiles_ms[{args.config}]" if args.tiles
+                       else "scaling_ms[slices]" if args.scaling
                        else f"moe_layer_fwd_ms[{args.config}]")
 
     def emit_error(msg, code=2):
@@ -968,6 +1123,42 @@ def main():
                  "not --ckpt")
     if args.a2a_chunks is not None and args.a2a_chunks < 1:
         ap.error("--a2a-chunks must be >= 1")
+    if args.wire_dcn and not args.scaling:
+        # fail-fast contract: the DCN-hop wire only exists on the
+        # two-stage multi-slice exchange the scaling sweep runs; every
+        # other mode would silently ignore it
+        ap.error("--wire-dcn applies to --scaling only (the other "
+                 "modes run no cross-slice hop)")
+    if args.scaling:
+        if args.overlap or args.ckpt or args.sweep or args.serve \
+                or args.profile or args.profile_quick or args.tiles:
+            ap.error("--scaling is its own mode; drop "
+                     "--overlap/--ckpt/--sweep/--serve/--profile/"
+                     "--tiles")
+        if os.environ.get("FLASHMOE_OVERLAP_TPU") == "1":
+            # real-hardware runs inherit the probe fail-fast contract:
+            # a wedged tunnel yields ONE well-formed skipped:true
+            # record and rc 0, never a hang or an ambiguous rc 2
+            ok, info, hung = _probe_backend_retry(
+                args.probe_budget, each_s=max(args.probe_timeout, 10),
+                max_attempts=args.probe_attempts)
+            if not ok:
+                if hung:
+                    print(json.dumps({
+                        "metric": headline_metric,
+                        "value": None, "unit": "ms",
+                        "vs_baseline": None,
+                        "skipped": True, "reason": info,
+                    }), flush=True)
+                    sys.exit(0)
+                emit_error(info)
+        if args.deadline > 0:
+            signal.alarm(args.deadline)
+        _bench_scaling(args.trials, wire_dtype=args.wire_dtype,
+                       wire_combine=args.wire_combine,
+                       wire_dcn=args.wire_dcn,
+                       a2a_chunks=args.a2a_chunks)
+        return
     if args.tiles:
         # the --profile/--ckpt fail-fast contract: refuse knobs/modes
         # this mode would silently ignore — the tiles sweep pins its

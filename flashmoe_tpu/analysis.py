@@ -54,7 +54,8 @@ def xla_cost(fn, *abstract_args) -> dict:
     }
 
 
-def wire_row_bytes(cfg: MoEConfig, leg: str = "dispatch") -> float:
+def wire_row_bytes(cfg: MoEConfig, leg: str = "dispatch",
+                   hop: str = "ici") -> float:
     """Bytes ONE token row occupies on the EP all-to-all wire for
     ``leg`` ('dispatch' | 'combine'): ``H x wire itemsize`` plus the
     4-byte f32 per-row scale sidecar for fp8 wires
@@ -62,12 +63,23 @@ def wire_row_bytes(cfg: MoEConfig, leg: str = "dispatch") -> float:
     leg's wire is off.  Every comm term below — and the planner's slab
     serialization (:mod:`flashmoe_tpu.planner.model`) — prices the
     exchange through this one function, so the byte model can never
-    disagree with the codec about what actually crosses the wire."""
+    disagree with the codec about what actually crosses the wire.
+
+    ``hop`` selects the stage of a two-stage multi-slice exchange being
+    priced: ``'ici'`` (default — also the flat exchange, which carries
+    the leg wire end to end) or ``'dcn'``, where
+    ``MoEConfig.wire_dtype_dcn`` overrides the leg wire when set (None
+    inherits — both hops then price identically, matching the codec's
+    single-encode path)."""
     from flashmoe_tpu.ops import wire as wr
 
     if leg not in ("dispatch", "combine"):
         raise ValueError(f"unknown wire leg {leg!r}")
+    if hop not in ("ici", "dcn"):
+        raise ValueError(f"unknown wire hop {hop!r}")
     name = cfg.wire_dtype if leg == "dispatch" else cfg.wire_dtype_combine
+    if hop == "dcn" and cfg.wire_dtype_dcn is not None:
+        name = cfg.wire_dtype_dcn
     wd = wr.resolve(name)
     return (wr.payload_row_bytes(wd, cfg.hidden_size, cfg.dtype)
             + wr.scale_bytes(wd))
@@ -301,7 +313,8 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1,
 
 def a2a_transport_cost(d: int, inner: int, slab_bytes: float,
                        gen: str = "v5e", links: int = 1,
-                       chunks: int = 1) -> dict:
+                       chunks: int = 1,
+                       dcn_slab_bytes: float | None = None) -> dict:
     """Model the flat vs two-stage (ICI+DCN) all-to-all on a ``d``-rank
     ep axis spanning ``d // inner`` slices, per rank per direction
     (``parallel/ep.py:_hierarchical_a2a``; the reference's per-peer
@@ -330,6 +343,15 @@ def a2a_transport_cost(d: int, inner: int, slab_bytes: float,
     pipeline's hiding: more chunks hide more compute but pay more
     message latencies — the IO-aware tradeoff SonicMoE's tile knob
     makes (arXiv 2512.14080).
+
+    ``dcn_slab_bytes``: the per-dest slab at the CROSS-SLICE hop's own
+    wire row size (``MoEConfig.wire_dtype_dcn`` via
+    :func:`wire_row_bytes` ``hop='dcn'``; default None = ``slab_bytes``
+    — the inherit case).  Only the hierarchical DCN stage re-encodes,
+    so only its serialization term uses it; the flat exchange carries
+    the leg wire across DCN unchanged — which is exactly the modeled
+    gap an fp8 DCN hop opens over flat (docs/PERF.md "Multi-slice
+    scale-out").
     """
     from flashmoe_tpu.parallel.topology import _DCN_SPEC, _ICI_SPECS
 
@@ -346,6 +368,7 @@ def a2a_transport_cost(d: int, inner: int, slab_bytes: float,
     bw_ici = bw_ici * 1e6 * max(links, 1)                # B/ms, striped
     bw_dcn = bw_dcn * 1e6                                # B/ms
     outer = d // inner
+    dcn_slab = slab_bytes if dcn_slab_bytes is None else dcn_slab_bytes
     flat = {
         "dcn_messages": (d - inner) * chunks,
         "dcn_ms": (d - inner) * (a_dcn + slab_bytes / bw_dcn),
@@ -353,7 +376,7 @@ def a2a_transport_cost(d: int, inner: int, slab_bytes: float,
     }
     hier = {
         "dcn_messages": (outer - 1) * chunks,
-        "dcn_ms": (outer - 1) * (a_dcn + inner * slab_bytes / bw_dcn),
+        "dcn_ms": (outer - 1) * (a_dcn + inner * dcn_slab / bw_dcn),
         "ici_ms": (inner - 1) * (a_ici + outer * slab_bytes / bw_ici),
     }
     for c in (flat, hier):
@@ -445,14 +468,44 @@ def comm_census(cfg: MoEConfig, d: int, path: str) -> dict:
         bound_factor = 1.0
         gather_eqns = 0
         meta_bytes = {"all_gather": 0.0, "all_to_all": 0.0}
-        for leg, wd in wires.items():
-            legs[leg] = stages * d * slab_bytes(cfg, d, leg=leg)
-            a2a += stages * chunks * (1 + (1 if wr.is_fp8(wd) else 0))
+        if path == "hierarchical":
+            # per-hop staging (ISSUE 13): the inner (ICI) stage moves
+            # the leg-wire buffer, the outer (DCN) stage the DCN-wire
+            # buffer (wire_dtype_dcn; equal when it inherits — the
+            # codec's single-encode path, where this reduces exactly to
+            # the old stages x flat formula)
+            wd_dcn = wr.resolve(cfg.wire_dtype_dcn)
+            for leg, wd in wires.items():
+                legs[leg] = d * (slab_bytes(cfg, d, leg=leg, hop="ici")
+                                 + slab_bytes(cfg, d, leg=leg,
+                                              hop="dcn"))
+                hop_dcn = wd_dcn if wd_dcn is not None else wd
+                a2a += chunks * ((1 + (1 if wr.is_fp8(wd) else 0))
+                                 + (1 + (1 if wr.is_fp8(hop_dcn)
+                                         else 0)))
+        else:
+            # flat transports carry the leg wire end to end; the DCN
+            # override has no hop to re-encode and must price as off
+            for leg, wd in wires.items():
+                legs[leg] = d * slab_bytes(cfg, d, leg=leg)
+                a2a += chunks * (1 + (1 if wr.is_fp8(wd) else 0))
 
     # cross-check the two model sources against each other: the graph
     # legs must equal the HBM model's one-sided bytes times the
-    # documented structural multipliers
-    want = cost.comm_bytes / 2.0 * stages * bound_factor
+    # documented structural multipliers.  The hierarchical per-hop
+    # variant derives each hop's side from path_costs independently —
+    # the ICI hop from the config as-is, the DCN hop from the config
+    # with the resolved DCN wire as its leg wire — so planner slabs and
+    # the HBM model still cross-check per hop.
+    if path == "hierarchical":
+        cfg_dcn = (cfg.replace(wire_dtype=cfg.wire_dtype_dcn,
+                               wire_dtype_combine=cfg.wire_dtype_dcn,
+                               wire_dtype_dcn=None)
+                   if cfg.wire_dtype_dcn is not None else cfg)
+        cost_dcn = path_costs(cfg_dcn, "explicit", d_world=d)
+        want = (cost.comm_bytes + cost_dcn.comm_bytes) / 2.0
+    else:
+        want = cost.comm_bytes / 2.0 * stages * bound_factor
     got = sum(legs.values())
     if abs(got - want) > 1e-6 * max(want, 1.0):
         raise AssertionError(

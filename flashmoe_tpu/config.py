@@ -141,6 +141,23 @@ class MoEConfig:
     wire_dtype: str | None = None
     wire_dtype_combine: str | None = None
 
+    # Per-hop wire dtype for the CROSS-SLICE (DCN) stage of the
+    # two-stage hierarchical all-to-all (parallel/ep.py
+    # _hierarchical_a2a): when the ep axis spans DCN-connected slices,
+    # the exchange decomposes into an intra-slice ICI hop and one
+    # aggregated DCN message per slice pair — and the DCN hop, priced
+    # ~5x slower per byte than ICI (topology._DCN_SPEC), can carry a
+    # narrower wire than the in-slice hop.  Set (e.g. "e4m3") the DCN
+    # stage of BOTH legs re-encodes at this dtype while the ICI stage
+    # stays at the leg's own wire (`wire_dtype` / `wire_dtype_combine`,
+    # raw when those are off).  Default None: INHERIT the leg wire —
+    # the whole exchange encodes once and the traced graph is exactly
+    # the single-dtype build (bit-identical; proven by the staticcheck
+    # invariant engine).  Inert on flat (single-slice) exchanges — there
+    # is no DCN hop to re-encode.  XLA transports only, like the other
+    # wire knobs (the fused RDMA kernel moves raw slabs).
+    wire_dtype_dcn: str | None = None
+
     # Chunked double-buffered EP dispatch (Comet-style compute–
     # communication overlap, arXiv 2502.19811): split the [E, C, H]
     # exchange slab along the local-expert axis into this many chunks
@@ -293,7 +310,8 @@ class MoEConfig:
         from flashmoe_tpu.ops import wire as _wire
 
         for knob, val in (("wire_dtype", self.wire_dtype),
-                          ("wire_dtype_combine", self.wire_dtype_combine)):
+                          ("wire_dtype_combine", self.wire_dtype_combine),
+                          ("wire_dtype_dcn", self.wire_dtype_dcn)):
             if val is None:
                 continue
             wd = _wire.resolve(val)  # ValueError on unknown/unsupported
@@ -370,7 +388,8 @@ class MoEConfig:
             raise ValueError(
                 f"serving_mode {self.serving_mode!r} not in "
                 f"(None, 'prefill', 'decode')")
-        if ((self.wire_dtype or self.wire_dtype_combine)
+        if ((self.wire_dtype or self.wire_dtype_combine
+                or self.wire_dtype_dcn)
                 and self.moe_backend == "fused"):
             raise ValueError(
                 "wire-dtype compression rides the XLA transports; "

@@ -158,16 +158,28 @@ def wire_report(flight: list[dict]) -> dict:
     mean/max error — a rising error flags payload distributions the fp8
     wire no longer represents well."""
     errs = []
+    dcn_errs = []
     for rec in flight:
         for m in _layer_stats(rec):
             e = m.get("wire_rtq_error")
             if isinstance(e, (int, float)) and e > 0:
                 errs.append(float(e))
+            e = m.get("wire_rtq_error_dcn")
+            if isinstance(e, (int, float)) and e > 0:
+                dcn_errs.append(float(e))
     return {
         "steps_with_wire": len(errs),
         "mean_rtq_error": round(sum(errs) / len(errs), 6) if errs
         else None,
         "max_rtq_error": round(max(errs), 6) if errs else None,
+        # the cross-slice hop's own wire (wire_dtype_dcn), tracked
+        # separately so an fp8 DCN hop's loss never hides in (or
+        # inflates) the in-slice number
+        "steps_with_dcn_wire": len(dcn_errs),
+        "mean_dcn_rtq_error": (round(sum(dcn_errs) / len(dcn_errs), 6)
+                               if dcn_errs else None),
+        "max_dcn_rtq_error": round(max(dcn_errs), 6) if dcn_errs
+        else None,
     }
 
 

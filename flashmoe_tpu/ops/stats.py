@@ -57,6 +57,13 @@ class MoEStats(NamedTuple):
                           dispatched payload, pmeaned across ranks.
                           0.0 when ``wire_dtype`` is off (or the layer
                           has no exchange).
+    wire_rtq_error_dcn:   [] same proxy for the CROSS-SLICE hop's own
+                          wire (``MoEConfig.wire_dtype_dcn``) on a
+                          two-stage multi-slice exchange: how lossy the
+                          fp8-across-DCN hop is on live traffic,
+                          separately from the in-slice hop.  0.0 when
+                          the DCN override is off or the exchange is
+                          flat.
     """
 
     expert_load: jnp.ndarray
@@ -68,6 +75,7 @@ class MoEStats(NamedTuple):
     masked_experts: jnp.ndarray
     masked_fraction: jnp.ndarray
     wire_rtq_error: jnp.ndarray
+    wire_rtq_error_dcn: jnp.ndarray
 
 
 def load_imbalance(expert_load) -> jnp.ndarray:
@@ -142,8 +150,10 @@ def moe_stats(router_out, cfg: MoEConfig, capacity: int | None
         masked_experts=zero,
         masked_fraction=zero,
         # wire-compression error: filled in by the EP layers via
-        # with_wire_error() once the dispatch payload exists
+        # with_wire_error() once the dispatch payload exists (the
+        # _dcn twin covers the cross-slice hop's own wire)
         wire_rtq_error=zero,
+        wire_rtq_error_dcn=zero,
     )
 
 
@@ -157,18 +167,28 @@ def with_degradation(stats: MoEStats, masked_experts,
     )
 
 
-def with_wire_error(stats: MoEStats, wire_rtq_error,
-                    reduce_axes=None) -> MoEStats:
+def with_wire_error(stats: MoEStats, wire_rtq_error=None,
+                    reduce_axes=None, *, dcn_error=None) -> MoEStats:
     """Attach the wire-compression round-trip error
     (:func:`flashmoe_tpu.ops.wire.roundtrip_error`) to a stats tuple.
     Inside a shard_map body pass ``reduce_axes`` to pmean the per-shard
-    proxy across ranks (every rank holds the same token count)."""
-    err = jnp.asarray(wire_rtq_error, jnp.float32)
-    if reduce_axes is not None:
-        import jax
+    proxy across ranks (every rank holds the same token count).
+    ``dcn_error`` carries the cross-slice hop's own proxy
+    (``wire_rtq_error_dcn``, the ``wire_dtype_dcn`` hop); either side
+    may be ``None`` to leave its field untouched."""
+    import jax
 
-        err = jax.lax.pmean(err, reduce_axes)
-    return stats._replace(wire_rtq_error=err)
+    def _red(v):
+        v = jnp.asarray(v, jnp.float32)
+        return (jax.lax.pmean(v, reduce_axes)
+                if reduce_axes is not None else v)
+
+    fields = {}
+    if wire_rtq_error is not None:
+        fields["wire_rtq_error"] = _red(wire_rtq_error)
+    if dcn_error is not None:
+        fields["wire_rtq_error_dcn"] = _red(dcn_error)
+    return stats._replace(**fields) if fields else stats
 
 
 def reduce_stats(local: MoEStats, probs_mean, reduce_axes) -> MoEStats:
@@ -200,6 +220,7 @@ def reduce_stats(local: MoEStats, probs_mean, reduce_axes) -> MoEStats:
         masked_experts=local.masked_experts,
         masked_fraction=local.masked_fraction,
         wire_rtq_error=local.wire_rtq_error,
+        wire_rtq_error_dcn=local.wire_rtq_error_dcn,
     )
 
 
@@ -223,4 +244,5 @@ def stats_to_host(stats: MoEStats) -> dict:
         "masked_experts": float(host.masked_experts),
         "masked_fraction": float(host.masked_fraction),
         "wire_rtq_error": float(host.wire_rtq_error),
+        "wire_rtq_error_dcn": float(host.wire_rtq_error_dcn),
     }

@@ -215,6 +215,91 @@ def assign_experts(group: list, rates, e: int,
     return out
 
 
+def assign_experts_sliced(group: list, rates, e: int, slice_of,
+                          expert_costs, pair: int = 2) -> dict:
+    """Slice-aware cost-sorted assignment for a group whose devices
+    span DCN-connected slices (ISSUE 13: the Decider output maps
+    experts to SLICES, not just devices).
+
+    Two levels, both deterministic:
+
+    1. **experts -> slices.**  Each slice gets a rate-proportional
+       expert budget (floor + remainder to the fastest slices, the
+       :func:`assign_experts` uniform arm at slice granularity).
+       Experts are then placed cost-sorted in PAIRS of ``pair``
+       (default 2 = ``expert_top_k`` routing companions: the experts a
+       token's top-k selection sends traffic to together): each pair
+       lands whole on the slice with the smallest projected finish
+       time ``(load + pair cost) / slice rate`` among slices with
+       budget left.  Hot companions therefore co-locate inside one
+       slice — a token routed to both crosses DCN at most once on
+       dispatch and its combine rides the aggregated per-slice-pair
+       message — while the pair-at-a-time greedy keeps the slices
+       load-balanced (packing all hot experts on one slice would just
+       move the bottleneck).
+    2. **experts -> devices within a slice.**  The greedy makespan
+       heuristic of :func:`assign_experts` over that slice's expert
+       subset and its own devices.
+
+    Returns device id -> sorted expert ids, the :func:`assign_experts`
+    contract."""
+    costs = np.asarray(expert_costs, dtype=np.float64)
+    if costs.shape != (e,):
+        raise ValueError(
+            f"expert_costs must have shape ({e},), got {costs.shape}")
+    by_slice: dict = {}
+    for d in group:
+        by_slice.setdefault(slice_of[d], []).append(d)
+    sids = sorted(by_slice)
+    if len(sids) < 2:
+        return assign_experts(group, rates, e, expert_costs=expert_costs)
+    srate = np.array([sum(rates[d] for d in by_slice[s]) for s in sids],
+                     dtype=np.float64)
+    budgets = np.floor(e * srate / srate.sum()).astype(int)
+    rem = e - budgets.sum()
+    order = np.argsort(-srate, kind="stable")
+    for k in range(int(rem)):
+        budgets[order[k % len(sids)]] += 1
+
+    slice_experts: dict = {s: [] for s in sids}
+    load = {s: 0.0 for s in sids}
+    left = {s: int(budgets[i]) for i, s in enumerate(sids)}
+    ranked = sorted(range(e), key=lambda i: (-costs[i], i))
+    for lo in range(0, e, max(pair, 1)):
+        chunk = ranked[lo:lo + max(pair, 1)]
+        # slices that can hold the whole pair keep companions together;
+        # the tail (budget fragmentation) falls back to any free slot
+        fits = [s for s in sids if left[s] >= len(chunk)]
+        cands = fits or [s for s in sids if left[s] > 0]
+        csum = sum(costs[i] for i in chunk)
+        tgt = min(cands,
+                  key=lambda s: ((load[s] + csum) / max(srate[sids.index(s)], 1e-9), s))
+        for eid in chunk:
+            if left[tgt] <= 0:
+                tgt = min((s for s in sids if left[s] > 0),
+                          key=lambda s: ((load[s] + costs[eid])
+                                         / max(srate[sids.index(s)],
+                                               1e-9), s))
+            slice_experts[tgt].append(eid)
+            load[tgt] += costs[eid]
+            left[tgt] -= 1
+
+    out: dict[int, list[int]] = {d: [] for d in group}
+    for s in sids:
+        devs = sorted(by_slice[s])
+        assigned = {d: 0.0 for d in devs}
+        for eid in sorted(slice_experts[s],
+                          key=lambda i: (-costs[i], i)):
+            d = min(devs,
+                    key=lambda dd: ((assigned[dd] + costs[eid])
+                                    / max(rates[dd], 1e-9), dd))
+            out[d].append(eid)
+            assigned[d] += costs[eid]
+    for d in group:
+        out[d].sort()
+    return out
+
+
 def _replicate_hot(group: list, rates, per_device: dict, costs,
                    spare_slots: int) -> dict:
     """Replicate the costliest experts onto extra devices while spare
@@ -270,7 +355,8 @@ def decide(adj: Adjacency, workers: list[WorkerAttr], cfg: MoEConfig,
            expert_mb: float | None = None,
            native: str | bool = "auto",
            price_mode: str = "bottleneck",
-           expert_costs=None, replicate: bool = False) -> Placement:
+           expert_costs=None, replicate: bool = False,
+           slice_of=None) -> Placement:
     """Form DP x EP groups and assign experts (the reference's
     ``Decider<JobType>::operator()`` + ``assign``).
 
@@ -301,6 +387,14 @@ def decide(adj: Adjacency, workers: list[WorkerAttr], cfg: MoEConfig,
     group memory capacity allows AND each copy improves the projected
     makespan (``Placement.replicas``).  Both are host-side only and
     force the pure-Python path (the C++ decider predates them).
+
+    ``slice_of``: per-device slice membership (``topology.
+    device_slice_ids``).  With ``expert_costs`` given, groups spanning
+    more than one slice assign their experts through
+    :func:`assign_experts_sliced` — hot top-k companion pairs
+    co-locate inside a slice so the DCN hop carries the aggregated
+    minimum (ISSUE 13).  Without costs the uniform split is
+    slice-agnostic and nothing changes.
     """
     import heapq
 
@@ -480,8 +574,15 @@ def decide(adj: Adjacency, workers: list[WorkerAttr], cfg: MoEConfig,
     local_experts: dict[int, list[int]] = {d: [] for d in range(n)}
     replicas: dict[int, list[int]] = {}
     for group in groups:
-        per_device = assign_experts(group, rates, e,
-                                    expert_costs=expert_costs)
+        spans_slices = (slice_of is not None
+                        and len({slice_of[d] for d in group}) > 1)
+        if expert_costs is not None and spans_slices:
+            per_device = assign_experts_sliced(group, rates, e,
+                                               slice_of, expert_costs,
+                                               pair=cfg.expert_top_k)
+        else:
+            per_device = assign_experts(group, rates, e,
+                                        expert_costs=expert_costs)
         if replicate and expert_costs is not None:
             cap_mb = sum(workers[d].memory_gb for d in group) * 1024.0
             spare = int(cap_mb // expert_mb) - e if expert_mb > 0 else 0

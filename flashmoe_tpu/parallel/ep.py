@@ -31,6 +31,11 @@ Both exchanges optionally compress their payload to a narrow wire dtype
 dequantize just after, halving (bf16) or quartering (fp8 + f32 per-row
 scale sidecar) the ICI/DCN bytes while every compute stage stays at the
 compute dtype.  Off by default; the wire-off graph is bit-identical.
+On a multi-slice (two-stage) exchange, ``MoEConfig.wire_dtype_dcn``
+additionally re-encodes the CROSS-SLICE hop at its own (narrower)
+dtype — fp8 across DCN while the in-slice ICI hop stays bf16/f32 —
+on both legs; default None inherits the leg wire (graph-identical to
+the single-dtype build).
 
 With ``MoEConfig.a2a_chunks = n`` the exchange additionally runs as a
 chunked software pipeline (Comet, arXiv 2502.19811): the ``[D, nLx, C,
@@ -92,28 +97,55 @@ def _hierarchical_a2a(t, axis: str, d: int, inner: int, *, reverse: bool):
 
     t: [D, ...] dest-major slabs (rank = outer * inner + inner_idx).
     Returns [D, ...] source-major, identical to a flat all_to_all.
+    Composed from :func:`_hier_stage` (one definition of the group
+    structure) so the per-hop wire path can never drift from it.
     """
-    outer = d // inner
-    inner_groups = [
-        [o * inner + i for i in range(inner)] for o in range(outer)
-    ]
-    outer_groups = [
-        [o * inner + j for o in range(outer)] for j in range(inner)
-    ]
-    rest = t.shape[1:]
-    t = t.reshape((outer, inner) + rest)
-    stages = [
-        (1, inner_groups),  # within-slice exchange over the inner coord
-        (0, outer_groups),  # cross-slice exchange over the outer coord
-    ]
+    stages = ["inner", "outer"]
     if reverse:
         stages = stages[::-1]
-    for ax, groups in stages:
-        t = jax.lax.all_to_all(
-            t, axis, split_axis=ax, concat_axis=ax, tiled=False,
-            axis_index_groups=groups,
-        )
+    for stage in stages:
+        t = _hier_stage(t, axis, d, inner, stage=stage)
+    return t
+
+
+def _hier_stage(t, axis: str, d: int, inner: int, *, stage: str):
+    """ONE hop of the two-stage exchange on a ``[D, ...]`` dest-major
+    array: ``stage='inner'`` is the within-slice ICI exchange,
+    ``stage='outer'`` the cross-slice DCN exchange.  Composing
+    inner-then-outer (or the reverse) reproduces
+    :func:`_hierarchical_a2a` exactly; the split exists so the per-hop
+    wire codec (``MoEConfig.wire_dtype_dcn``) can re-encode at the hop
+    boundary."""
+    outer = d // inner
+    rest = t.shape[1:]
+    t = t.reshape((outer, inner) + rest)
+    if stage == "inner":
+        ax = 1
+        groups = [[o * inner + i for i in range(inner)]
+                  for o in range(outer)]
+    else:
+        ax = 0
+        groups = [[o * inner + j for o in range(outer)]
+                  for j in range(inner)]
+    t = jax.lax.all_to_all(
+        t, axis, split_axis=ax, concat_axis=ax, tiled=False,
+        axis_index_groups=groups,
+    )
     return t.reshape((d,) + rest)
+
+
+def _staged_wired(t, wire_dtype, axis: str, d: int, inner: int, *,
+                  stage: str):
+    """One hierarchical hop with its own wire: encode at ``wire_dtype``
+    (None = raw), exchange payload (+fp8 scale sidecar) over that hop
+    only, decode back to the compute dtype before the next hop."""
+    if wire_dtype is None:
+        return _hier_stage(t, axis, d, inner, stage=stage)
+    payload, scales = wr.encode(t, wire_dtype)
+    payload = _hier_stage(payload, axis, d, inner, stage=stage)
+    if scales is not None:
+        scales = _hier_stage(scales, axis, d, inner, stage=stage)
+    return wr.decode(payload, scales, t.dtype)
 
 
 def _exchange(t, axis: str, d: int, dcn_inner: int | None, *,
@@ -130,12 +162,30 @@ def _exchange(t, axis: str, d: int, dcn_inner: int | None, *,
 
 
 def _wired_exchange(t, wire_dtype, axis: str, d: int,
-                    dcn_inner: int | None, *, reverse: bool):
+                    dcn_inner: int | None, *, reverse: bool,
+                    wire_dcn=None):
     """Exchange ``t`` ([D, ..., H], rows on the last axis), quantized to
     ``wire_dtype`` for the wire only (``None`` = raw — the graph is then
     exactly the pre-compression one).  For fp8 wires the per-row f32
     scales ride the same (flat or hierarchical) route as the payload, so
-    both hops of the two-stage exchange stay consistent."""
+    both hops of the two-stage exchange stay consistent.
+
+    ``wire_dcn`` (resolved ``MoEConfig.wire_dtype_dcn``): a distinct
+    wire for the CROSS-SLICE hop of the hierarchical exchange.  None
+    inherits ``wire_dtype`` — one encode covers both hops and the graph
+    is byte-identical to the single-dtype build (the default path
+    below, unchanged).  Set (and a slice blocking active), each hop
+    encodes independently: the ICI stage at the leg wire, the DCN stage
+    at ``wire_dcn`` — so e.g. an fp8 DCN hop under a raw/bf16 in-slice
+    hop.  Inert on the flat exchange (no DCN hop exists)."""
+    hier = dcn_inner is not None and 1 < dcn_inner < d
+    if wire_dcn is not None and hier:
+        stages = [("inner", wire_dtype), ("outer", wire_dcn)]
+        if reverse:
+            stages = stages[::-1]
+        for stage, wd in stages:
+            t = _staged_wired(t, wd, axis, d, dcn_inner, stage=stage)
+        return t
     if wire_dtype is None:
         return _exchange(t, axis, d, dcn_inner, reverse=reverse)
     payload, scales = wr.encode(t, wire_dtype)
@@ -169,6 +219,11 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
     cap = local_capacity(cfg, s_loc)
     wire_disp = wr.resolve(cfg.wire_dtype)
     wire_comb = wr.resolve(cfg.wire_dtype_combine)
+    # the DCN-hop override only exists on a two-stage exchange; resolve
+    # it to None otherwise so the flat transport traces the identical
+    # graph whatever the knob says (it has no DCN hop to re-encode)
+    hier_on = dcn_inner is not None and 1 < dcn_inner < d
+    wire_dcn = wr.resolve(cfg.wire_dtype_dcn) if hier_on else None
 
     # phase spans mirror the reference's NVTX "Flashmoe" domain
     # (telemetry.cuh): named HLO scopes so xprof traces show gate /
@@ -218,11 +273,17 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
 
     # exchange expert-major slabs: [E, C, H] -> [D, nLx, C, H] received
     wire_err = None
+    dcn_err = None
     send = xbuf.reshape(d, nlx, cap, h)
     if cfg.collect_stats and wire_disp is not None:
         # round-trip error proxy on the payload actually shipped —
         # stats-gated, so the stats-off graph carries no extra pass
         wire_err = wr.roundtrip_error(send, wire_disp)
+    if cfg.collect_stats and wire_dcn is not None:
+        # per-hop proxy for the DCN stage's own wire (wire_dtype_dcn):
+        # the same send payload quantized at the cross-slice dtype, so
+        # the flight recorder sees each hop's loss separately
+        dcn_err = wr.roundtrip_error(send, wire_dcn)
 
     if n_chunks > 1:
         # Chunked double-buffered pipeline (Comet, arXiv 2502.19811):
@@ -243,7 +304,8 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
                     recv_k = send_k
                 else:
                     recv_k = _wired_exchange(send_k, wire_disp, axis, d,
-                                             dcn_inner, reverse=False)
+                                             dcn_inner, reverse=False,
+                                             wire_dcn=wire_dcn)
                 if cfg.profile_phases:
                     prof.fence(recv_k)
             p_k = {kk: (v[lo:lo + nc] if kk in ffn_keys else v)
@@ -266,11 +328,16 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
                     err_k = wr.roundtrip_error(ysend_k, wire_comb)
                     comb_err = (err_k if comb_err is None
                                 else jnp.maximum(comb_err, err_k))
+                if cfg.collect_stats and wire_dcn is not None:
+                    errd_k = wr.roundtrip_error(ysend_k, wire_dcn)
+                    dcn_err = (errd_k if dcn_err is None
+                               else jnp.maximum(dcn_err, errd_k))
                 if skip_exchange:
                     yback_k = ysend_k
                 else:
                     yback_k = _wired_exchange(ysend_k, wire_comb, axis,
-                                              d, dcn_inner, reverse=True)
+                                              d, dcn_inner, reverse=True,
+                                              wire_dcn=wire_dcn)
                 if cfg.profile_phases:
                     prof.fence(yback_k)
             ybacks.append(yback_k)
@@ -287,7 +354,8 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
                 recv = send
             else:
                 recv = _wired_exchange(send, wire_disp, axis, d,
-                                       dcn_inner, reverse=False)
+                                       dcn_inner, reverse=False,
+                                       wire_dcn=wire_dcn)
                 # [D, nLx, C, H] — dim 0 now indexes source rank
             if cfg.profile_phases:
                 prof.fence(recv)
@@ -314,11 +382,16 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
                 comb_err = wr.roundtrip_error(ysend, wire_comb)
                 wire_err = (comb_err if wire_err is None
                             else jnp.maximum(wire_err, comb_err))
+            if cfg.collect_stats and wire_dcn is not None:
+                errd = wr.roundtrip_error(ysend, wire_dcn)
+                dcn_err = (errd if dcn_err is None
+                           else jnp.maximum(dcn_err, errd))
             if skip_exchange:
                 yback = ysend
             else:
                 yback = _wired_exchange(ysend, wire_comb, axis, d,
-                                        dcn_inner, reverse=True)
+                                        dcn_inner, reverse=True,
+                                        wire_dcn=wire_dcn)
                 # [D, nLx, C, H] — dim 0 indexes expert-owner rank
             if cfg.profile_phases:
                 prof.fence(yback)
@@ -356,8 +429,9 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
 
             stats = hlt.attach_degradation(stats, healthy, r.expert_idx,
                                            reduce_axes)
-        if wire_err is not None:
-            stats = st.with_wire_error(stats, wire_err, reduce_axes)
+        if wire_err is not None or dcn_err is not None:
+            stats = st.with_wire_error(stats, wire_err, reduce_axes,
+                                       dcn_error=dcn_err)
     return MoEOutput(out.astype(cfg.dtype), aux, z, counts, stats)
 
 

@@ -171,6 +171,62 @@ def arrival_order(adj: Adjacency, payload_mb: float,
     return order
 
 
+def _mock_slices(n: int) -> int | None:
+    """Parse ``FLASHMOE_MOCK_SLICES`` against a world of ``n`` devices.
+
+    Returns the slice count, or ``None`` when the mock is unset (or
+    asks for a single slice — no blocking).  Malformed values are a
+    configuration error the job must see at bootstrap, not a silent
+    fall-back to the flat transport (the pre-hardening guard was
+    parse-only): a non-integer, a non-positive count, or a count that
+    does not divide the world size all raise a ``ValueError`` naming
+    the world size and the accepted format (docs/PLANNER.md)."""
+    import os
+
+    raw = os.environ.get("FLASHMOE_MOCK_SLICES")
+    if raw is None or raw.strip() == "":
+        return None
+    try:
+        outer = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"FLASHMOE_MOCK_SLICES={raw!r} is not an integer; the mock "
+            f"format is a single positive slice count dividing the "
+            f"world size ({n} devices), e.g. FLASHMOE_MOCK_SLICES=2")
+    if outer < 1:
+        raise ValueError(
+            f"FLASHMOE_MOCK_SLICES={outer} must be >= 1 (a positive "
+            f"slice count dividing the world size, {n} devices)")
+    if outer > 1 and n % outer:
+        raise ValueError(
+            f"FLASHMOE_MOCK_SLICES={outer} does not divide the world "
+            f"size ({n} devices); pick a divisor of {n} so every mocked "
+            f"slice holds the same contiguous rank block")
+    return outer if outer > 1 else None
+
+
+def device_slice_ids(devices=None) -> list:
+    """Per-device slice membership ids, the ONE resolution every
+    consumer shares: ``FLASHMOE_MOCK_SLICES`` (validated by
+    :func:`_mock_slices`) partitions the device list into equal
+    contiguous blocks; otherwise ``device.slice_index`` with a
+    ``process_index`` fallback (0 for non-device objects).  Both the
+    blocking detector (:func:`slice_structure`) and the adjacency
+    builder (:func:`ici_adjacency`) read membership through this
+    helper, so a mocked topology gets DCN-priced edges in the Decider's
+    adjacency exactly like a real multislice job."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    mock = _mock_slices(n)
+    if mock is not None:
+        inner = n // mock
+        return [i // inner for i in range(n)]
+    sids = [getattr(d, "slice_index", None) for d in devices]
+    if any(s is None for s in sids):
+        sids = [getattr(d, "process_index", 0) for d in devices]
+    return sids
+
+
 def slice_structure(devices=None) -> tuple[int, int] | None:
     """Detect a (num_slices, ranks_per_slice) blocking of the device
     list, or None when it is a single slice / irregular.
@@ -189,27 +245,26 @@ def slice_structure(devices=None) -> tuple[int, int] | None:
     ``FLASHMOE_MOCK_SLICES=k`` partitions the first ``n`` devices into
     ``k`` equal contiguous "slices" regardless of their real topology —
     the virtual-mesh hook (CPU devices all share process 0) used by the
-    multislice tests and chaos drills.
+    multislice tests, the weak-scaling bench (``bench.py --scaling``)
+    and the chaos drills.  Malformed mock values (non-integer,
+    non-positive, non-divisor of ``n``) raise a ``ValueError`` naming
+    the world size (:func:`_mock_slices`) — a mis-typed mock must fail
+    the bootstrap, not silently run the flat transport.
     """
-    import os
-
     devices = list(devices if devices is not None else jax.devices())
-    n = len(devices)
-    mock = os.environ.get("FLASHMOE_MOCK_SLICES")
-    if mock:
-        try:
-            outer = int(mock)
-        except ValueError:
-            # malformed value = no mock blocking, matching the
-            # "irregular returns None" contract of the real detector
-            # (ADVICE round 5) — the flat transport stands
-            return None
-        if outer > 1 and n % outer == 0:
-            return outer, n // outer
-        return None
-    sids = [getattr(d, "slice_index", None) for d in devices]
-    if any(s is None for s in sids):
-        sids = [getattr(d, "process_index", 0) for d in devices]
+    return contiguous_blocking(device_slice_ids(devices))
+
+
+def contiguous_blocking(sids) -> tuple[int, int] | None:
+    """(num_blocks, block_size) of a contiguous equal-sized blocking of
+    a slice-id sequence, or None when it is single-valued / irregular —
+    the structural half of :func:`slice_structure`, public so the
+    bootstrap can derive the blocking of an ep PREFIX from the WORLD's
+    slice ids (re-running the mock on a subset would mis-partition it,
+    and reject world-valid mocks whose count does not divide the
+    subset)."""
+    sids = list(sids)
+    n = len(sids)
     uniq = sorted(set(sids))
     if len(uniq) <= 1:
         return None
@@ -237,7 +292,11 @@ def ici_adjacency(devices=None, platform: str | None = None) -> Adjacency:
     """Analytic alpha-beta adjacency for the device set.
 
     Devices on the same slice get torus-hop-scaled ICI costs; devices on
-    different slices (different ``slice_index``/process) get DCN costs.
+    different slices (different ``slice_index``/process — or different
+    mocked blocks under ``FLASHMOE_MOCK_SLICES``, via
+    :func:`device_slice_ids`) get DCN costs.  The mock therefore feeds
+    the Decider a genuinely heterogeneous adjacency, so DP x EP group
+    formation is CI-testable on the virtual CPU mesh.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
@@ -246,12 +305,11 @@ def ici_adjacency(devices=None, platform: str | None = None) -> Adjacency:
     dcn_lat_us, dcn_bw = _DCN_SPEC
 
     coords = []
-    slice_ids = []
+    slice_ids = device_slice_ids(devices)
     dims = None
     for d in devices:
         c = getattr(d, "coords", None)
-        coords.append(tuple(c) if c is not None else (d.id,))
-        slice_ids.append(getattr(d, "slice_index", getattr(d, "process_index", 0)))
+        coords.append(tuple(c) if c is not None else (getattr(d, "id", 0),))
     if coords and all(len(c) == len(coords[0]) for c in coords):
         dims = tuple(
             max(c[k] for c in coords) + 1 for k in range(len(coords[0]))

@@ -49,6 +49,10 @@ def main(argv=None) -> int:
     ap.add_argument("--wire-combine", default=None,
                     help="EP payload wire dtype for the combine leg "
                          "(default off — high-precision returns)")
+    ap.add_argument("--wire-dcn", default=None,
+                    help="per-hop wire for the CROSS-SLICE stage of "
+                         "the hierarchical a2a (fp8 across DCN; "
+                         "meaningful with --slices > 1)")
     ap.add_argument("--chunks", type=int, default=None,
                     help="price the chunked double-buffered a2a "
                          "pipeline at this depth "
@@ -73,6 +77,8 @@ def main(argv=None) -> int:
     if args.wire or args.wire_combine:
         cfg = cfg.replace(wire_dtype=args.wire,
                           wire_dtype_combine=args.wire_combine)
+    if args.wire_dcn:
+        cfg = cfg.replace(wire_dtype_dcn=args.wire_dcn)
     if args.chunks and args.chunks > 1:
         cfg = cfg.replace(a2a_chunks=args.chunks)
     gens = args.gen or list(GOLDEN_GENS)
@@ -99,6 +105,8 @@ def main(argv=None) -> int:
         if cfg.wire_dtype or cfg.wire_dtype_combine:
             wire_tag = (f" wire={cfg.wire_dtype or 'off'}/"
                         f"{cfg.wire_dtype_combine or 'off'}")
+        if cfg.wire_dtype_dcn:
+            wire_tag += f" wire_dcn={cfg.wire_dtype_dcn}"
         if cfg.a2a_chunks:
             wire_tag += f" chunks={cfg.a2a_chunks}"
         print(f"\n# {args.config}: E={cfg.num_experts} "

@@ -45,6 +45,15 @@ GOLDEN_RTOL = 1e-3
 # is priced at (planner.model.decode_shape) — frozen so the decode-vs-
 # training plan split (docs/SERVING.md) is itself golden-gated
 GOLDEN_DECODE_TOKENS = 64
+# the multi-slice weak-scaling dimension (ISSUE 13): the ep axis
+# spanning 1/2/4/8 DCN-connected slices at d=8.  At each scale the
+# planner's path/wire/chunk picks are frozen, along with the modeled
+# DCN serialization of the flat-uncompressed exchange vs the
+# hierarchical exchange with the fp8 DCN-hop wire — the acceptance gate
+# that fp8-across-DCN + per-slice-pair aggregation beats flat
+# (tests/test_planner.py::test_golden_slices_dimension_gates_dcn_wire)
+GOLDEN_SLICES = (1, 2, 4, 8)
+GOLDEN_WIRE_DCN = "e4m3"
 
 _TERMS = ("compute_ms", "hbm_ms", "ici_ms", "dcn_ms", "total_ms")
 
@@ -61,7 +70,7 @@ def golden_chunk_variants(cfg) -> dict:
                 and nlx_cfg % knobs["a2a_chunks"] == 0)}
 
 
-def _predicted_plan(cfg, gen: str, mode: str) -> dict:
+def _predicted_plan(cfg, gen: str, mode: str, slices: int = 1) -> dict:
     """Hermetic (prediction-only) plan for one (cfg, gen, mode) point:
     the fastest feasible prediction across the chunk sweep — the same
     sweep ``select_path(sweep_chunks=True)`` runs, minus the measured
@@ -73,7 +82,7 @@ def _predicted_plan(cfg, gen: str, mode: str) -> dict:
         cfg_n = (cfg if n == (cfg.a2a_chunks or 1)
                  else cfg.replace(a2a_chunks=None if n == 1 else n))
         preds = predict_paths(
-            cfg_n, GOLDEN_D, gen, mode=mode,
+            cfg_n, GOLDEN_D, gen, mode=mode, slices=slices,
             decode_tokens=GOLDEN_DECODE_TOKENS)
         pw = next((p for p in preds if p.feasible), None)
         if pw is None:
@@ -85,11 +94,43 @@ def _predicted_plan(cfg, gen: str, mode: str) -> dict:
             "chunks": pw.a2a_chunks, "total_ms": round(total, 6)}
 
 
+def _slice_point(cfg, gen: str, s: int) -> dict:
+    """One frozen weak-scaling point: the chunk-swept plan with the
+    wire off and with the fp8 DCN-hop wire, plus the modeled DCN
+    serialization of the flat-uncompressed vs hierarchical+fp8-DCN
+    exchanges (the acceptance comparison; ``None`` fields at s=1 —
+    a single slice has no DCN hop)."""
+    cfg_dcn = cfg.replace(wire_dtype_dcn=GOLDEN_WIRE_DCN)
+    point = {
+        "plan": _predicted_plan(cfg, gen, "training", slices=s),
+        "plan_dcn": _predicted_plan(cfg_dcn, gen, "training", slices=s),
+        "flat_dcn_ms": None, "hier_dcn_ms": None,
+        "hier_dcn_wins": None,
+    }
+    if s > 1:
+        flat = {p.path: p for p in predict_paths(cfg, GOLDEN_D, gen,
+                                                 slices=s)}
+        hier = {p.path: p for p in predict_paths(cfg_dcn, GOLDEN_D, gen,
+                                                 slices=s)}
+        f = flat["collective"].dcn_ms
+        h = hier["hierarchical"].dcn_ms
+        point.update(flat_dcn_ms=round(f, 6), hier_dcn_ms=round(h, 6),
+                     hier_dcn_wins=bool(h < f))
+    return point
+
+
 def golden_snapshot() -> dict:
     """Recompute the full golden structure from the live model."""
     from flashmoe_tpu.config import BENCH_CONFIGS
 
-    out = {"d": GOLDEN_D, "configs": {}, "decode": {}}
+    out = {"d": GOLDEN_D, "configs": {}, "decode": {}, "slices": {}}
+    for name in GOLDEN_CONFIGS:
+        cfg = BENCH_CONFIGS[name]
+        gens = {}
+        for gen in GOLDEN_GENS:
+            gens[gen] = {str(s): _slice_point(cfg, gen, s)
+                         for s in GOLDEN_SLICES}
+        out["slices"][name] = gens
     for name in GOLDEN_CONFIGS:
         cfg = BENCH_CONFIGS[name]
         gens = {}

@@ -121,6 +121,10 @@ class PathPrediction:
                                # transports; 1 = serial schedule; the
                                # fused rows always carry 1 — their
                                # in-kernel transport ignores the knob)
+    dp_allreduce_ms: float = 0.0  # DP gradient-ring share included in
+                               # serial_ms/total_ms (0 unless the
+                               # caller priced a dp axis; same value on
+                               # every row of one prediction set)
 
     @property
     def family(self) -> str:
@@ -149,7 +153,8 @@ def _ici_link(gen: str) -> tuple[float, float]:
 
 def a2a_leg_ms(slab: float, kind: str, *, d: int, gen: str,
                slices: int = 1, links: int = 4,
-               chunks: int = 1) -> tuple[float, float]:
+               chunks: int = 1,
+               dcn_slab: float | None = None) -> tuple[float, float]:
     """(ici_ms, dcn_ms) of ONE exchange leg moving a ``slab`` of bytes
     at its wire row size, per-message alpha multiplied by the chunk
     count (``analysis.a2a_transport_cost``).  Public because it is THE
@@ -159,17 +164,22 @@ def a2a_leg_ms(slab: float, kind: str, *, d: int, gen: str,
     each measured a2a phase through the same call, so planner and
     ledger can never price the same bytes differently.  ``kind``
     selects the ``a2a_transport_cost`` row when the exchange spans
-    slices (> 1); single-slice legs use the closed flat form."""
+    slices (> 1); single-slice legs use the closed flat form.
+    ``dcn_slab``: the slab at the CROSS-SLICE hop's own wire row size
+    (``MoEConfig.wire_dtype_dcn``; None = inherit ``slab``) — only the
+    hierarchical DCN stage re-encodes, so only that row's dcn term
+    moves."""
     a_ici, bw_link = _ici_link(gen)
     if slices > 1:
         t = a2a_transport_cost(d, d // slices, slab, gen=gen,
-                               links=links, chunks=chunks)[kind]
+                               links=links, chunks=chunks,
+                               dcn_slab_bytes=dcn_slab)[kind]
         return t["ici_ms"], t["dcn_ms"]
     return (d - 1) * (chunks * a_ici + slab / (bw_link * links)), 0.0
 
 
 def slab_bytes(cfg: MoEConfig, d: int, *, padded: bool = False,
-               leg: str = "dispatch") -> float:
+               leg: str = "dispatch", hop: str = "ici") -> float:
     """One (dest-rank) capacity slab: the unit both exchanges move.
     Public because the collective census
     (:mod:`flashmoe_tpu.staticcheck.census` via ``analysis.comm_census``)
@@ -183,7 +193,9 @@ def slab_bytes(cfg: MoEConfig, d: int, *, padded: bool = False,
     ``leg`` selects which exchange is priced: rows serialize at that
     leg's WIRE row size (``analysis.wire_row_bytes`` — compute row size
     when ``wire_dtype`` is off), so compression shrinks the ici/dcn
-    terms by the wire/compute itemsize ratio."""
+    terms by the wire/compute itemsize ratio.  ``hop`` ('ici'/'dcn')
+    selects the stage of a two-stage multi-slice exchange: 'dcn'
+    prices at the ``wire_dtype_dcn`` override when set."""
     from flashmoe_tpu.analysis import wire_row_bytes
     from flashmoe_tpu.parallel.ep import local_capacity
 
@@ -195,7 +207,38 @@ def slab_bytes(cfg: MoEConfig, d: int, *, padded: bool = False,
         # transport never compresses (config.py rejects fused + wire)
         cap = -(-cap // 32) * 32
         return nlx * cap * cfg.hidden_size * jnp.dtype(cfg.dtype).itemsize
-    return nlx * cap * wire_row_bytes(cfg, leg)
+    return nlx * cap * wire_row_bytes(cfg, leg, hop)
+
+
+def dp_allreduce_ms(cfg: MoEConfig, dp: int, gen: str, *,
+                    over_dcn: bool = False, links: int = 4) -> float:
+    """Per-step gradient-allreduce time of the DP axis, priced from the
+    Decider's ring model (:func:`flashmoe_tpu.parallel.decider.
+    ring_allreduce_ms`, the reference's ``ARArgs`` pricing): ``2(G-1)``
+    chunks of ``grad / G`` over the bottleneck hop — the host DCN NIC
+    when the DP groups live on different slices (``over_dcn=True``),
+    the chip's striped ICI links otherwise.  0 for inference jobs or
+    ``dp <= 1``.
+
+    This is the term that lets the planner trade EP-across-DCN against
+    DP-across-DCN (``select.scaleout_plan``): packing the ep axis
+    inside a slice frees the a2a from DCN but pushes the gradient ring
+    across it — whichever axis moves fewer bytes per step should own
+    the slow hop."""
+    if dp <= 1 or not cfg.is_training:
+        return 0.0
+    from flashmoe_tpu.parallel.decider import ring_allreduce_ms
+    from flashmoe_tpu.parallel.topology import _DCN_SPEC, _ICI_SPECS
+
+    grad_mb = (cfg.param_count
+               * jnp.dtype(cfg.param_dtype).itemsize) / 1e6
+    if over_dcn:
+        lat_us, gbps = _DCN_SPEC
+        beta = 1e3 / (gbps * 1e3)                       # ms per MB
+    else:
+        lat_us, gbps = _ICI_SPECS.get(gen, _ICI_SPECS["default"])
+        beta = 1e3 / (gbps * 1e3 * max(links, 1))
+    return ring_allreduce_ms(grad_mb, dp, beta, lat_us / 1e3)
 
 
 #: Default per-step decode token count priced when ``mode='decode'``
@@ -228,7 +271,8 @@ def decode_shape(cfg: MoEConfig, d: int = 1,
 def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
                   slices: int = 1, links: int = 4,
                   mxu_fraction: float = 1.0, mode: str = "training",
-                  decode_tokens: int | None = None
+                  decode_tokens: int | None = None,
+                  dp: int = 1, dp_over_dcn: bool = False
                   ) -> list[PathPrediction]:
     """Predict every candidate path's latency at (cfg, d ranks, gen).
 
@@ -236,6 +280,14 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
     single slice); ``links``: ICI links per chip serving the exchange;
     ``mxu_fraction``: achieved fraction of peak matmul throughput.
     Rows are returned fastest-first among feasible, infeasible last.
+
+    ``dp`` / ``dp_over_dcn``: price the DP axis's per-step gradient
+    allreduce (:func:`dp_allreduce_ms`, training only) into every row —
+    a constant across paths, so it never flips a path winner, but it
+    makes predictions comparable ACROSS slice mappings: EP spanning the
+    slices (``slices>1, dp_over_dcn=False``) vs EP packed per slice
+    with the DP ring riding DCN (``slices=1, dp_over_dcn=True``) — the
+    trade ``select.scaleout_plan`` makes.
 
     ``mode``: the pricing regime — ``'training'`` (default) prices the
     config's own B x S step; ``'decode'`` re-shapes it first
@@ -267,7 +319,12 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
 
     wire_tag = (f"{wr.canonical_name(cfg.wire_dtype)}/"
                 f"{wr.canonical_name(cfg.wire_dtype_combine)}")
+    wire_dcn_tag = wr.canonical_name(cfg.wire_dtype_dcn)
+    if wire_dcn_tag != "off":
+        wire_tag += f"/dcn:{wire_dcn_tag}"
     wire_on = wire_tag != "off/off"
+    ar_ms = dp_allreduce_ms(cfg, dp, gen, over_dcn=dp_over_dcn,
+                            links=links)
     n_chunks = cfg.a2a_chunks or 1
     if n_chunks > 1 and d > 1 and (cfg.num_experts // d) % n_chunks:
         raise ValueError(
@@ -280,14 +337,16 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
         compute_ms = cost.flops / (peak_fs * mxu_fraction) * 1e3
         hbm_ms = cost.total_bytes / hbm_bs * 1e3
         chip_ms = max(compute_ms, hbm_ms)
-        serial_ms = chip_ms + ici_ms + dcn_ms
+        # the DP gradient ring serializes after the step's MoE work on
+        # every path alike (ar_ms = 0 unless a dp axis was priced)
+        serial_ms = chip_ms + ici_ms + dcn_ms + ar_ms
         rows.append(PathPrediction(
             path=path, backend=BACKEND_OF[path], schedule=schedule,
             compute_ms=compute_ms, hbm_ms=hbm_ms, ici_ms=ici_ms,
             dcn_ms=dcn_ms, serial_ms=serial_ms,
-            total_ms=serial_ms if total_ms is None else total_ms,
+            total_ms=serial_ms if total_ms is None else total_ms + ar_ms,
             feasible=feasible, note=note, cost=cost, wire=wire,
-            a2a_chunks=chunks))
+            a2a_chunks=chunks, dp_allreduce_ms=ar_ms))
         return rows[-1]
 
     if d == 1:
@@ -301,9 +360,10 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
 
     from flashmoe_tpu.parallel.fused import schedule_table
 
-    def one_leg(slab, kind):
+    def one_leg(slab, dcn_slab=None, *, kind):
         return a2a_leg_ms(slab, kind, d=d, gen=gen, slices=slices,
-                          links=links, chunks=n_chunks)
+                          links=links, chunks=n_chunks,
+                          dcn_slab=dcn_slab)
 
     def xla_row(path, cost, slab_by_leg, kind, note):
         """One XLA-transport row: legs priced separately (each at its
@@ -311,8 +371,11 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
         report; with a2a_chunks > 1 the overlap-adjusted total is the
         chunked-pipeline makespan (``analysis.chunked_pipeline_ms``)
         instead of the serial sum — chunk k's FFN hides chunk k+1's
-        exchange on both legs."""
-        legs = [one_leg(slab, kind) for slab in slab_by_leg]
+        exchange on both legs.  ``slab_by_leg`` entries are either a
+        slab or a (slab, dcn_slab) pair — the hierarchical row prices
+        its DCN hop at the ``wire_dtype_dcn`` row size."""
+        legs = [one_leg(*(slab if isinstance(slab, tuple) else (slab,)),
+                        kind=kind) for slab in slab_by_leg]
         ici = sum(l[0] for l in legs)
         dcn = sum(l[1] for l in legs)
         total = None
@@ -337,9 +400,25 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
 
     # --- hierarchical two-stage ICI+DCN (multi-slice only) ------------
     if slices > 1:
+        # the DCN hop serializes at its own wire row size when
+        # wire_dtype_dcn is set (fp8 across DCN under a raw/bf16 ICI
+        # hop); the ICI hop stays at the leg wire.  At inner=1 (one
+        # rank per slice) the decomposition degenerates to the flat
+        # exchange — the layer gates the two-stage path on
+        # 1 < dcn_inner < d and never re-encodes there, so the row
+        # must not price a discount the transport cannot deliver.
+        dcn_applies = d // slices > 1 and wire_dcn_tag != "off"
+        hier_legs = [(slab_bytes(cfg, d, leg=leg),
+                      slab_bytes(cfg, d, leg=leg,
+                                 hop="dcn" if dcn_applies else "ici"))
+                     for leg in ("dispatch", "combine")]
+        hier_note = "one aggregated DCN message per slice pair"
+        if dcn_applies:
+            hier_note += f" [dcn hop {wire_dcn_tag}]"
+        elif wire_dcn_tag != "off":
+            hier_note += " [dcn wire inert: one rank per slice]"
         xla_row("hierarchical", path_costs(cfg, "explicit", d_world=d),
-                slab_legs, "hierarchical",
-                "one aggregated DCN message per slice pair" + wire_note)
+                hier_legs, "hierarchical", hier_note + wire_note)
 
     # --- ragged / dropless EP: routed rows, no capacity padding -------
     from flashmoe_tpu.analysis import wire_row_bytes

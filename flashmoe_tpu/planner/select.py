@@ -118,6 +118,7 @@ def _shape_key(cfg: MoEConfig, d: int) -> dict:
                 d=d, dtype=jnp.dtype(cfg.dtype).name,
                 wire=wr.canonical_name(cfg.wire_dtype),
                 wire_combine=wr.canonical_name(cfg.wire_dtype_combine),
+                wire_dcn=wr.canonical_name(cfg.wire_dtype_dcn),
                 chunks=cfg.a2a_chunks or 1)
 
 
@@ -138,7 +139,8 @@ def _bench_record_latencies(cfg: MoEConfig, d: int) -> dict:
            f"H={cfg.hidden_size},I={cfg.intermediate_size},"
            f"S={cfg.tokens},{jnp.dtype(cfg.dtype).name}")
     wire_sig = (wr.canonical_name(cfg.wire_dtype),
-                wr.canonical_name(cfg.wire_dtype_combine))
+                wr.canonical_name(cfg.wire_dtype_combine),
+                wr.canonical_name(cfg.wire_dtype_dcn))
     out: dict[str, float] = {}
 
     def keep(p, v):
@@ -161,7 +163,8 @@ def _bench_record_latencies(cfg: MoEConfig, d: int) -> dict:
                 # never overrides a selection without it (records
                 # without the fields are legacy = off / serial)
                 if (str(rec.get("wire_dtype", "off")),
-                        str(rec.get("wire_dtype_combine",
+                        str(rec.get("wire_dtype_combine", "off")),
+                        str(rec.get("wire_dtype_dcn",
                                     "off"))) != wire_sig:
                     continue
                 if int(rec.get("a2a_chunks", 1) or 1) != (
@@ -198,7 +201,8 @@ def select_path(cfg: MoEConfig, d: int = 1, gen: str | None = None, *,
                 record: bool = True,
                 sweep_chunks: bool = False,
                 mode: str = "training",
-                decode_tokens: int | None = None) -> Selection:
+                decode_tokens: int | None = None,
+                dp: int = 1, dp_over_dcn: bool = False) -> Selection:
     """Pick the execution path for (cfg, d ranks, gen).
 
     ``measured``: explicit {path_family: ms} overrides (highest
@@ -220,7 +224,13 @@ def select_path(cfg: MoEConfig, d: int = 1, gen: str | None = None, *,
     every downstream consumer (chunk candidates, measurement shape
     keys, predictions, the decision record) sees the decode-shaped
     problem; a decode measurement therefore keys at decode token
-    counts and can never override a training-shape selection."""
+    counts and can never override a training-shape selection.
+
+    ``dp`` / ``dp_over_dcn``: price the DP gradient allreduce into
+    every prediction (``planner.model.dp_allreduce_ms``) — constant
+    across paths, so it never changes which path wins here, but it
+    makes selections comparable across slice MAPPINGS; that comparison
+    is :func:`scaleout_plan`."""
     from flashmoe_tpu import tuning
     from flashmoe_tpu.planner.model import decode_shape
 
@@ -245,7 +255,8 @@ def select_path(cfg: MoEConfig, d: int = 1, gen: str | None = None, *,
         cfg_n = (cfg if n == (cfg.a2a_chunks or 1)
                  else cfg.replace(a2a_chunks=None if n == 1 else n))
         preds = predict_paths(cfg_n, d, gen, slices=slices, links=links,
-                              mxu_fraction=mxu_fraction)
+                              mxu_fraction=mxu_fraction, dp=dp,
+                              dp_over_dcn=dp_over_dcn)
         feasible = [p for p in preds if p.feasible]
         if not feasible:
             continue
@@ -417,3 +428,99 @@ def resolve_moe_backend(cfg: MoEConfig, mesh=None) -> str:
     """The moe_backend an ``moe_backend='auto'`` config should run —
     :func:`resolve_moe_plan` without the chunk component."""
     return resolve_moe_plan(cfg, mesh)[0]
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleoutPlan:
+    """The planner's verdict on how a multi-slice job should map its
+    DP x EP axes onto the slice topology (:func:`scaleout_plan`)."""
+
+    mapping: str                # 'ep_across_dcn' | 'dp_across_dcn'
+    ep: int                     # expert-parallel width
+    dp: int                     # data-parallel replica count
+    a2a_slices: int             # slices the ep a2a spans (1 = in-slice)
+    dp_over_dcn: bool           # the gradient ring rides DCN
+    predicted_ms: float         # winning mapping's per-step prediction
+    alternative_ms: float | None  # the losing mapping's (None when the
+                                # other mapping is infeasible)
+    selection: Selection        # the winner's full path selection
+    reason: str
+
+
+def scaleout_plan(cfg: MoEConfig, n_devices: int, n_slices: int,
+                  gen: str | None = None, *, links: int = 4,
+                  record: bool = True) -> ScaleoutPlan:
+    """Trade **EP-across-DCN** against **DP-across-DCN** for a job of
+    ``n_devices`` chips on ``n_slices`` DCN-connected slices — the
+    planner-side counterpart of the bootstrap Decider's group formation
+    (:func:`flashmoe_tpu.runtime.bootstrap.form_groups`), the tradeoff
+    the reference's Decider objective makes with its inter-group
+    allreduce term (``decider.cuh:60-158``).
+
+    Two candidate mappings of the same ``dp x ep`` factorization:
+
+    * ``ep_across_dcn`` — the ep axis spans every slice, so the expert
+      all-to-all pays the DCN hop (hierarchical two-stage exchange,
+      ``wire_dtype_dcn`` applies) while the DP gradient ring rides ICI
+      inside each slice;
+    * ``dp_across_dcn`` — the ep axis packs inside one slice (needs
+      ``ep <= n_devices // n_slices``), the a2a never leaves ICI, and
+      the gradient ring pays DCN instead
+      (``planner.model.dp_allreduce_ms`` with ``over_dcn=True``).
+
+    Whichever axis moves fewer bytes per step should own the slow hop;
+    each candidate is priced end to end through :func:`select_path`
+    (chunk sweep included) and the faster total wins.  Inference jobs
+    have no allreduce, so ``dp_across_dcn`` wins whenever it is
+    feasible.  Recorded as a ``planner.scaleout`` decision."""
+    if n_slices < 1 or n_devices % n_slices:
+        raise ValueError(
+            f"n_devices={n_devices} not divisible into "
+            f"{n_slices} slices")
+    inner = n_devices // n_slices
+    ep = min(cfg.ep if cfg.ep > 1 else n_devices, n_devices)
+    while cfg.num_experts % ep:
+        ep -= 1
+    dp = n_devices // ep
+
+    cands = []
+    if n_slices == 1 or ep % n_slices == 0:
+        # ep spans the slices evenly; dp replicas live inside slices
+        cands.append(("ep_across_dcn", n_slices, False))
+    if ep <= inner:
+        # ep packs in one slice; the dp ring crosses slices (when any)
+        cands.append(("dp_across_dcn", 1, n_slices > 1))
+    if not cands:
+        raise ValueError(
+            f"ep={ep} neither spans {n_slices} slices evenly nor fits "
+            f"one slice of {inner} ranks — no regular DP x EP mapping")
+
+    priced = []
+    for mapping, a2a_slices, over_dcn in cands:
+        sel = select_path(cfg, ep, gen, slices=a2a_slices, links=links,
+                          record=False, sweep_chunks=True, dp=dp,
+                          dp_over_dcn=over_dcn)
+        priced.append((sel.predicted_ms, mapping, a2a_slices, over_dcn,
+                       sel))
+    priced.sort(key=lambda t: t[0])
+    win_ms, mapping, a2a_slices, over_dcn, sel = priced[0]
+    alt_ms = priced[1][0] if len(priced) > 1 else None
+    reason = (f"{mapping} predicts {win_ms:.3f} ms"
+              + (f" vs {alt_ms:.3f} ms" if alt_ms is not None
+                 else " (only regular mapping)"))
+    plan = ScaleoutPlan(mapping=mapping, ep=ep, dp=dp,
+                        a2a_slices=a2a_slices, dp_over_dcn=over_dcn,
+                        predicted_ms=win_ms, alternative_ms=alt_ms,
+                        selection=sel, reason=reason)
+    if record:
+        metrics.decision(
+            "planner.scaleout", mapping=mapping, ep=ep, dp=dp,
+            n_devices=n_devices, n_slices=n_slices,
+            a2a_slices=a2a_slices, dp_over_dcn=over_dcn,
+            winner=sel.winner, backend=sel.backend,
+            a2a_chunks=sel.a2a_chunks,
+            predicted_ms=round(win_ms, 4),
+            alternative_ms=(round(alt_ms, 4) if alt_ms is not None
+                            else None),
+            reason=reason)
+    return plan

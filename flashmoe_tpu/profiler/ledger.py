@@ -87,14 +87,26 @@ def predicted_phase_ms(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
 
     if d > 1:
         def leg(which: str) -> float:
+            # the DCN-wire override only applies where the layer runs
+            # the two-stage exchange (1 < inner < d, ep.py transport —
+            # the same guard predict_paths uses: never price a discount
+            # the transport cannot deliver).  The ragged transport is
+            # flat-only (no per-hop codec), so it never re-encodes.
+            hop = ("dcn" if path != "ragged"
+                   and d // max(slices, 1) > 1 else "ici")
             if path == "ragged":
                 slab = rows / d * wire_row_bytes(cfg, which)
+                dcn_slab = rows / d * wire_row_bytes(cfg, which, hop)
             else:
                 slab = slab_bytes(cfg, d, leg=which)
+                dcn_slab = slab_bytes(cfg, d, leg=which, hop=hop)
             # THE per-leg formula (planner.model.a2a_leg_ms): ledger
             # and planner can never price the same bytes differently
+            # (the dcn slab rides the wire_dtype_dcn row size when the
+            # cross-slice hop re-encodes)
             ici, dcn = a2a_leg_ms(slab, "hierarchical", d=d, gen=gen,
-                                  slices=slices, links=links, chunks=n)
+                                  slices=slices, links=links, chunks=n,
+                                  dcn_slab=dcn_slab)
             return ici + dcn
 
         out["moe.a2a_dispatch"] = leg("dispatch")

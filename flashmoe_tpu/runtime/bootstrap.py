@@ -25,10 +25,107 @@ from flashmoe_tpu.config import MoEConfig
 from flashmoe_tpu.parallel.decider import Placement, decide, uniform_placement
 from flashmoe_tpu.parallel.mesh import make_mesh
 from flashmoe_tpu.parallel.topology import (
-    ici_adjacency, measured_worker_attrs, merge_dcn_costs, probe_dcn_costs,
+    device_slice_ids, ici_adjacency, measured_worker_attrs,
+    merge_dcn_costs, probe_dcn_costs, slice_structure,
 )
 
 _runtime: Optional["Runtime"] = None
+
+
+@dataclasses.dataclass
+class GroupPlan:
+    """Decider-driven DP x EP group formation (ISSUE 13 / ROADMAP 5):
+    how a (measured or ``FLASHMOE_MOCK_SLICES``-mocked) slice topology
+    maps onto the job's parallelism axes.
+
+    ``mapping``:
+
+    * ``'single'`` — one slice (or one decider group on it): the ep
+      axis owns every device, no DCN structure to exploit;
+    * ``'ep_across_dcn'`` — the ep axis (each EP group) spans the
+      slices: the expert a2a runs the two-stage hierarchical exchange
+      (``dcn_inner`` set; ``MoEConfig.wire_dtype_dcn`` applies) while
+      any DP replication rides inside slices;
+    * ``'dp_across_dcn'`` — the Decider kept one EP group per slice
+      (DCN too expensive for per-step a2a relative to the gradient
+      ring): the a2a never leaves ICI, DP crosses DCN;
+    * ``'irregular'`` — the Decider's groups do not form equal
+      contiguous blocks the (dp, ep) mesh grid can express: group
+      structure is recorded but the single-group fold stands.
+    """
+
+    dp: int
+    ep: int
+    mapping: str
+    slices: tuple[int, int] | None   # (n_slices, ranks_per_slice)
+    dcn_inner: int | None            # two-stage a2a blocking of the ep axis
+    groups: list
+    placement: Placement
+
+
+def form_groups(cfg: MoEConfig, devices, adj=None, workers=None, *,
+                expert_costs=None) -> GroupPlan:
+    """Run the Decider over the (DCN-aware) adjacency and classify its
+    groups into a DP x EP mapping the mesh can express.
+
+    The adjacency prices cross-slice pairs at DCN cost
+    (``topology.ici_adjacency`` via ``device_slice_ids`` — mocked
+    slices included), so the Decider's merge objective makes the
+    EP-across-DCN vs DP-across-DCN trade the reference makes with its
+    inter-group allreduce term (``decider.cuh:60-158``); the
+    planner-side counterpart is
+    :func:`flashmoe_tpu.planner.select.scaleout_plan`.  ``expert_costs``
+    (observed load histogram) additionally routes the within-group
+    assignment through the slice-aware cost-sorted multiset
+    (:func:`flashmoe_tpu.parallel.decider.assign_experts_sliced`) so
+    hot top-k companion pairs co-locate inside a slice."""
+    devices = list(devices)
+    n = len(devices)
+    ss = slice_structure(devices)
+    sids = device_slice_ids(devices)
+    if adj is None:
+        adj = ici_adjacency(devices)
+    if workers is None:
+        workers = measured_worker_attrs(devices, cfg, probe=False)
+    placement = decide(adj, workers, cfg, slice_of=sids,
+                       expert_costs=expert_costs)
+    groups = placement.groups
+
+    def blocked(size: int) -> bool:
+        """Groups are exactly the contiguous rank blocks of ``size``
+        (the only structure the (dp, ep) mesh grid can express)."""
+        want = [list(range(i, i + size)) for i in range(0, n, size)]
+        return sorted(map(tuple, groups)) == sorted(map(tuple, want))
+
+    gsz = len(groups[0]) if groups else n
+    regular = (len(groups) >= 1 and all(len(g) == gsz for g in groups)
+               and gsz * len(groups) == n and blocked(gsz)
+               and cfg.num_experts % gsz == 0)
+    if not regular:
+        ep = n
+        while cfg.num_experts % ep:
+            ep -= 1
+        inner = ss[1] if ss else None
+        hier = (inner is not None and 1 < inner < ep
+                and ep % inner == 0)
+        return GroupPlan(dp=1, ep=ep, mapping="irregular", slices=ss,
+                         dcn_inner=inner if hier else None,
+                         groups=groups, placement=placement)
+    dp, ep = len(groups), gsz
+    inner = ss[1] if ss else None
+    if ss is None or dp == 1 and (inner is None or ep <= inner):
+        mapping, dcn_inner = "single", None
+    elif inner is not None and ep > inner and ep % inner == 0:
+        # each EP group spans slices: two-stage a2a inside the group
+        mapping, dcn_inner = "ep_across_dcn", inner
+    elif inner is not None and ep <= inner and inner % ep == 0:
+        mapping = "dp_across_dcn" if dp > 1 else "single"
+        dcn_inner = None
+    else:
+        mapping, dcn_inner = "irregular", None
+    return GroupPlan(dp=dp, ep=ep, mapping=mapping, slices=ss,
+                     dcn_inner=dcn_inner, groups=groups,
+                     placement=placement)
 
 
 @dataclasses.dataclass
@@ -47,6 +144,9 @@ class Runtime:
     # all-to-all in the collective EP path (the reference's per-peer
     # P2P-vs-remote transport duality, bootstrap.cuh:442-446)
     dcn_inner: int | None = None
+    # Decider-driven DP x EP group formation (form_groups): None on
+    # single-device / decider-off bootstraps
+    group_plan: "GroupPlan | None" = None
 
     @property
     def num_local_experts(self) -> int:
@@ -118,34 +218,65 @@ def initialize(cfg: MoEConfig | dict | str | None = None, *,
 
     devices = jax.devices()
     n = len(devices)
+    ep_pinned = cfg.ep > 1
     # fold requested ep down to the available device count
     ep = min(cfg.ep if cfg.ep > 1 else n, n)
     while cfg.num_experts % ep:
         ep -= 1
     cfg = cfg.replace(ep=max(1, ep))
-    mesh = make_mesh(cfg)
 
     if measure is None:
         measure = jax.process_count() > 1 or devices[0].platform != "cpu"
     src_order = None
+    plan = None
     if use_decider and n > 1:
         adj = ici_adjacency(devices)
         if measure and jax.process_count() > 1:
             adj = merge_dcn_costs(adj, probe_dcn_costs(), devices)
         attrs = measured_worker_attrs(devices, cfg, probe=measure)
-        placement = decide(adj, attrs, cfg)
+        plan = form_groups(cfg, devices, adj=adj, workers=attrs)
+        placement = plan.placement
+        if (not ep_pinned and plan.mapping in ("ep_across_dcn",
+                                               "dp_across_dcn")
+                and plan.ep >= 1 and cfg.num_experts % plan.ep == 0):
+            # adopt the Decider's DP x EP factorization: each decider
+            # group becomes one EP shard group, replicas ride the dp
+            # axis (a user-pinned ep always stands)
+            cfg = cfg.replace(ep=plan.ep)
+        from flashmoe_tpu.utils.telemetry import metrics
+
+        metrics.decision(
+            "bootstrap.groups", mapping=plan.mapping,
+            dp=plan.dp, ep=plan.ep, adopted_ep=cfg.ep,
+            slices=list(plan.slices) if plan.slices else None,
+            dcn_inner=plan.dcn_inner,
+            groups=[list(g) for g in plan.groups],
+            ep_pinned=ep_pinned)
         src_order = _heterogeneous_src_order(adj, cfg, n)
     else:
         placement = uniform_placement(n, cfg)
 
-    from flashmoe_tpu.parallel.topology import slice_structure
+    mesh = make_mesh(cfg)
+    if plan is not None and cfg.ep == plan.ep:
+        dcn_inner = plan.dcn_inner
+    else:
+        # blocking of the ep PREFIX, derived from the WORLD's slice
+        # membership (mock validated against the world size once —
+        # re-running the mock on the subset would mis-partition it and
+        # reject world-valid mocks that don't divide the folded ep)
+        from flashmoe_tpu.parallel.topology import contiguous_blocking
 
-    ss = slice_structure(devices[:cfg.ep]) if cfg.ep > 1 else None
+        ss = (contiguous_blocking(device_slice_ids(devices)[:cfg.ep])
+              if cfg.ep > 1 else None)
+        # inner == 1 (one rank per slice) degenerates to the flat
+        # exchange — publish None, matching the layer's gate
+        dcn_inner = ss[1] if ss and 1 < ss[1] < cfg.ep else None
     _runtime = Runtime(
         cfg=cfg, mesh=mesh, placement=placement,
         num_processes=jax.process_count(), process_id=jax.process_index(),
         src_order=src_order,
-        dcn_inner=ss[1] if ss else None,
+        dcn_inner=dcn_inner,
+        group_plan=plan,
     )
     return _runtime
 

@@ -33,6 +33,14 @@ step boundaries only — performs two graduated recovery actions:
   combine merges contributions unchanged.  Triggered by a sustained
   step-time regression (a slow/degraded device).
 
+A third, narrower morph axis exists on multi-slice jobs (ISSUE 13):
+when the phase ledger's a2a legs dominate the step
+(``observe_step(metrics_dict={'phase_ms': ...})`` feeding the
+``a2a_share_high`` trigger), the **wire morph** flips
+``MoEConfig.wire_dtype_dcn`` so the two-stage exchange's DCN hop ships
+fp8 — its own budget (``wire_morph_budget``), the same
+cooldown/manifest discipline, recorded as ``controller.wire_morph``.
+
 Oscillation is impossible by construction: every action starts a
 cooldown window (triggers during it are recorded as
 ``controller.cooldown`` decisions, not acted on), each action class has
@@ -83,6 +91,15 @@ class ControllerConfig:
     # --- slow trigger (drives re-placement) ---
     slow_factor: float = 1.5       # step_ms EMA > factor * baseline
     baseline_steps: int = 3        # baseline = min of the first N steps
+    # --- a2a-dominance trigger (drives the DCN wire morph, ISSUE 13;
+    #     armed only on multi-slice jobs — RuntimeController(slices=)) ---
+    enable_wire_morph: bool = True
+    a2a_share_high: float = 0.5    # a2a legs' share of the phase-ledger
+    #                                sum above which the exchange (and on
+    #                                a multi-slice job its DCN leg, the
+    #                                slowest hop) dominates the step
+    wire_morph_dtype: str = "e4m3"  # the DCN-hop wire the morph enables
+    wire_morph_budget: int = 1
     # --- dynamics ---
     debounce_steps: int = 3        # consecutive triggering observations
     cooldown_steps: int = 8        # no action for N steps after one
@@ -107,6 +124,8 @@ class ControllerConfig:
             raise ValueError("ema_decay must be in (0, 1)")
         if self.slow_factor <= 1.0:
             raise ValueError("slow_factor must be > 1")
+        if not 0 < self.a2a_share_high < 1:
+            raise ValueError("a2a_share_high must be in (0, 1)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -140,6 +159,29 @@ class ReplaceAction:
     @property
     def needs_rebuild(self) -> bool:
         return bool(self.overrides)
+
+
+def detected_slices() -> int:
+    """Slices the running job's ep axis spans — the default for
+    :class:`RuntimeController`'s ``slices`` so production loops
+    (``resilient_train`` / ``supervise`` / ``trainer.train``) arm the
+    DCN wire morph without every call site learning the axis: the
+    bootstrapped GroupPlan when a runtime exists, else live slice
+    detection; 1 on any failure (detection must never block a step
+    boundary)."""
+    try:
+        from flashmoe_tpu.runtime import bootstrap
+
+        rt = bootstrap._runtime
+        if rt is not None and rt.group_plan is not None \
+                and rt.group_plan.slices:
+            return int(rt.group_plan.slices[0])
+        from flashmoe_tpu.parallel.topology import slice_structure
+
+        ss = slice_structure()
+        return int(ss[0]) if ss else 1
+    except Exception:  # noqa: BLE001 — degrade to single-slice
+        return 1
 
 
 #: MoE param leaves stacked on a leading expert axis (permuted by
@@ -227,7 +269,8 @@ class RuntimeController:
                  ccfg: ControllerConfig | None = None, *,
                  metrics: Metrics | None = None,
                  rates_fn=None, n_devices: int | None = None,
-                 d: int | None = None, gen: str | None = None):
+                 d: int | None = None, gen: str | None = None,
+                 slices: int | None = None):
         self.cfg = cfg
         self.ccfg = ccfg or ControllerConfig()
         self.metrics = metrics if metrics is not None else _global
@@ -240,6 +283,12 @@ class RuntimeController:
                 f"num_experts={cfg.num_experts}")
         self.d = int(d) if d is not None else self.n_devices
         self.gen = gen
+        # slices the ep axis spans (bootstrap's GroupPlan / mocked):
+        # the DCN wire morph only makes sense when a DCN hop exists.
+        # Default (None) auto-detects, so the production loops arm the
+        # axis on real multi-slice jobs without passing it through
+        self.slices = (int(slices) if slices is not None
+                       else detected_slices())
         # --- live signal state ---
         self.load_ema: np.ndarray | None = None   # [E] slot loads
         self.imbalance_ema: float | None = None
@@ -253,12 +302,17 @@ class RuntimeController:
         self._last_step_ms: float | None = None
         self.baseline_ms: float | None = None
         self._baseline_seen: list[float] = []
+        # a2a-leg share of the phase ledger (ISSUE 13 wire morph)
+        self.a2a_share_ema: float | None = None
+        self._last_a2a_share: float | None = None
         self._skew_run = 0
         self._slow_run = 0
+        self._a2a_run = 0
         # --- persistent (manifest-riding) state ---
         self.overrides: dict = {}
         self.morphs_used = 0
         self.replaces_used = 0
+        self.wire_morphs_used = 0
         self.cooldown_until = -1
         self.timeline: list[dict] = []
         self._cooldown_logged: set = set()
@@ -283,6 +337,23 @@ class RuntimeController:
             self.baseline_ms = min(self._baseline_seen)
         self.step_ms_ema = self._ema(self.step_ms_ema, float(step_ms))
         self._last_step_ms = float(step_ms)
+
+        # phase-ledger a2a-leg share (the profiler's PhaseTimeline /
+        # cost-ledger phase_ms dict — moe.a2a_dispatch[.k] +
+        # moe.a2a_combine[.k] over every measured moe.* phase): the
+        # signal the DCN wire morph debounces on
+        self._last_a2a_share = None
+        if isinstance(metrics_dict, dict):
+            phases = metrics_dict.get("phase_ms")
+            if isinstance(phases, dict) and phases:
+                tot = sum(float(v) for v in phases.values())
+                a2a = sum(float(v) for k, v in phases.items()
+                          if str(k).startswith("moe.a2a_"))
+                if tot > 0:
+                    share = a2a / tot
+                    self.a2a_share_ema = self._ema(self.a2a_share_ema,
+                                                   share)
+                    self._last_a2a_share = share
 
         stats = None
         if isinstance(metrics_dict, dict):
@@ -318,6 +389,10 @@ class RuntimeController:
             self._slow_run += 1
         else:
             self._slow_run = 0
+        if self._a2a_active():
+            self._a2a_run += 1
+        else:
+            self._a2a_run = 0
 
     def _skew_active(self) -> bool:
         # instantaneous values: the debounce counts CONSECUTIVE skewed
@@ -335,6 +410,14 @@ class RuntimeController:
                 and len(self._baseline_seen) >= self.ccfg.baseline_steps
                 and self._last_step_ms
                 > self.ccfg.slow_factor * self.baseline_ms)
+
+    def _a2a_active(self) -> bool:
+        # instantaneous like the other debounces; gated on the job
+        # actually having a DCN hop to narrow and the knob being off
+        return (self.slices > 1
+                and self._last_a2a_share is not None
+                and self._last_a2a_share > self.ccfg.a2a_share_high
+                and self._current_cfg().wire_dtype_dcn is None)
 
     def device_load_share(self, device: int) -> float:
         """Observed load share of one device's slot block under the
@@ -375,10 +458,13 @@ class RuntimeController:
         c = self.ccfg
         skew = self._skew_run >= c.debounce_steps and c.enable_morph
         slow = self._slow_run >= c.debounce_steps and c.enable_replace
-        if not (skew or slow):
+        wire = (self._a2a_run >= c.debounce_steps
+                and c.enable_wire_morph and self.slices > 1)
+        if not (skew or slow or wire):
             return None
         if step < self.cooldown_until:
-            for name, hit in (("skew", skew), ("slow", slow)):
+            for name, hit in (("skew", skew), ("slow", slow),
+                              ("a2a", wire)):
                 key = (name, self.cooldown_until)
                 if hit and key not in self._cooldown_logged:
                     self._cooldown_logged.add(key)
@@ -399,12 +485,18 @@ class RuntimeController:
             act = self._plan_morph(step)
             if act is not None:
                 return act
+            if step < self.cooldown_until:
+                return None
+        if wire and self.wire_morphs_used < c.wire_morph_budget \
+                and can_rebuild:
+            return self._plan_wire_morph(step)
         return None
 
     def _cooldown(self, step: int) -> None:
         self.cooldown_until = step + self.ccfg.cooldown_steps
         self._skew_run = 0
         self._slow_run = 0
+        self._a2a_run = 0
         # a fresh baseline: the action changed what "normal" looks like
         self._baseline_seen = []
         self.baseline_ms = None
@@ -451,6 +543,35 @@ class RuntimeController:
             budget_left=self.ccfg.morph_budget - self.morphs_used,
             reason=plan.reason)
         return MorphAction(dict(plan.overrides), "skew", plan.reason)
+
+    def _plan_wire_morph(self, step: int):
+        """Wire-dtype morph (ROADMAP item 3 follow-up / ISSUE 13): the
+        phase ledger shows the a2a legs dominating the step on a
+        multi-slice job, so narrow the DCN hop — flip
+        ``wire_dtype_dcn`` to the configured fp8 wire and let the
+        runner re-jit, with the same cooldown / budget / manifest
+        discipline as a path morph.  The two-stage exchange then ships
+        ~4x fewer DCN bytes while the in-slice hop keeps the compute
+        dtype (quality guarded by the ``wire_rtq_error_dcn`` proxy in
+        MoEStats)."""
+        overrides = {"wire_dtype_dcn": self.ccfg.wire_morph_dtype}
+        self.overrides.update(overrides)
+        self.wire_morphs_used += 1
+        self._cooldown(step)
+        self._decide(
+            "controller.wire_morph", step=step, trigger="a2a",
+            wire_dtype_dcn=self.ccfg.wire_morph_dtype,
+            a2a_share_ema=(round(self.a2a_share_ema, 4)
+                           if self.a2a_share_ema is not None else None),
+            slices=self.slices,
+            budget_left=(self.ccfg.wire_morph_budget
+                         - self.wire_morphs_used),
+            reason="a2a legs dominate the phase ledger on a "
+                   "multi-slice job: narrow the DCN hop to "
+                   f"{self.ccfg.wire_morph_dtype}")
+        return MorphAction(overrides, "a2a",
+                           "DCN-hop wire narrowed after sustained "
+                           "a2a-leg dominance")
 
     def _probe_rates(self):
         """Default ``rates_fn``: live per-device throughput re-probe
@@ -568,6 +689,7 @@ class RuntimeController:
         return {"overrides": ov,
                 "morphs_used": self.morphs_used,
                 "replaces_used": self.replaces_used,
+                "wire_morphs_used": self.wire_morphs_used,
                 "timeline": list(self.timeline)}
 
     def load_state_dict(self, sd: dict) -> None:
@@ -584,6 +706,8 @@ class RuntimeController:
                                int(sd.get("morphs_used", 0)))
         self.replaces_used = max(self.replaces_used,
                                  int(sd.get("replaces_used", 0)))
+        self.wire_morphs_used = max(self.wire_morphs_used,
+                                    int(sd.get("wire_morphs_used", 0)))
         stored = list(sd.get("timeline") or [])
         if len(stored) > len(self.timeline):
             self.timeline = stored

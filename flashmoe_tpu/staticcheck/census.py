@@ -25,7 +25,11 @@ Reconciliation rules with documented slack (docs/STATIC_ANALYSIS.md):
   the transport); ``path_costs.comm_bytes`` counts read+write — exact
   factor 2;
 * hierarchical staging: each two-stage exchange moves the full local
-  buffer twice — exact factor 2 per leg vs flat;
+  buffer twice — exact factor 2 per leg vs flat when both hops share
+  one wire; with a per-hop DCN wire (``MoEConfig.wire_dtype_dcn``) the
+  two stages price at their OWN row sizes (ici hop at the leg wire,
+  dcn hop at the dcn wire), each cross-checked against ``path_costs``
+  of the matching single-wire config;
 * ragged dense fallback: the CPU arm pads every transfer to the
   worst-case bound — exact factor ``d x chunks`` vs the uniform-routing
   expectation the model prices (the TPU ``ragged_all_to_all`` arm moves
@@ -53,6 +57,15 @@ RTOL = 1e-6
 CENSUS_PATHS = ("collective", "hierarchical", "ragged")
 CENSUS_D = 8              # golden.GOLDEN_D: the 8-rank virtual mesh
 CENSUS_DCN_INNER = 4      # hierarchical blocking: 2 slices of 4 ranks
+
+#: census-only wire variants beyond golden.GOLDEN_WIRES: the per-hop
+#: DCN wire (MoEConfig.wire_dtype_dcn, ISSUE 13).  On the hierarchical
+#: path the outer stage re-encodes at fp8 (its own payload+sidecar
+#: eqns, priced at the dcn row size); on the FLAT paths the knob is
+#: inert and the rows double-check it prices as off.  Kept out of
+#: GOLDEN_WIRES because the planner's golden tables are computed at
+#: slices=1, where the variant would just duplicate the base rows.
+CENSUS_EXTRA_WIRES = {"dcn-e4m3": {"wire_dtype_dcn": "e4m3"}}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,7 +98,8 @@ def census_matrix():
 
     for name in GOLDEN_CONFIGS:
         base = BENCH_CONFIGS[name]
-        for wtag, wknobs in GOLDEN_WIRES.items():
+        wire_variants = dict(GOLDEN_WIRES, **CENSUS_EXTRA_WIRES)
+        for wtag, wknobs in wire_variants.items():
             for ctag, cknobs in golden_chunk_variants(base).items():
                 cfg = base.replace(ep=CENSUS_D, **wknobs, **cknobs)
                 for path in CENSUS_PATHS:

@@ -120,6 +120,19 @@ KNOBS: tuple[KnobSpec, ...] = (
         doc="EP combine-leg payload compression; off = bit-identical, "
             "fp8-free graph"),
     KnobSpec(
+        "wire_dtype_dcn", off_values=(None,),
+        on={"wire_dtype_dcn": "e4m3"},
+        backends=("hierarchical",),
+        off_rules=("fp8_free",), on_rules=("fp8_present",),
+        doc="per-hop wire for the CROSS-SLICE (DCN) stage of the "
+            "two-stage exchange (parallel/ep.py _wired_exchange): set, "
+            "both legs re-encode their DCN hop at this dtype while the "
+            "ICI hop keeps the leg wire; None inherits the leg wire — "
+            "graph-identical to the single-dtype build.  Hierarchical "
+            "backend only: the flat transports have no DCN hop, so the "
+            "knob is inert (= off graph) there, which the census's "
+            "flat rows double-check"),
+    KnobSpec(
         "a2a_chunks", off_values=(None, 1), on={"a2a_chunks": 2},
         backends=("collective", "hierarchical", "ragged"),
         on_rules=("chunked_a2a_count",),

@@ -109,8 +109,9 @@ def measured_path_latencies(gen: str | None = None, **shape) -> dict:
          "match": {"path": "fused", "h": 2048, "i": 2048, "d": 8},
          "measured_ms": 2.71}
 
-    The ``wire`` / ``wire_combine`` keys (EP payload compression,
-    ``MoEConfig.wire_dtype``) and the ``chunks`` key (chunked a2a
+    The ``wire`` / ``wire_combine`` / ``wire_dcn`` keys (EP payload
+    compression, ``MoEConfig.wire_dtype`` family — ``wire_dcn`` is the
+    cross-slice hop override) and the ``chunks`` key (chunked a2a
     pipeline depth, ``MoEConfig.a2a_chunks``) are matched STRICTLY
     with implicit ``"off"`` / ``1`` defaults on both sides: a latency
     measured with compression or chunking on is never applied to a run
@@ -134,7 +135,7 @@ def measured_path_latencies(gen: str | None = None, **shape) -> dict:
             continue
         if any(str(m.pop(wk, dv)) != str(shape.get(wk, dv))
                for wk, dv in (("wire", "off"), ("wire_combine", "off"),
-                              ("chunks", 1))):
+                              ("wire_dcn", "off"), ("chunks", 1))):
             continue
         if all(shape.get(kk) == v for kk, v in m.items()):
             if path not in best or len(m) > best[path][0]:
@@ -156,7 +157,7 @@ ENTRY_SCHEMA = {
 #: keys an entry ``match`` dict may constrain (shape facts + the
 #: measurement-identity knobs the lookups compare strictly)
 MATCH_KEYS = {"h", "i", "e", "k", "s", "d", "cap", "dtype", "path",
-              "wire", "wire_combine", "chunks"}
+              "wire", "wire_combine", "wire_dcn", "chunks"}
 
 
 def validate_entries(doc) -> list[str]:
@@ -195,7 +196,8 @@ def validate_entries(doc) -> list[str]:
                 problems.append(
                     f"{where}: unknown match key {mk!r}; known: "
                     f"{sorted(MATCH_KEYS)}")
-            elif mk in ("dtype", "path", "wire", "wire_combine"):
+            elif mk in ("dtype", "path", "wire", "wire_combine",
+                        "wire_dcn"):
                 if not isinstance(mv, str):
                     problems.append(
                         f"{where}: match.{mk} must be a string, got "

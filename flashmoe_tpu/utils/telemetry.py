@@ -46,6 +46,9 @@ import jax
 #: generated from this dict (:func:`decision_table_markdown`) and the
 #: lint's doc-sync rule keeps the two aligned.
 DECISION_NAMES: dict[str, str] = {
+    "bootstrap.groups":
+        "the Decider formed DP x EP groups from the measured/mocked "
+        "slice topology at bootstrap",
     "checkpoint.async_error":
         "a background async save failed (surfaced, not raised)",
     "checkpoint.emergency_save":
@@ -65,6 +68,9 @@ DECISION_NAMES: dict[str, str] = {
     "controller.replace":
         "the self-healing controller re-placed/replicated experts "
         "mid-job",
+    "controller.wire_morph":
+        "the controller flipped the DCN-hop wire dtype after sustained "
+        "a2a-leg dominance on a multi-slice job",
     "planner.backend_constraint":
         "auto pick demoted to a backend the config can actually run",
     "planner.drift":
@@ -75,6 +81,9 @@ DECISION_NAMES: dict[str, str] = {
         "measured overlap fraction compared against the chunked bound",
     "planner.path_select":
         "moe_backend='auto' resolved a path (full latency breakdown)",
+    "planner.scaleout":
+        "the planner traded EP-across-DCN against DP-across-DCN for a "
+        "multi-slice job",
     "preempt.drain":
         "graceful drain completed: final step, remaining grace",
     "preempt.notice":

@@ -363,3 +363,93 @@ def test_cli_emits_json_error_fast_when_backend_dead():
     rec = json.loads(r.stdout.strip().splitlines()[-1])
     assert rec["value"] == -1 and "error" in rec
     assert r.returncode == 2
+
+
+def test_cli_scaling_plumbs_sweep_and_knobs(monkeypatch):
+    """`bench.py --scaling` hands the weak-scaling sweep its trials and
+    wire/chunk knobs (wire-dcn included — the knob the sweep exists to
+    measure)."""
+    import sys as _sys
+
+    import bench
+
+    seen = {}
+
+    def fake_scaling(trials, *, wire_dtype=None, wire_combine=None,
+                     wire_dcn=None, a2a_chunks=None):
+        seen.update(trials=trials, wire_dtype=wire_dtype,
+                    wire_dcn=wire_dcn, a2a_chunks=a2a_chunks)
+
+    monkeypatch.setattr(bench, "_bench_scaling", fake_scaling)
+    monkeypatch.setattr(_sys, "argv",
+                        ["bench.py", "--scaling", "--trials", "3",
+                         "--wire-dcn", "e4m3", "--a2a-chunks", "2",
+                         "--deadline", "0"])
+    bench.main()
+    assert seen == {"trials": 3, "wire_dtype": None,
+                    "wire_dcn": "e4m3", "a2a_chunks": 2}
+
+
+def test_cli_scaling_flag_exclusivity(monkeypatch, capsys):
+    """--scaling fail-fasts on modes it would silently ignore, and
+    --wire-dcn is rejected outside --scaling (no other mode runs a
+    cross-slice hop)."""
+    import sys as _sys
+
+    import bench
+
+    cases = [
+        ["bench.py", "--scaling", "--overlap", "4"],
+        ["bench.py", "--scaling", "--ckpt"],
+        ["bench.py", "--scaling", "--tiles"],
+        ["bench.py", "--scaling", "--serve"],
+        ["bench.py", "--wire-dcn", "e4m3"],
+        ["bench.py", "--wire-dcn", "e4m3", "--overlap", "4"],
+    ]
+    for argv in cases:
+        monkeypatch.setattr(_sys, "argv", argv)
+        with pytest.raises(SystemExit) as e:
+            bench.main()
+        assert e.value.code == 2, argv
+        capsys.readouterr()
+
+
+def test_cli_scaling_emits_skipped_record_when_probe_hangs(monkeypatch,
+                                                           capsys):
+    """The probe fail-fast contract on real hardware
+    (FLASHMOE_OVERLAP_TPU=1): a wedged tunnel yields ONE well-formed
+    skipped:true scaling record and rc 0 — never a hang, never an
+    ambiguous rc 2."""
+    import sys as _sys
+
+    import bench
+
+    monkeypatch.setenv("FLASHMOE_OVERLAP_TPU", "1")
+    monkeypatch.setattr(
+        bench, "_probe_backend_retry",
+        lambda budget_s, each_s=90, max_attempts=0:
+        (False, "backend probe hung >10s after 2 attempts / 20s", True))
+    monkeypatch.setattr(
+        bench, "_bench_scaling",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("sweep must not run on a hung probe")))
+    monkeypatch.setattr(_sys, "argv",
+                        ["bench.py", "--scaling", "--probe-attempts",
+                         "2"])
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["skipped"] is True
+    assert rec["metric"] == "scaling_ms[slices]"
+    assert rec["value"] is None and "hung" in rec["reason"]
+    # a dead (non-hung) backend still errors rc 2 with the reason
+    monkeypatch.setattr(
+        bench, "_probe_backend_retry",
+        lambda budget_s, each_s=90, max_attempts=0:
+        (False, "backend probe rc=1: boom", False))
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 2
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["error"].startswith("backend probe rc=1")

@@ -248,6 +248,36 @@ def test_degrade_masks_nan_expert_through_chunked_fp8_pipeline(devices):
 
 
 @pytest.mark.slow
+def test_degrade_masks_nan_expert_through_fp8_dcn_hop(devices):
+    """Tier-0 masking through the PER-HOP wire pipeline (ISSUE 13): a
+    two-stage multi-slice exchange whose cross-slice hop re-encodes at
+    e4m3 (wire_dtype_dcn, in-slice hop raw) with a chunked pipeline on
+    top.  The poisoned expert's NaN must survive encode -> inner a2a ->
+    decode -> fp8 re-encode -> DCN a2a -> decode (ops/wire.py:
+    non-finite rows decode non-finite, per hop) before the health mask
+    sees it — the through-the-wire guarantee extended to the fp8 DCN
+    hop."""
+    from flashmoe_tpu.parallel.ep import ep_moe_layer
+
+    cfg = MoEConfig(num_experts=16, expert_top_k=2, hidden_size=64,
+                    intermediate_size=128, sequence_len=256, ep=8,
+                    a2a_chunks=2, wire_dtype_dcn="e4m3",
+                    collect_stats=True, **F32)
+    mesh = make_mesh(cfg, dp=1, devices=devices[:8])
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (cfg.tokens, 64),
+                          jnp.float32)
+    inject.arm("nan_expert", expert=5)
+    sick_off = ep_moe_layer(params, x, cfg, mesh, dcn_inner=4)
+    assert not bool(np.isfinite(np.asarray(sick_off.out)).all())
+    on = cfg.replace(degrade_unhealthy_experts=True)
+    sick_on = ep_moe_layer(params, x, on, mesh, dcn_inner=4)
+    assert bool(np.isfinite(np.asarray(sick_on.out)).all())
+    assert float(sick_on.stats.masked_experts) == 8.0
+    assert float(sick_on.stats.masked_fraction) > 0.0
+
+
+@pytest.mark.slow
 def test_degrade_ragged_ep_layer(devices):
     from flashmoe_tpu.parallel.ragged_ep import ragged_ep_moe_layer
 

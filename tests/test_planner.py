@@ -251,11 +251,19 @@ def test_divisibility_errors():
         a2a_transport_cost(8, 3, 1e6)           # ADVICE r5: no silent //
 
 
-def test_mock_slices_garbage_falls_back_to_flat(monkeypatch):
+def test_mock_slices_garbage_is_loud_but_never_blocks_trace(monkeypatch):
+    """Hardened mock parsing (ISSUE 13 satellite): garbage raises a
+    ValueError naming the world size at the detection layer, while the
+    planner's auto resolution — which must never die inside a trace —
+    degrades to the single-slice flat pricing."""
     from flashmoe_tpu.parallel.topology import slice_structure
+    from flashmoe_tpu.planner.select import resolve_moe_plan
 
     monkeypatch.setenv("FLASHMOE_MOCK_SLICES", "banana")
-    assert slice_structure(devices=list(range(8))) is None
+    with pytest.raises(ValueError, match="8 devices"):
+        slice_structure(devices=list(range(8)))
+    backend, _ = resolve_moe_plan(REF.replace(moe_backend="auto", ep=8))
+    assert backend in ("collective", "ragged", "fused")
     monkeypatch.setenv("FLASHMOE_MOCK_SLICES", "2")
     assert slice_structure(devices=list(range(8))) == (2, 4)
 
@@ -419,3 +427,151 @@ def test_hierarchical_beats_flat_on_dcn_messages():
     preds = {p.path: p for p in predict_paths(cfg, 16, "v5e", slices=4)}
     assert preds["hierarchical"].dcn_ms < preds["collective"].dcn_ms
     assert not preds["fused[batched]"].feasible  # intra-slice only
+
+
+# ----------------------------------------------------------------------
+# Multi-slice scale-out (ISSUE 13): per-hop wires, DP allreduce,
+# EP-vs-DP-across-DCN trade, golden slices dimension
+# ----------------------------------------------------------------------
+
+def test_hierarchical_dcn_wire_shrinks_dcn_term_only():
+    """wire_dtype_dcn prices the DCN hop at the fp8 row size: the
+    hierarchical row's dcn_ms shrinks, its ici_ms is untouched, and
+    the flat row never sees the knob (no re-encode hop)."""
+    base = {p.path: p for p in predict_paths(REF, 8, "v5e", slices=4)}
+    dcn = {p.path: p for p in predict_paths(
+        REF.replace(wire_dtype_dcn="e4m3"), 8, "v5e", slices=4)}
+    assert dcn["hierarchical"].dcn_ms < base["hierarchical"].dcn_ms
+    assert dcn["hierarchical"].ici_ms == base["hierarchical"].ici_ms
+    assert dcn["collective"].dcn_ms == base["collective"].dcn_ms
+    assert "dcn:e4m3" in dcn["hierarchical"].wire
+    # the fused rows are disqualified under any wire, dcn included
+    for pname, p in dcn.items():
+        if pname.startswith("fused"):
+            assert not p.feasible, pname
+
+
+def test_dcn_wire_discount_not_priced_at_one_rank_per_slice():
+    """slices == d degenerates the two-stage exchange to flat (the
+    layer gates on 1 < dcn_inner < d), so the planner must not price
+    the DCN-wire discount there."""
+    base = {p.path: p for p in predict_paths(REF, 8, "v5e", slices=8)}
+    dcn = {p.path: p for p in predict_paths(
+        REF.replace(wire_dtype_dcn="e4m3"), 8, "v5e", slices=8)}
+    assert dcn["hierarchical"].dcn_ms == base["hierarchical"].dcn_ms
+    assert "inert" in dcn["hierarchical"].note
+
+
+def test_dp_allreduce_priced_from_decider_ring_model():
+    """The DP axis's gradient ring (decider.ring_allreduce_ms): 0 for
+    inference/dp=1, DCN pricing > ICI pricing, and the term rides every
+    row of a prediction set identically (never flips a path winner)."""
+    from flashmoe_tpu.planner.model import dp_allreduce_ms
+
+    tr = REF.replace(is_training=True)
+    assert dp_allreduce_ms(REF, 4, "v5e") == 0.0          # inference
+    assert dp_allreduce_ms(tr, 1, "v5e") == 0.0           # no dp axis
+    ici = dp_allreduce_ms(tr, 4, "v5e", over_dcn=False)
+    dcn = dp_allreduce_ms(tr, 4, "v5e", over_dcn=True)
+    assert 0.0 < ici < dcn
+    preds = predict_paths(tr, 8, "v5e", dp=4, dp_over_dcn=True)
+    assert all(p.dp_allreduce_ms == pytest.approx(dcn) for p in preds)
+    bare = {p.path: p.total_ms for p in predict_paths(tr, 8, "v5e")}
+    for p in preds:
+        assert p.total_ms == pytest.approx(bare[p.path] + dcn, rel=1e-6)
+
+
+def test_scaleout_plan_trades_ep_against_dp_across_dcn():
+    """The EP-vs-DP-across-DCN trade: a training job with a heavy
+    gradient keeps the DP ring off DCN (ep_across_dcn); the same job in
+    inference mode — no allreduce at all — packs the a2a inside a slice
+    (dp_across_dcn).  Both mappings priced, loser recorded."""
+    from flashmoe_tpu.planner.select import scaleout_plan
+
+    cfg = REF.replace(ep=8)
+    train = scaleout_plan(cfg.replace(is_training=True), 32, 4, "v5e",
+                          record=False)
+    assert train.mapping == "ep_across_dcn"
+    assert (train.ep, train.dp) == (8, 4)
+    assert train.a2a_slices == 4 and not train.dp_over_dcn
+    assert train.alternative_ms is not None
+    assert train.predicted_ms < train.alternative_ms
+    infer = scaleout_plan(cfg, 32, 4, "v5e", record=False)
+    assert infer.mapping == "dp_across_dcn"
+    assert infer.a2a_slices == 1 and infer.dp_over_dcn
+    with pytest.raises(ValueError, match="slices"):
+        scaleout_plan(cfg, 32, 5, "v5e", record=False)
+
+
+def test_scaleout_decision_lands_in_telemetry():
+    from flashmoe_tpu.planner.select import scaleout_plan
+
+    scaleout_plan(REF.replace(ep=8), 32, 4, "v5e")
+    rec = metrics.last_decision("planner.scaleout")
+    assert rec is not None and rec["mapping"] in ("ep_across_dcn",
+                                                  "dp_across_dcn")
+    assert rec["n_slices"] == 4 and rec["predicted_ms"] > 0
+
+
+def test_golden_slices_dimension_gates_dcn_wire():
+    """The golden `slices` dimension (ISSUE 13 acceptance): every
+    (config, gen) point freezes the planner's picks at 1/2/4/8 slices,
+    matches the live model, and at the 4-slice point the
+    hierarchical+e4m3-DCN-hop row beats flat-uncompressed on modeled
+    DCN ms."""
+    from flashmoe_tpu.planner.golden import GOLDEN_SLICES, golden_snapshot
+
+    live, frozen = golden_snapshot(), load_golden()
+    assert set(live["slices"]) == set(frozen["slices"])
+    for cname, gens in frozen["slices"].items():
+        for gen, points in gens.items():
+            assert set(points) == {str(s) for s in GOLDEN_SLICES}
+            for s, g in points.items():
+                l = live["slices"][cname][gen][s]
+                for plan_key in ("plan", "plan_dcn"):
+                    assert l[plan_key]["winner"] == g[plan_key]["winner"], (
+                        f"slices winner flipped for {cname}@{gen}"
+                        f"[slices={s},{plan_key}]: "
+                        f"{g[plan_key]['winner']} -> "
+                        f"{l[plan_key]['winner']}; regenerate with "
+                        f"python -m flashmoe_tpu.planner --regen-golden")
+                    assert l[plan_key]["chunks"] == g[plan_key]["chunks"]
+                    assert l[plan_key]["total_ms"] == pytest.approx(
+                        g[plan_key]["total_ms"], rel=GOLDEN_RTOL)
+                for term in ("flat_dcn_ms", "hier_dcn_ms"):
+                    if g[term] is None:
+                        assert l[term] is None and s == "1"
+                    else:
+                        assert l[term] == pytest.approx(
+                            g[term], rel=GOLDEN_RTOL)
+                assert l["hier_dcn_wins"] == g["hier_dcn_wins"]
+            # THE acceptance criterion: 4-slice mesh, fp8 DCN hop +
+            # per-slice-pair aggregation beats flat-uncompressed
+            p4 = points["4"]
+            assert p4["hier_dcn_wins"] is True, (cname, gen)
+            assert p4["hier_dcn_ms"] < p4["flat_dcn_ms"], (cname, gen)
+
+
+def test_select_path_keys_measurements_on_dcn_wire(tmp_path,
+                                                   monkeypatch):
+    """A latency measured with the DCN-hop wire on never overrides a
+    selection without it (and vice versa) — the wire_dcn key rides the
+    measurement identity like wire/wire_combine/chunks."""
+    import json as _json
+
+    rec = {"metric": f"moe_layer_fwd_ms[x:E={REF.num_experts},"
+                     f"k={REF.expert_top_k},H={REF.hidden_size},"
+                     f"I={REF.intermediate_size},S={REF.tokens},"
+                     f"bfloat16]",
+           "value": 0.001, "path": "collective", "d": 8,
+           "wire_dtype": "off", "wire_dtype_combine": "off",
+           "wire_dtype_dcn": "e4m3"}
+    p = tmp_path / "records.jsonl"
+    p.write_text(_json.dumps(rec) + "\n")
+    monkeypatch.setenv("FLASHMOE_BENCH_RECORDS", str(p))
+    sel_off = select_path(REF, 8, "v5e", record=False)
+    assert sel_off.mode == "predicted"       # dcn-wire record ignored
+    sel_on = select_path(REF.replace(wire_dtype_dcn="e4m3"), 8, "v5e",
+                         record=False)
+    assert sel_on.mode == "measured"
+    assert sel_on.measured_ms == pytest.approx(0.001)

@@ -95,7 +95,9 @@ def test_cli_all_json(capsys, devices):
     doc = json.loads(capsys.readouterr().out)
     assert doc["ok"] and doc["violations"] == []
     assert set(doc["engines"]) == {"lint", "invariants", "census"}
-    assert len(doc["engines"]["census"]["rows"]) == 30
+    # 3 configs x (2 golden + 1 census-only dcn) wires x chunk variants
+    # x 3 paths (declared skips included)
+    assert len(doc["engines"]["census"]["rows"]) == 45
 
 
 def test_cli_exits_nonzero_on_violation(tmp_path):
@@ -174,8 +176,9 @@ def test_planted_mispriced_model_flagged(monkeypatch, devices):
     import flashmoe_tpu.analysis as an
 
     orig = an.wire_row_bytes
-    monkeypatch.setattr(an, "wire_row_bytes",
-                        lambda cfg, leg="dispatch": orig(cfg, leg) / 2)
+    monkeypatch.setattr(
+        an, "wire_row_bytes",
+        lambda cfg, leg="dispatch", hop="ici": orig(cfg, leg, hop) / 2)
     violations, _rows = run_census(
         configs=["reference"], wires=["off"], chunks=["serial"],
         paths=["collective"], devices=devices)
