@@ -285,8 +285,35 @@ def _emit(cfg, name, t_fused, t_xla, note: str | None = None):
 # duplicate decisions.
 _OBS: list = [None, 0]
 
+# Perf-sentry collection (--regression): [history path or None, the
+# run's emitted records].  Armed in main(); _finish_regression()
+# appends ONE run entry to obs/history.jsonl when the mode completes —
+# skipped/partial/error records never enter the baseline
+# (telemetry_plane/regression.py filters them).
+_REG: list = [None, []]
+
+
+def _finish_regression():
+    if not _REG[0] or not _REG[1]:
+        return
+    try:
+        from flashmoe_tpu.telemetry_plane import regression as reg
+
+        points = reg.collect_points(_REG[1])
+        entry = reg.append_run(_REG[0], points,
+                               meta={"argv": sys.argv[1:]})
+        if entry:
+            print(f"# perf sentry: appended {len(points)} metric "
+                  f"point(s) to {_REG[0]}", file=sys.stderr, flush=True)
+    except Exception as e:  # noqa: BLE001 — history is best-effort
+        print(f"# regression history write failed: "
+              f"{type(e).__name__}: {str(e)[:120]}",
+              file=sys.stderr, flush=True)
+
 
 def _flush_observability(rec: dict):
+    if _REG[0] is not None:
+        _REG[1].append(rec)
     if not _OBS[0]:
         return
     try:
@@ -392,17 +419,22 @@ def _bench_profile(obs_dir: str | None, *, steps: int = 1,
         _flush_observability(rec)
 
 
-def _bench_serve(loads, *, requests: int, max_batch: int):
+def _bench_serve(loads, *, requests: int, max_batch: int,
+                 telemetry_port: int | None = None):
     """Offered-load serving sweep (``--serve``): the continuous-
     batching engine (flashmoe_tpu/serving/) driven by a seeded arrival
     trace at each offered-load point, one JSON record per point with
     throughput (tokens/sec), TTFT/TPOT percentiles, queue depth, cache
     occupancy, and evictions — the latency/throughput curve.  CPU-
-    sized model; identical procedure on real chips."""
+    sized model; identical procedure on real chips.
+    ``telemetry_port`` arms the live scrape plane for the sweep's
+    duration; each record then carries a mid-sweep ``/metrics``
+    self-scrape (``telemetry_scrape``)."""
     from flashmoe_tpu.serving.loadgen import serve_load_sweep
 
     for rec in serve_load_sweep(loads, n_requests=requests,
-                                max_batch=max_batch):
+                                max_batch=max_batch,
+                                telemetry_port=telemetry_port):
         print(json.dumps(rec), flush=True)
         _flush_observability(rec)
 
@@ -1026,6 +1058,16 @@ def main():
                     help="requests per --serve load point")
     ap.add_argument("--serve-batch", type=int, default=4,
                     help="engine decode-batch width for --serve")
+    ap.add_argument("--telemetry-port", type=int, default=None,
+                    metavar="PORT",
+                    help="with --serve: arm the live scrape plane for "
+                         "the sweep and self-scrape /metrics mid-sweep "
+                         "into each record (0 = ephemeral port)")
+    ap.add_argument("--regression", action="store_true",
+                    help="append this run's metric points to "
+                         "obs/history.jsonl for the perf sentry "
+                         "(`observe --regression`); headline, --serve, "
+                         "--profile and --scaling modes")
     ap.add_argument("--deadline", type=int, default=720,
                     help="wall-clock watchdog (s) for the measurement "
                          "itself, armed AFTER the backend probe succeeds; "
@@ -1072,6 +1114,21 @@ def main():
                          "summarized by `python -m flashmoe_tpu.observe`)")
     args = ap.parse_args()
     _OBS[0] = args.obs_dir
+
+    # live-plane flag contracts (the --profile/--ckpt fail-fast rule:
+    # refuse flags a mode would silently ignore)
+    if args.telemetry_port is not None and not args.serve:
+        ap.error("--telemetry-port applies with --serve only (the "
+                 "live scrape plane rides the serving sweep; the "
+                 "train CLIs take their own --telemetry-port)")
+    if args.regression and (args.ckpt or args.overlap or args.sweep
+                            or args.tiles):
+        ap.error("--regression appends measured runs from the "
+                 "headline bench, --serve, --profile, or --scaling; "
+                 "drop --ckpt/--overlap/--sweep/--tiles")
+    _REG[0] = (os.path.join(args.obs_dir or "obs", "history.jsonl")
+               if args.regression else None)
+    _REG[1].clear()
 
     # the headline record's identity follows the mode, so a tiles-sweep
     # or scaling-sweep skip/error is machine-distinguishable from a
@@ -1158,6 +1215,7 @@ def main():
                        wire_combine=args.wire_combine,
                        wire_dcn=args.wire_dcn,
                        a2a_chunks=args.a2a_chunks)
+        _finish_regression()
         return
     if args.tiles:
         # the --profile/--ckpt fail-fast contract: refuse knobs/modes
@@ -1196,6 +1254,7 @@ def main():
             signal.alarm(args.deadline)  # virtual-mesh path: no probe leg
         _bench_profile(args.obs_dir, steps=args.profile_steps,
                        quick=args.profile_quick)
+        _finish_regression()
         return
     if args.profile_steps != 1:
         ap.error("--profile-steps only applies with "
@@ -1222,7 +1281,9 @@ def main():
         if args.deadline > 0:
             signal.alarm(args.deadline)  # host+CPU path: no probe leg
         _bench_serve(loads, requests=args.serve_requests,
-                     max_batch=args.serve_batch)
+                     max_batch=args.serve_batch,
+                     telemetry_port=args.telemetry_port)
+        _finish_regression()
         return
     if args.ckpt:
         if args.deadline > 0:
@@ -1310,6 +1371,7 @@ def main():
         emit_best_partial(f"{type(e).__name__}: {str(e)[:300]}")
         return
     _emit(cfg, args.config, t_fused, t_xla)
+    _finish_regression()
 
 
 if __name__ == "__main__":

@@ -23,14 +23,23 @@ Usage::
     python -m flashmoe_tpu.observe --ledger obs/ledger.jsonl
     python -m flashmoe_tpu.observe --serving obs/flight.jsonl obs/decisions.jsonl
     python -m flashmoe_tpu.observe --postmortem /path/to/bundles
+    python -m flashmoe_tpu.observe --trace 3 obs/trace.jsonl
+    python -m flashmoe_tpu.observe --merge obs/telemetry.*.jsonl
+    python -m flashmoe_tpu.observe --regression --ci [obs/history.jsonl]
 
 ``--ledger`` renders the per-phase predicted-vs-measured cost ledger
 (:mod:`flashmoe_tpu.profiler.ledger` artifacts / ``planner.phase_drift``
 decision dumps); ``--serving`` renders the serving-engine report
-(TTFT/TPOT percentiles, queue depth, cache occupancy, the prefill-vs-
-decode planner split — docs/SERVING.md); ``--postmortem`` renders a
-triage report of the crash bundle(s) written by
-:mod:`flashmoe_tpu.profiler.postmortem`.
+(TTFT/TPOT percentiles through the shared bounded-memory quantile
+sketch, queue depth, cache occupancy, the prefill-vs-decode planner
+split — docs/SERVING.md); ``--postmortem`` renders a triage report of
+the crash bundle(s) written by
+:mod:`flashmoe_tpu.profiler.postmortem`; ``--trace <rid>`` renders one
+request's end-to-end timeline (eviction gaps included) from
+``serve_trace_span`` records; ``--merge`` folds per-host telemetry
+shards into one fleet view; ``--regression`` runs the perf sentry over
+``obs/history.jsonl`` (``--ci`` exits rc 2 on a tolerance breach) —
+docs/OBSERVABILITY.md "Live telemetry plane".
 """
 
 from __future__ import annotations
@@ -418,65 +427,94 @@ def serving_report(records: list[dict]) -> dict:
     ``serve_request`` records / ``serve.retire`` decisions, the
     admission/eviction narrative, the decode-vs-prefill planner split
     (``serve.plan``), and serving SLO breaches (``slo.breach`` with
-    target ttft/tpot)."""
-    steps = [r for r in records if r.get("kind") == "serve_step"]
-    req_recs = [r for r in records if r.get("kind") == "serve_request"]
-    retires = [r for r in records
-               if r.get("decision") == "serve.retire"]
-    # the one serving percentile definition, shared with the bench
-    # sweep's records so the two surfaces can never disagree on p99
-    from flashmoe_tpu.serving.loadgen import pctl
+    target ttft/tpot).
 
-    per_req = req_recs or retires
-    ttfts = [float(r["ttft_ms"]) for r in per_req
-             if isinstance(r.get("ttft_ms"), (int, float))]
-    tpots = [float(r["tpot_ms"]) for r in per_req
-             if isinstance(r.get("tpot_ms"), (int, float))]
-    tokens = sum(int(r.get("tokens", 0)) for r in steps)
-    wall_ms = sum(float(r.get("step_ms", 0.0)) for r in steps)
-    qd = [int(r["queue_depth"]) for r in steps
-          if isinstance(r.get("queue_depth"), (int, float))]
-    occ = [float(r["cache_occupancy"]) for r in steps
-           if isinstance(r.get("cache_occupancy"), (int, float))]
-    act = [int(r["active"]) for r in steps
-           if isinstance(r.get("active"), (int, float))]
-    plan = next((r for r in reversed(records)
-                 if r.get("decision") == "serve.plan"), None)
-    slo = [r for r in records if r.get("decision") == "slo.breach"
-           and r.get("target") in ("ttft", "tpot")]
+    Percentiles run through the shared bounded-memory quantile sketch
+    (telemetry_plane/sketch.py) — the same definition the engine's live
+    ``/metrics`` summaries use, nearest-rank exact below 64
+    observations (= ``loadgen.pctl`` on every CI-sized drill) and
+    O(1)-memory P² beyond, so a million-request dump aggregates in
+    constant space instead of retaining full history."""
+    from flashmoe_tpu.telemetry_plane.sketch import QuantileSketch
+
+    steps = n_steps = 0
+    tokens = 0
+    wall_ms = 0.0
+    tt, tp = QuantileSketch(), QuantileSketch()
+    qd, occ, act = QuantileSketch(), QuantileSketch(), QuantileSketch()
+    rids: set = set()
+    seen_req_recs = False
+    plan = None
+    admissions = evictions = slo_ttft = slo_tpot = 0
+    for r in records:
+        kind, dec = r.get("kind"), r.get("decision")
+        if kind == "serve_step":
+            n_steps += 1
+            tokens += int(r.get("tokens", 0))
+            wall_ms += float(r.get("step_ms", 0.0))
+            if isinstance(r.get("queue_depth"), (int, float)):
+                qd.observe(r["queue_depth"])
+            if isinstance(r.get("cache_occupancy"), (int, float)):
+                occ.observe(r["cache_occupancy"])
+            if isinstance(r.get("active"), (int, float)):
+                act.observe(r["active"])
+        elif kind == "serve_request" or (dec == "serve.retire"
+                                         and not seen_req_recs):
+            # serve_request flight records win; retire decisions are
+            # the fallback when no flight dump is present (same values)
+            if kind == "serve_request" and not seen_req_recs:
+                seen_req_recs = True
+                tt, tp = QuantileSketch(), QuantileSketch()
+                rids = set()
+            rids.add(r.get("rid"))
+            if isinstance(r.get("ttft_ms"), (int, float)):
+                tt.observe(r["ttft_ms"])
+            if isinstance(r.get("tpot_ms"), (int, float)):
+                tp.observe(r["tpot_ms"])
+        if dec == "serve.plan":
+            plan = r
+        elif dec == "serve.admit":
+            admissions += 1
+        elif dec == "serve.evict":
+            evictions += 1
+        elif dec == "slo.breach":
+            if r.get("target") == "ttft":
+                slo_ttft += 1
+            elif r.get("target") == "tpot":
+                slo_tpot += 1
+    steps = n_steps
+
+    def rnd(v, nd=3):
+        return round(v, nd) if v is not None else None
+
+    slo = slo_ttft or slo_tpot
     return {
-        "steps": len(steps),
-        "requests_completed": len({r.get("rid") for r in per_req}
-                                  if per_req else ()),
+        "steps": steps,
+        "requests_completed": len(rids),
         "tokens": tokens,
         "tokens_per_sec": round(tokens / (wall_ms / 1e3), 1)
         if wall_ms > 0 else None,
-        "ttft_ms": {"mean": round(sum(ttfts) / len(ttfts), 3),
-                    "p50": pctl(ttfts, 0.5), "p99": pctl(ttfts, 0.99),
-                    "max": round(max(ttfts), 3)} if ttfts else None,
-        "tpot_ms": {"mean": round(sum(tpots) / len(tpots), 3),
-                    "p50": pctl(tpots, 0.5)} if tpots else None,
-        "queue_depth": {"mean": round(sum(qd) / len(qd), 2),
-                        "max": max(qd)} if qd else None,
-        "active": {"mean": round(sum(act) / len(act), 2),
-                   "max": max(act)} if act else None,
-        "cache_occupancy": {"mean": round(sum(occ) / len(occ), 4),
-                            "peak": round(max(occ), 4)} if occ else
-        None,
-        "admissions": sum(1 for r in records
-                          if r.get("decision") == "serve.admit"),
-        "evictions": sum(1 for r in records
-                         if r.get("decision") == "serve.evict"),
+        "ttft_ms": {"mean": rnd(tt.mean), "p50": rnd(tt.quantile(0.5)),
+                    "p99": rnd(tt.quantile(0.99)),
+                    "max": rnd(tt.max)} if tt.n else None,
+        "tpot_ms": {"mean": rnd(tp.mean),
+                    "p50": rnd(tp.quantile(0.5))} if tp.n else None,
+        "queue_depth": {"mean": rnd(qd.mean, 2),
+                        "max": int(qd.max)} if qd.n else None,
+        "active": {"mean": rnd(act.mean, 2),
+                   "max": int(act.max)} if act.n else None,
+        "cache_occupancy": {"mean": rnd(occ.mean, 4),
+                            "peak": rnd(occ.max, 4)} if occ.n else None,
+        "admissions": admissions,
+        "evictions": evictions,
         "plan": ({"prefill": [plan.get("prefill_backend"),
                               plan.get("prefill_chunks")],
                   "decode": [plan.get("decode_backend"),
                              plan.get("decode_chunks")],
                   "heterogeneous": plan.get("heterogeneous")}
                  if plan else None),
-        "slo_breaches": {
-            "ttft": sum(1 for r in slo if r["target"] == "ttft"),
-            "tpot": sum(1 for r in slo if r["target"] == "tpot"),
-        } if slo else None,
+        "slo_breaches": {"ttft": slo_ttft, "tpot": slo_tpot}
+        if slo else None,
     }
 
 
@@ -520,6 +558,112 @@ def render_serving_text(rep: dict) -> str:
         b = rep["slo_breaches"]
         lines.append(f"  SLO breaches: ttft={b['ttft']} "
                      f"tpot={b['tpot']}")
+    return "\n".join(lines)
+
+
+def trace_report(records: list[dict], rid: int) -> dict:
+    """One request's end-to-end timeline (``--trace <rid>``) from the
+    tracer's ``serve_trace_span`` JSONL records: every lifecycle span
+    in timeline order, eviction gaps flagged, and the totals a latency
+    investigation starts from (queue wait vs prefill vs decode-window
+    time)."""
+    spans = [r for r in records if r.get("kind") == "serve_trace_span"
+             and r.get("rid") == rid]
+    spans.sort(key=lambda s: s.get("ts_ms", 0.0))
+    known = sorted({r.get("rid") for r in records
+                    if r.get("kind") == "serve_trace_span"})
+    by_phase: dict[str, float] = {}
+    for s in spans:
+        if s.get("name") != "serve.step":   # windows overlap the rest
+            by_phase[s["name"]] = by_phase.get(s["name"], 0.0) \
+                + float(s.get("dur_ms", 0.0))
+    gaps = [s for s in spans if s.get("name") == "serve.queued"
+            and s.get("resumed")]
+    return {
+        "rid": rid,
+        "found": bool(spans),
+        "known_rids": known,
+        "trace_id": spans[0].get("trace_id") if spans else None,
+        "spans": spans,
+        "evictions": int(spans[0].get("evictions", 0)) if spans else 0,
+        "eviction_gap_ms": round(sum(float(s.get("dur_ms", 0.0))
+                                     for s in gaps), 3),
+        "phase_ms": {k: round(v, 3) for k, v in sorted(by_phase.items())},
+        # max END over all spans: the last-STARTING span may end before
+        # an earlier step window does
+        "total_ms": round(max(s["ts_ms"] + s["dur_ms"] for s in spans)
+                          - spans[0]["ts_ms"], 3) if spans else None,
+    }
+
+
+def render_trace_text(rep: dict) -> str:
+    if not rep["found"]:
+        known = ", ".join(str(r) for r in rep["known_rids"]) or "none"
+        return (f"no trace spans for request {rep['rid']} (traced "
+                f"requests: {known}) — run the drill with tracing on "
+                f"(`python -m flashmoe_tpu.serving --trace ...`)")
+    lines = [f"request {rep['rid']} trace {rep['trace_id']}: "
+             f"{len(rep['spans'])} spans, {rep['total_ms']} ms end to "
+             f"end, {rep['evictions']} eviction(s)"
+             + (f" ({rep['eviction_gap_ms']} ms re-queued)"
+                if rep["evictions"] else "")]
+    for k, v in rep["phase_ms"].items():
+        lines.append(f"  {k:<24s} {v:>10.3f} ms total")
+    lines.append("  timeline:")
+    t0 = rep["spans"][0]["ts_ms"]
+    for s in rep["spans"]:
+        mark = " <- eviction gap" if (s["name"] == "serve.queued"
+                                      and s.get("resumed")) else ""
+        lines.append(
+            f"    +{s['ts_ms'] - t0:>10.3f} ms  {s['name']:<16s} "
+            f"{s['dur_ms']:>10.3f} ms  step={s.get('step')}{mark}")
+    return "\n".join(lines)
+
+
+def merge_report(paths: list[str]) -> dict:
+    """Fleet view over per-host telemetry shards (``--merge``): each
+    input file is one host's JSONL dump (``telemetry.<host>.jsonl`` —
+    telemetry_plane/server.py:host_shard_path, or any flight/decision
+    file); records are tagged with their host, counted per host, and
+    the union is summarized once — the mocked multi-slice drills
+    (PR 12) read as ONE job instead of n disjoint dumps."""
+    import os as _os
+
+    hosts: dict[str, dict] = {}
+    merged: list[dict] = []
+    for path in paths:
+        base = _os.path.basename(path)
+        host = base
+        if base.startswith("telemetry.") and base.endswith(".jsonl"):
+            host = base[len("telemetry."):-len(".jsonl")]
+        recs = load_jsonl([path])
+        info = hosts.setdefault(host, {"records": 0, "files": []})
+        info["records"] += len(recs)
+        info["files"].append(base)
+        steps = [r.get("step") for r in recs
+                 if isinstance(r.get("step"), (int, float))]
+        if steps:
+            info["steps"] = [int(min(steps)), int(max(steps))]
+        for r in recs:
+            merged.append(dict(r, host=host))
+    return {
+        "hosts": hosts,
+        "records": len(merged),
+        "fleet": summarize(merged),
+    }
+
+
+def render_merge_text(rep: dict) -> str:
+    lines = [f"fleet view: {len(rep['hosts'])} host shard(s), "
+             f"{rep['records']} records"]
+    for host in sorted(rep["hosts"]):
+        info = rep["hosts"][host]
+        steps = info.get("steps")
+        lines.append(f"  {host}: {info['records']} records"
+                     + (f", steps {steps[0]}..{steps[1]}" if steps
+                        else ""))
+    lines.append("")
+    lines.append(render_text(rep["fleet"]))
     return "\n".join(lines)
 
 
@@ -714,7 +858,54 @@ def main(argv=None) -> int:
     ap.add_argument("--postmortem", metavar="DIR",
                     help="render a triage report of the crash postmortem "
                          "bundle(s) under DIR")
+    ap.add_argument("--trace", type=int, metavar="RID", default=None,
+                    help="render one request's end-to-end timeline "
+                         "(queue wait, prefill, decode, eviction gaps) "
+                         "from serve_trace_span JSONL records")
+    ap.add_argument("--merge", action="store_true",
+                    help="fleet view: treat each input file as one "
+                         "host's telemetry shard and summarize the "
+                         "union (telemetry.<host>.jsonl)")
+    ap.add_argument("--regression", action="store_true",
+                    help="perf sentry: compare the newest run in the "
+                         "history file (default obs/history.jsonl) "
+                         "against the rolling baseline")
+    ap.add_argument("--ci", action="store_true",
+                    help="with --regression: exit rc 2 when any metric "
+                         "regressed (regress.detected decisions)")
     args = ap.parse_args(argv)
+
+    modes = [m for m, on in (("--ledger", args.ledger),
+                             ("--serving", args.serving),
+                             ("--postmortem", bool(args.postmortem)),
+                             ("--trace", args.trace is not None),
+                             ("--merge", args.merge),
+                             ("--regression", args.regression)) if on]
+    if len(modes) > 1:
+        ap.error(f"pick one mode: {' '.join(modes)}")
+    if args.ci and not args.regression:
+        ap.error("--ci only applies with --regression")
+
+    if args.regression:
+        from flashmoe_tpu.telemetry_plane import regression as reg
+
+        path = args.files[0] if args.files else reg.DEFAULT_HISTORY
+        runs = reg.load_history(path)
+        if not runs:
+            print(f"no run history at {path!r} (append one with "
+                  f"`bench.py --regression` or "
+                  f"regression.append_run)", file=sys.stderr)
+            return 2
+        report = reg.check_regression(runs)
+        report["history"] = path
+        if args.json:
+            json.dump(report, sys.stdout)
+            print()
+        else:
+            print(reg.render_text(report))
+        if args.ci and report["regressions"]:
+            return 2
+        return 0
 
     if args.postmortem:
         from flashmoe_tpu.profiler import postmortem as pm
@@ -733,11 +924,28 @@ def main(argv=None) -> int:
         return 0
 
     if not args.files:
-        ap.error("JSONL files required (or use --postmortem DIR)")
+        ap.error("JSONL files required (or use --postmortem DIR / "
+                 "--regression)")
+    if args.merge:
+        rep = merge_report(args.files)
+        if args.json:
+            json.dump(rep, sys.stdout)
+            print()
+        else:
+            print(render_merge_text(rep))
+        return 0 if rep["records"] else 2
     records = load_jsonl(args.files)
     if not records:
         print("no parseable records found", file=sys.stderr)
         return 2
+    if args.trace is not None:
+        rep = trace_report(records, args.trace)
+        if args.json:
+            json.dump(rep, sys.stdout)
+            print()
+        else:
+            print(render_trace_text(rep))
+        return 0 if rep["found"] else 2
     if args.ledger:
         led = ledger_report(records)
         if args.json:

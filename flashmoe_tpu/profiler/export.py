@@ -93,6 +93,68 @@ def write_trace(timelines, path: str, *, labels=None) -> dict:
     return doc
 
 
+def request_trace_events(tracer, *, base_pid: int = 1000) -> list[dict]:
+    """One Perfetto track PER REQUEST from a
+    :class:`flashmoe_tpu.telemetry_plane.tracing.RequestTracer`: each
+    request gets its own pid (named ``request <rid> [<trace_id>]``),
+    with its lifecycle spans — ``serve.queued`` (eviction gaps render
+    as ``serve.queued [resumed]`` slices), ``serve.prefill``,
+    ``serve.step`` windows and the nested ``serve.decode`` device
+    slices — as ``ph:"X"`` complete events.  Composable with
+    :func:`chrome_trace_events` output (phase timelines keep pids <
+    ``base_pid``), so one trace.json can carry both views."""
+    events: list[dict] = []
+    for idx, rid in enumerate(sorted(tracer.requests)):
+        st = tracer.requests[rid]
+        pid = base_pid + idx
+        name = f"request {rid}"
+        if st.trace_id:
+            name += f" [{st.trace_id}]"
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": name}})
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": 0, "args": {"name": "request lifecycle"}})
+        for s in tracer.request_track(rid):
+            label = s["name"]
+            if s.get("resumed"):
+                label += " [resumed]"
+            events.append({
+                "ph": "X", "name": label, "cat": "request",
+                "ts": round(s["ts_ms"] * 1e3, 3),
+                "dur": max(round(s["dur_ms"] * 1e3, 3), 0.001),
+                "pid": pid, "tid": 0,
+                "args": {"rid": rid, "trace_id": st.trace_id,
+                         "step": s.get("step")},
+            })
+    return events
+
+
+def request_trace_document(tracer, *, timelines=None,
+                           labels=None) -> dict:
+    """A full trace document of per-request tracks, optionally merged
+    with phase timelines (one pid each, below the request pids)."""
+    events: list[dict] = []
+    if timelines is not None:
+        events = trace_document(timelines, labels=labels)["traceEvents"]
+    events.extend(request_trace_events(tracer))
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"producer": "flashmoe_tpu.profiler"}}
+
+
+def write_request_trace(tracer, path: str, *, timelines=None,
+                        labels=None) -> dict:
+    """Write the per-request trace (``validate_trace``-gated, like
+    :func:`write_trace` — a malformed export fails at write time)."""
+    doc = request_trace_document(tracer, timelines=timelines,
+                                 labels=labels)
+    errors = validate_trace(doc)
+    if errors:
+        raise ValueError(f"malformed request-trace export: {errors[:3]}")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return doc
+
+
 def validate_trace(doc: dict) -> list[str]:
     """Schema check against the Trace Event Format invariants this
     exporter relies on.  Returns human-readable problems (empty =

@@ -193,6 +193,24 @@ class SLOWatchdog:
     def consecutive_breaches(self) -> int:
         return self._consecutive
 
+    def snapshot(self) -> dict:
+        """Episode state for the live ``/healthz`` endpoint: which
+        targets are currently in breach, the step-budget run length,
+        whether this episode already escalated, and the budgets being
+        judged against."""
+        return {
+            "consecutive_step_breaches": self._consecutive,
+            "in_breach": sorted(self._breached),
+            "escalated": self._escalated,
+            "budgets": {
+                k: v for k, v in (
+                    ("step_ms", self.slo.step_ms),
+                    ("ttft_ms", self.slo.ttft_ms),
+                    ("tpot_ms", self.slo.tpot_ms),
+                ) if v is not None
+            },
+        }
+
     def observe_request(self, step: int, request_id,
                         *, ttft_ms: float | None = None,
                         tpot_ms: float | None = None) -> list[dict]:

@@ -676,6 +676,27 @@ class RuntimeController:
                                         action.replica_pairs)
         return state
 
+    def snapshot(self) -> dict:
+        """Live ``/healthz`` view: remaining action budgets, the
+        cooldown window, current trigger run lengths, and the
+        accumulated overrides — "what can the self-healer still do"."""
+        c = self.ccfg
+        return {
+            "budgets": {
+                "morph": c.morph_budget - self.morphs_used,
+                "replace": c.replace_budget - self.replaces_used,
+                "wire_morph": c.wire_morph_budget - self.wire_morphs_used,
+            },
+            "cooldown_until": self.cooldown_until,
+            "trigger_runs": {"skew": self._skew_run,
+                             "slow": self._slow_run,
+                             "a2a": self._a2a_run},
+            "overrides": {k: (list(map(list, v))
+                              if k == "expert_replicas" else v)
+                          for k, v in self.overrides.items()},
+            "actions_taken": len(self.timeline),
+        }
+
     def state_dict(self) -> dict:
         """JSON-able persistent state, written into every checkpoint
         manifest after an action (``runtime.checkpoint.save(...,

@@ -225,7 +225,8 @@ def resilient_train(state: TrainState, step_fn: Callable,
                     fail_injector: Callable | None = None,
                     preempt=None, slo=None,
                     postmortem_dir: str | None = None, cfg=None,
-                    controller=None, rebuild_step: Callable | None = None):
+                    controller=None, rebuild_step: Callable | None = None,
+                    telemetry_port: int | None = None):
     """Run ``num_steps`` with detection + restore-and-retry recovery.
 
     ``step_fn(state, batch) -> (state, metrics_dict)`` — e.g. from
@@ -277,6 +278,18 @@ def resilient_train(state: TrainState, step_fn: Callable,
     metrics = metrics or Metrics()
     watchdog = _as_watchdog(slo)
     history = []
+    # live scrape plane (telemetry_plane/server.py): /healthz carries
+    # the step, SLO episode, controller budgets, and the last DURABLE
+    # checkpoint step — default off = no thread, bit-identical loop
+    progress = {"step": None}
+    server = None
+    if telemetry_port is not None:
+        from flashmoe_tpu.runtime.telemetry_hooks import train_server
+
+        server = train_server(
+            telemetry_port, cfg, num_steps=num_steps, progress=progress,
+            watchdog=watchdog, controller=controller,
+            checkpoint_dir=rcfg.checkpoint_dir, metrics_obj=metrics)
 
     def _ctrl_state():
         return controller.state_dict() if controller is not None else None
@@ -342,6 +355,7 @@ def resilient_train(state: TrainState, step_fn: Callable,
     ex_box: list = [None]
     try:
         while i < num_steps:
+            progress["step"] = i
             if preempt is not None and preempt.requested:
                 # graceful drain: the in-flight step already finished
                 # (the flag is polled between steps); make everything
@@ -552,6 +566,8 @@ def resilient_train(state: TrainState, step_fn: Callable,
                 e.postmortem_bundle = bundle
         raise
     finally:
+        if server is not None:
+            server.stop()
         if ex_box[0] is not None:
             ex_box[0].shutdown(wait=False)
 
@@ -563,7 +579,8 @@ def supervise(cfg, data_factory: Callable, num_steps: int,
               max_restarts: int = 3, fail_injector: Callable | None = None,
               step_wrapper: Callable | None = None, seed: int = 0,
               use_pallas: bool | None = None, slo=None,
-              postmortem_dir: str | None = None, controller=None):
+              postmortem_dir: str | None = None, controller=None,
+              telemetry_port: int | None = None):
     """Job-level restart loop: run to ``num_steps`` across preemptions,
     crashes, and world-size changes.
 
@@ -621,6 +638,27 @@ def supervise(cfg, data_factory: Callable, num_steps: int,
     history: list = []
     restarts = 0
     incarnation = 0
+    # one long-lived scrape server for the whole supervised job: the
+    # box re-points it at each incarnation's folded cfg/controller, so
+    # /healthz answers across restarts instead of churning ports.  The
+    # watchdog is built HERE (one episode state across incarnations)
+    # and handed down, so /healthz carries SLO state and the metrics
+    # `steps` counter gives live step progress.
+    from flashmoe_tpu.runtime.trainer import _as_watchdog
+
+    watchdog = _as_watchdog(slo)
+    tbox: dict = {"phase": "supervise",
+                  "checkpoint_dir": rcfg.checkpoint_dir,
+                  "watchdog": watchdog}
+    tserver = None
+    if telemetry_port is not None:
+        from flashmoe_tpu.runtime.telemetry_hooks import train_server
+
+        tserver = train_server(
+            telemetry_port, cfg, num_steps=num_steps,
+            metrics_obj=metrics, box=tbox,
+            extra_health=lambda: {
+                "steps_done": int(metrics.counters.get("steps", 0))})
     # drains don't consume the restart budget, but a notice source stuck
     # on "always preempted" must not loop forever either
     max_incarnations = max(8, 4 * (max_restarts + 1))
@@ -633,114 +671,125 @@ def supervise(cfg, data_factory: Callable, num_steps: int,
                             extra={"incarnation": incarnation,
                                    "restarts": restarts})
 
-    while True:
-        if incarnation >= max_incarnations:
+    try:
+        while True:
+            if incarnation >= max_incarnations:
+                e = StepFailure(
+                    f"supervisor exceeded {max_incarnations} incarnations "
+                    f"without reaching step {num_steps}")
+                _bundle(e)
+                raise e
+            devices = list(devices_fn() if devices_fn is not None
+                           else jax.devices())
+            resumed_step = None
+            if ckpt.latest_step(rcfg.checkpoint_dir) is not None:
+                state, mesh, fcfg, opt = elastic_resume(
+                    cfg, rcfg.checkpoint_dir, devices=devices, guard=guard,
+                    total_steps=num_steps)
+                metrics.decision(
+                    "supervisor.resume", incarnation=incarnation,
+                    step=int(state.step), world=len(devices),
+                    ep=fcfg.ep, dp=fcfg.dp)
+                # an incarnation resumes on a fresh (possibly re-folded)
+                # topology: path demotions earned by the DEAD incarnation
+                # describe hardware/paths that may no longer exist — clear
+                # the process blacklist so the planner re-evaluates every
+                # path against the surviving world
+                from flashmoe_tpu.planner.select import (
+                    failed_backends, reset_path_failures,
+                )
+
+                stale = sorted(failed_backends())
+                if stale:
+                    reset_path_failures()
+                    metrics.decision(
+                        "controller.demotion_reset",
+                        incarnation=incarnation, world=len(devices),
+                        ep=fcfg.ep, dp=fcfg.dp, dropped=stale)
+                resumed_step = int(state.step)
+            else:
+                fcfg = fold_parallelism(cfg, len(devices))
+                mesh = make_mesh(fcfg, devices=devices)
+                opt = make_optimizer(fcfg, total_steps=num_steps)
+                state = init_state(_random.PRNGKey(seed), fcfg, opt,
+                                   guard=guard)
+                state = jax.device_put(state,
+                                       state_shardings(state, fcfg, mesh))
+            if own_controller:
+                # re-target the controller to THIS incarnation's folded
+                # topology: placement math (n_devices, slot -> device) and
+                # morph re-selection (d, the folded cfg) must describe the
+                # world that is actually running, not the one that died.
+                # Spent budgets and the accumulated plan carry over (slot
+                # ids are expert ids — independent of the device count);
+                # the manifest restore below then pins the plan to the
+                # params actually resumed.
+                from flashmoe_tpu.runtime.controller import RuntimeController
+
+                prev = controller
+                controller = RuntimeController(fcfg, rcfg.adapt,
+                                               metrics=metrics)
+                if prev is not None:
+                    controller.load_state_dict(prev.state_dict())
+            if controller is not None and resumed_step is not None:
+                cs = ckpt.load_controller_state(rcfg.checkpoint_dir,
+                                                resumed_step)
+                controller.load_state_dict(cs or {})
+            data = data_factory(fcfg)
+            if ckpt.restore_loader_state(rcfg.checkpoint_dir,
+                                         int(state.step), data):
+                metrics.count("loader_restores")
+
+            def _build_step(overrides: dict, _fcfg=fcfg, _mesh=mesh,
+                            _opt=opt):
+                scfg = _fcfg.replace(**overrides) if overrides else _fcfg
+                sf = make_train_step(scfg, _mesh, _opt,
+                                     use_pallas=use_pallas, guard=guard)
+                return step_wrapper(sf) if step_wrapper is not None else sf
+
+            step_fn = _build_step(
+                controller.cfg_overrides if controller is not None else {})
+            # re-point the long-lived scrape server at THIS
+            # incarnation's folded world (no port churn on restart)
+            tbox.update(cfg=fcfg, mesh=mesh, controller=controller,
+                        health={"incarnation": incarnation,
+                                "restarts": restarts,
+                                "world": len(devices)})
+            incarnation += 1
+            try:
+                state, hist = resilient_train(
+                    state, step_fn, data, num_steps, rcfg=rcfg,
+                    metrics=metrics, fail_injector=fail_injector,
+                    preempt=preempt, slo=watchdog,
+                    postmortem_dir=postmortem_dir,
+                    cfg=fcfg, controller=controller,
+                    rebuild_step=_build_step)
+                history.extend(hist)
+            except StepFailure as e:
+                # in-job recovery exhausted: the real process would be dead.
+                # The scheduler restarts it — here, the next loop iteration —
+                # against whatever checkpoint the drain/emergency paths left.
+                # The dead incarnation's executed steps stay in the history.
+                history.extend(getattr(e, "partial_history", []))
+                restarts += 1
+                metrics.count("supervisor_restarts")
+                if restarts > max_restarts:
+                    e.partial_history = list(history)
+                    raise
+                continue
+            if int(state.step) >= num_steps:
+                return state, history
+            if preempt is not None and preempt.requested:
+                # drained on a preemption notice: this incarnation is over;
+                # clear the latch and "restart" with the current device set
+                preempt.clear()
+                metrics.count("preempt_restarts")
+                continue
             e = StepFailure(
-                f"supervisor exceeded {max_incarnations} incarnations "
-                f"without reaching step {num_steps}")
+                f"incarnation ended at step {int(state.step)} of {num_steps} "
+                f"with no drain and no failure — refusing to spin")
             _bundle(e)
             raise e
-        devices = list(devices_fn() if devices_fn is not None
-                       else jax.devices())
-        resumed_step = None
-        if ckpt.latest_step(rcfg.checkpoint_dir) is not None:
-            state, mesh, fcfg, opt = elastic_resume(
-                cfg, rcfg.checkpoint_dir, devices=devices, guard=guard,
-                total_steps=num_steps)
-            metrics.decision(
-                "supervisor.resume", incarnation=incarnation,
-                step=int(state.step), world=len(devices),
-                ep=fcfg.ep, dp=fcfg.dp)
-            # an incarnation resumes on a fresh (possibly re-folded)
-            # topology: path demotions earned by the DEAD incarnation
-            # describe hardware/paths that may no longer exist — clear
-            # the process blacklist so the planner re-evaluates every
-            # path against the surviving world
-            from flashmoe_tpu.planner.select import (
-                failed_backends, reset_path_failures,
-            )
-
-            stale = sorted(failed_backends())
-            if stale:
-                reset_path_failures()
-                metrics.decision(
-                    "controller.demotion_reset",
-                    incarnation=incarnation, world=len(devices),
-                    ep=fcfg.ep, dp=fcfg.dp, dropped=stale)
-            resumed_step = int(state.step)
-        else:
-            fcfg = fold_parallelism(cfg, len(devices))
-            mesh = make_mesh(fcfg, devices=devices)
-            opt = make_optimizer(fcfg, total_steps=num_steps)
-            state = init_state(_random.PRNGKey(seed), fcfg, opt,
-                               guard=guard)
-            state = jax.device_put(state,
-                                   state_shardings(state, fcfg, mesh))
-        if own_controller:
-            # re-target the controller to THIS incarnation's folded
-            # topology: placement math (n_devices, slot -> device) and
-            # morph re-selection (d, the folded cfg) must describe the
-            # world that is actually running, not the one that died.
-            # Spent budgets and the accumulated plan carry over (slot
-            # ids are expert ids — independent of the device count);
-            # the manifest restore below then pins the plan to the
-            # params actually resumed.
-            from flashmoe_tpu.runtime.controller import RuntimeController
-
-            prev = controller
-            controller = RuntimeController(fcfg, rcfg.adapt,
-                                           metrics=metrics)
-            if prev is not None:
-                controller.load_state_dict(prev.state_dict())
-        if controller is not None and resumed_step is not None:
-            cs = ckpt.load_controller_state(rcfg.checkpoint_dir,
-                                            resumed_step)
-            controller.load_state_dict(cs or {})
-        data = data_factory(fcfg)
-        if ckpt.restore_loader_state(rcfg.checkpoint_dir,
-                                     int(state.step), data):
-            metrics.count("loader_restores")
-
-        def _build_step(overrides: dict, _fcfg=fcfg, _mesh=mesh,
-                        _opt=opt):
-            scfg = _fcfg.replace(**overrides) if overrides else _fcfg
-            sf = make_train_step(scfg, _mesh, _opt,
-                                 use_pallas=use_pallas, guard=guard)
-            return step_wrapper(sf) if step_wrapper is not None else sf
-
-        step_fn = _build_step(
-            controller.cfg_overrides if controller is not None else {})
-        incarnation += 1
-        try:
-            state, hist = resilient_train(
-                state, step_fn, data, num_steps, rcfg=rcfg,
-                metrics=metrics, fail_injector=fail_injector,
-                preempt=preempt, slo=slo, postmortem_dir=postmortem_dir,
-                cfg=fcfg, controller=controller,
-                rebuild_step=_build_step)
-            history.extend(hist)
-        except StepFailure as e:
-            # in-job recovery exhausted: the real process would be dead.
-            # The scheduler restarts it — here, the next loop iteration —
-            # against whatever checkpoint the drain/emergency paths left.
-            # The dead incarnation's executed steps stay in the history.
-            history.extend(getattr(e, "partial_history", []))
-            restarts += 1
-            metrics.count("supervisor_restarts")
-            if restarts > max_restarts:
-                e.partial_history = list(history)
-                raise
-            continue
-        if int(state.step) >= num_steps:
-            return state, history
-        if preempt is not None and preempt.requested:
-            # drained on a preemption notice: this incarnation is over;
-            # clear the latch and "restart" with the current device set
-            preempt.clear()
-            metrics.count("preempt_restarts")
-            continue
-        e = StepFailure(
-            f"incarnation ended at step {int(state.step)} of {num_steps} "
-            f"with no drain and no failure — refusing to spin")
-        _bundle(e)
-        raise e
+    finally:
+        if tserver is not None:
+            tserver.stop()

@@ -67,6 +67,12 @@ def main(argv=None) -> int:
                          "this budget instead of dying mid-write")
     ap.add_argument("--log-every", type=int, default=10)
     ap.add_argument("--metrics-jsonl", default=None)
+    ap.add_argument("--telemetry-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve live /metrics, /healthz and /vars on "
+                         "this port for the run's duration (0 = "
+                         "ephemeral; default off = no thread, "
+                         "byte-identical training)")
     ap.add_argument("--grad-guard", action="store_true",
                     help="tier-1 gradient anomaly guard: skip non-finite/"
                          "spiking updates in-graph (docs/RESILIENCE.md)")
@@ -135,7 +141,8 @@ def main(argv=None) -> int:
         try:
             state, history = resilient_train(
                 state, step, data, args.steps, rcfg=rcfg,
-                metrics=metrics, preempt=preempt,
+                metrics=metrics, preempt=preempt, cfg=cfg,
+                telemetry_port=args.telemetry_port,
             )
         finally:
             preempt.uninstall()
@@ -145,16 +152,33 @@ def main(argv=None) -> int:
                   f"{args.checkpoint_dir}); re-run to resume",
                   file=sys.stderr)
     else:
+        server = None
+        if args.telemetry_port is not None:
+            from flashmoe_tpu.runtime.telemetry_hooks import train_server
+
+            progress = {"step": 0}
+            server = train_server(args.telemetry_port, cfg, mesh,
+                                  num_steps=args.steps,
+                                  progress=progress,
+                                  metrics_obj=metrics)
         history = []
-        for i in range(args.steps):
-            with metrics.timer("step"):
-                state, m = step(state, next(data))
-            if i % args.log_every == 0 or i == args.steps - 1:
-                # scalar-safe: array-valued metrics (per-expert stats
-                # when collect_stats is on) must not crash the logger
-                rec = scalar_metrics(m)
-                history.append(rec)
-                print(json.dumps({"step": i, **rec}), file=sys.stderr)
+        try:
+            for i in range(args.steps):
+                if server is not None:
+                    progress["step"] = i
+                with metrics.timer("step"):
+                    state, m = step(state, next(data))
+                if i % args.log_every == 0 or i == args.steps - 1:
+                    # scalar-safe: array-valued metrics (per-expert
+                    # stats when collect_stats is on) must not crash
+                    # the logger
+                    rec = scalar_metrics(m)
+                    history.append(rec)
+                    print(json.dumps({"step": i, **rec}),
+                          file=sys.stderr)
+        finally:
+            if server is not None:
+                server.stop()
 
     summary = dict(metrics.summary(),
                    final_loss=history[-1].get("loss") if history else None,

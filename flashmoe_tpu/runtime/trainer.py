@@ -252,7 +252,7 @@ def train(cfg: MoEConfig, mesh: Mesh, data_iter, num_steps: int,
           flight_path: str | None = None,
           flight_flush_every: int = 0,
           guard: GradGuardConfig | None = None,
-          slo=None, controller=None):
+          slo=None, controller=None, telemetry_port: int | None = None):
     """Simple host training loop (see runtime.worker for the CLI).
 
     ``recorder``: a :class:`flashmoe_tpu.utils.telemetry.FlightRecorder`
@@ -283,6 +283,12 @@ def train(cfg: MoEConfig, mesh: Mesh, data_iter, num_steps: int,
     actions in checkpoint manifests).  Arming a controller times every
     step.
 
+    ``telemetry_port``: arm the live scrape server
+    (telemetry_plane/server.py) for the loop's duration — ``/metrics``
+    (the global registry), ``/healthz`` (step progress + SLO episode +
+    controller budgets), ``/vars`` (the shape being trained).  Default
+    ``None`` = no thread, byte-identical behavior.
+
     When a profiler timeline is armed (:func:`flashmoe_tpu.profiler.
     spans.profiling`), the loop's host work is recorded as
     ``train.data_pull`` / ``train.step`` sections.
@@ -305,77 +311,90 @@ def train(cfg: MoEConfig, mesh: Mesh, data_iter, num_steps: int,
     watchdog = _as_watchdog(slo)
     history = []
     flushed = 0  # offset-aware export cursor (absolute record index)
-    for i in range(num_steps):
-        with prof.section("train.data_pull", step=i):
-            batch = next(data_iter)
-        log_step = i % log_every == 0 or i == num_steps - 1
-        tl = prof.active()
-        if recorder is not None or log_step or watchdog is not None \
-                or tl is not None or controller is not None:
-            # block before reading the clock: jit dispatch is async, so
-            # an unsynchronized timer would record ~0 host-dispatch ms.
-            # With a recorder every step is timed exactly; log-only runs
-            # time the logged step plus whatever backlog drained with it.
-            t0 = time.perf_counter()
-            if tl is not None:
-                # an armed timeline gets per-step records; any phases
-                # measured inside (eager fenced runs — under jit the
-                # phase dict stays empty) feed the SLO phase budgets
-                tl.begin_step(i)
-            with prof.section("train.step", step=i):
-                state, metrics = step(state, batch)
-                jax.block_until_ready(metrics)
-            phases = tl.end_step()["phases"] if tl is not None else None
-            step_ms = (time.perf_counter() - t0) * 1e3
-            # bounded: the histogram aggregates, no per-step list grows
-            tm.histogram("trainer.step_ms", step_ms)
-            if watchdog is not None:
-                watchdog.observe_step(i, step_ms, phases=phases)
-            if controller is not None:
-                controller.observe_step(i, step_ms, metrics)
-                act = controller.maybe_act(i + 1)
-                if act is not None:
-                    # self-healing action at the step boundary: permute
-                    # the live state (re-placement) and/or re-jit onto
-                    # the controller's accumulated config overrides
-                    state = controller.apply_action(act, state)
-                    if act.needs_rebuild:
-                        step = make_train_step(
-                            cfg.replace(**controller.cfg_overrides),
-                            mesh, optimizer, use_pallas=use_pallas,
-                            guard=guard)
-            if recorder is not None or log_step:
-                # the full device->host metrics pull (per-layer MoEStats
-                # when collect_stats is on) only happens when someone
-                # consumes it; a watchdog alone needs just step_ms
-                rec = host_metrics(metrics,
-                                   moe_layers=cfg.moe_layer_indices)
-                rec["step_ms"] = step_ms
-                if rec.get("grad_ok", 1.0) == 0.0:
-                    # tier-1 guard fired: the skipped update is a
-                    # structured decision so a postmortem can answer
-                    # "which steps were dropped and why" without
-                    # replaying the run
-                    tm.decision("trainer.grad_skip", step=i,
-                                grad_norm=rec.get("grad_norm"),
-                                grad_norm_ema=rec.get("grad_norm_ema"))
-                if recorder is not None:
-                    recorder.record(step=i, **rec)
-                    if flight_path is not None and flight_flush_every > 0 \
-                            and (i + 1) % flight_flush_every == 0:
-                        flushed = recorder.export_jsonl(flight_path,
-                                                        start=flushed)
-                if log_step:
-                    history.append(rec)
-        else:
-            with prof.section("train.step", step=i):
-                state, metrics = step(state, batch)
-    if flight_path is not None and recorder is not None:
-        if flight_flush_every > 0:
-            recorder.export_jsonl(flight_path, start=flushed)
-        else:
-            recorder.export_jsonl(flight_path)
-    return state, history
+    progress = {"step": 0}
+    server = None
+    if telemetry_port is not None:
+        from flashmoe_tpu.runtime.telemetry_hooks import train_server
+
+        server = train_server(telemetry_port, cfg, mesh,
+                              num_steps=num_steps, progress=progress,
+                              watchdog=watchdog, controller=controller)
+    try:
+        for i in range(num_steps):
+            progress["step"] = i
+            with prof.section("train.data_pull", step=i):
+                batch = next(data_iter)
+            log_step = i % log_every == 0 or i == num_steps - 1
+            tl = prof.active()
+            if recorder is not None or log_step or watchdog is not None \
+                    or tl is not None or controller is not None:
+                # block before reading the clock: jit dispatch is async, so
+                # an unsynchronized timer would record ~0 host-dispatch ms.
+                # With a recorder every step is timed exactly; log-only runs
+                # time the logged step plus whatever backlog drained with it.
+                t0 = time.perf_counter()
+                if tl is not None:
+                    # an armed timeline gets per-step records; any phases
+                    # measured inside (eager fenced runs — under jit the
+                    # phase dict stays empty) feed the SLO phase budgets
+                    tl.begin_step(i)
+                with prof.section("train.step", step=i):
+                    state, metrics = step(state, batch)
+                    jax.block_until_ready(metrics)
+                phases = tl.end_step()["phases"] if tl is not None else None
+                step_ms = (time.perf_counter() - t0) * 1e3
+                # bounded: the histogram aggregates, no per-step list grows
+                tm.histogram("trainer.step_ms", step_ms)
+                if watchdog is not None:
+                    watchdog.observe_step(i, step_ms, phases=phases)
+                if controller is not None:
+                    controller.observe_step(i, step_ms, metrics)
+                    act = controller.maybe_act(i + 1)
+                    if act is not None:
+                        # self-healing action at the step boundary: permute
+                        # the live state (re-placement) and/or re-jit onto
+                        # the controller's accumulated config overrides
+                        state = controller.apply_action(act, state)
+                        if act.needs_rebuild:
+                            step = make_train_step(
+                                cfg.replace(**controller.cfg_overrides),
+                                mesh, optimizer, use_pallas=use_pallas,
+                                guard=guard)
+                if recorder is not None or log_step:
+                    # the full device->host metrics pull (per-layer MoEStats
+                    # when collect_stats is on) only happens when someone
+                    # consumes it; a watchdog alone needs just step_ms
+                    rec = host_metrics(metrics,
+                                       moe_layers=cfg.moe_layer_indices)
+                    rec["step_ms"] = step_ms
+                    if rec.get("grad_ok", 1.0) == 0.0:
+                        # tier-1 guard fired: the skipped update is a
+                        # structured decision so a postmortem can answer
+                        # "which steps were dropped and why" without
+                        # replaying the run
+                        tm.decision("trainer.grad_skip", step=i,
+                                    grad_norm=rec.get("grad_norm"),
+                                    grad_norm_ema=rec.get("grad_norm_ema"))
+                    if recorder is not None:
+                        recorder.record(step=i, **rec)
+                        if flight_path is not None and flight_flush_every > 0 \
+                                and (i + 1) % flight_flush_every == 0:
+                            flushed = recorder.export_jsonl(flight_path,
+                                                            start=flushed)
+                    if log_step:
+                        history.append(rec)
+            else:
+                with prof.section("train.step", step=i):
+                    state, metrics = step(state, batch)
+        if flight_path is not None and recorder is not None:
+            if flight_flush_every > 0:
+                recorder.export_jsonl(flight_path, start=flushed)
+            else:
+                recorder.export_jsonl(flight_path)
+        return state, history
+    finally:
+        if server is not None:
+            server.stop()
 
 
 def _as_watchdog(slo):

@@ -6,8 +6,11 @@ Usage::
     python -m flashmoe_tpu.serving --requests 12 --max-batch 8 \\
         --max-new 8 --arrival-every 2 --seed 7
     python -m flashmoe_tpu.serving --obs-dir obs/ --ttft-slo-ms 50
+    python -m flashmoe_tpu.serving --trace --telemetry-port 9464 \\
+        --obs-dir obs/           # live /metrics + per-request traces
     python -m flashmoe_tpu.observe --serving obs/flight.jsonl \\
         obs/decisions.jsonl                              # the report
+    python -m flashmoe_tpu.observe --trace 3 obs/trace.jsonl
 
 Runs a small MoE transformer (CPU-sized by default) through the
 continuous-batching engine under a seeded arrival trace, prints ONE
@@ -55,6 +58,17 @@ def main(argv=None) -> int:
         "FLASHMOE_OBS_DIR"),
         help="write flight.jsonl + decisions.jsonl here "
              "(observe --serving input)")
+    ap.add_argument("--telemetry-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve live /metrics, /healthz and /vars on "
+                         "this port for the run's duration (0 = "
+                         "ephemeral; default off = no thread, "
+                         "bit-identical outputs)")
+    ap.add_argument("--trace", action="store_true",
+                    help="request-scoped tracing: per-request "
+                         "Perfetto tracks (request_trace.json) + "
+                         "trace.jsonl spans into --obs-dir, rendered "
+                         "by `observe --trace <rid>`")
     ap.add_argument("--json", action="store_true",
                     help="(default) emit the JSON summary line")
     args = ap.parse_args(argv)
@@ -95,23 +109,48 @@ def main(argv=None) -> int:
 
     t0 = time.monotonic()
     engine = ServingEngine(params, cfg, serve, recorder=recorder,
-                           slo=slo)
-    engine.run(reqs, arrivals)
-    wall_s = time.monotonic() - t0
+                           slo=slo, tracer=args.trace,
+                           telemetry_port=args.telemetry_port)
+    try:
+        engine.run(reqs, arrivals)
+        wall_s = time.monotonic() - t0
 
-    summary = engine.summary()
-    summary["wall_s"] = round(wall_s, 3)
-    summary["tokens_per_sec"] = round(summary["tokens"] / wall_s, 1) \
-        if wall_s > 0 else None
-    summary["slo_breaches"] = int(
-        metrics.counters.get("slo.breaches", 0))
-    if args.obs_dir:
-        os.makedirs(args.obs_dir, exist_ok=True)
-        recorder.export_jsonl(os.path.join(args.obs_dir,
-                                           "flight.jsonl"))
-        metrics.dump_decisions_jsonl(
-            os.path.join(args.obs_dir, "decisions.jsonl"))
-        summary["obs_dir"] = args.obs_dir
+        summary = engine.summary()
+        summary["wall_s"] = round(wall_s, 3)
+        summary["tokens_per_sec"] = round(summary["tokens"] / wall_s, 1) \
+            if wall_s > 0 else None
+        summary["slo_breaches"] = int(
+            metrics.counters.get("slo.breaches", 0))
+        if args.telemetry_port is not None:
+            summary["telemetry_port"] = engine.telemetry.port
+        if args.obs_dir:
+            os.makedirs(args.obs_dir, exist_ok=True)
+            recorder.export_jsonl(os.path.join(args.obs_dir,
+                                               "flight.jsonl"))
+            metrics.dump_decisions_jsonl(
+                os.path.join(args.obs_dir, "decisions.jsonl"))
+            summary["obs_dir"] = args.obs_dir
+            if engine.tracer is not None:
+                from flashmoe_tpu.profiler.export import (
+                    write_request_trace,
+                )
+                from flashmoe_tpu.telemetry_plane.server import (
+                    host_shard_path,
+                )
+
+                problems = engine.tracer.validate()
+                summary["trace_problems"] = problems
+                engine.tracer.export_jsonl(
+                    os.path.join(args.obs_dir, "trace.jsonl"))
+                write_request_trace(
+                    engine.tracer,
+                    os.path.join(args.obs_dir, "request_trace.json"))
+                # the per-host shard: this process's spans under its
+                # host id, mergeable by `observe --merge`
+                engine.tracer.export_jsonl(
+                    host_shard_path(args.obs_dir))
+    finally:
+        engine.close()
     print(json.dumps(summary), flush=True)
     return 0
 

@@ -287,7 +287,8 @@ class ServingEngine:
 
     def __init__(self, params, cfg: MoEConfig,
                  serve: ServeConfig | None = None, *,
-                 recorder=None, slo=None, mesh=None, metrics_obj=None):
+                 recorder=None, slo=None, mesh=None, metrics_obj=None,
+                 tracer=None, telemetry_port=None):
         if cfg.drop_tokens:
             raise ValueError(
                 "the serving engine requires a dropless config "
@@ -302,6 +303,27 @@ class ServingEngine:
         self.metrics = metrics_obj if metrics_obj is not None \
             else _global_metrics
         self.watchdog = _as_watchdog(slo)
+        # ---- live telemetry plane (default off = zero threads, no
+        # behavior change; outputs are bit-identical either way) ------
+        self.tracer = None
+        if tracer:
+            from flashmoe_tpu.telemetry_plane.tracing import RequestTracer
+
+            self.tracer = (tracer if isinstance(tracer, RequestTracer)
+                           else RequestTracer(metrics_obj=self.metrics))
+            self.tracer.install()
+        self.telemetry = None
+        if telemetry_port is not None:
+            from flashmoe_tpu.telemetry_plane.server import maybe_server
+
+            self.telemetry = maybe_server(
+                telemetry_port, metrics_fn=lambda: self.metrics,
+                health_fn=self._health_snapshot,
+                vars_fn=self._vars_snapshot)
+        from flashmoe_tpu.telemetry_plane.sketch import WindowedRate
+
+        self._rates = {"tokens": WindowedRate(), "admits": WindowedRate(),
+                       "evictions": WindowedRate()}
 
         self.cache = init_paged_cache(cfg, self.serve.num_pages,
                                       self.serve.page_size)
@@ -343,6 +365,55 @@ class ServingEngine:
             decode_tokens=self.serve.max_batch,
             heterogeneous=(pre_b, pre_c) != (dec_b, dec_c),
             ep=cfg.ep, moe_backend=cfg.moe_backend)
+
+    # ---- live-plane snapshots ----------------------------------------
+
+    def _health_snapshot(self) -> dict:
+        """The ``/healthz`` document: liveness plus the engine's load
+        story and the SLO watchdog's episode state."""
+        doc = {
+            "steps": self.step_idx,
+            "queue_depth": len(self.queue),
+            "active_requests": len(self._active()),
+            "cache_occupancy": round(self.pool.occupancy, 4),
+            "completed": self.stats["completed"],
+            "evictions": self.stats["evictions"],
+        }
+        if self.watchdog is not None:
+            doc["slo"] = self.watchdog.snapshot()
+        return doc
+
+    def _vars_snapshot(self) -> dict:
+        """The ``/vars`` document: what this engine actually resolved
+        to run (plans + shape knobs)."""
+        cfg = self.cfg
+        return {
+            "prefill_plan": list(self.prefill_plan),
+            "decode_plan": list(self.decode_plan),
+            "serve": dataclasses.asdict(self.serve),
+            "config": {
+                "num_experts": cfg.num_experts,
+                "expert_top_k": cfg.expert_top_k,
+                "hidden_size": cfg.hidden_size,
+                "intermediate_size": cfg.intermediate_size,
+                "num_layers": cfg.num_layers,
+                "moe_backend": cfg.moe_backend,
+                "serving_mode": cfg.serving_mode,
+                "wire_dtype": cfg.wire_dtype,
+                "a2a_chunks": cfg.a2a_chunks,
+                "ep": cfg.ep,
+            },
+            "tracing": self.tracer is not None,
+        }
+
+    def close(self) -> None:
+        """Tear down the live plane (scrape server thread, tracer
+        listener).  Idempotent; engines without one are no-ops."""
+        if self.telemetry is not None:
+            self.telemetry.stop()
+            self.telemetry = None
+        if self.tracer is not None:
+            self.tracer.uninstall()
 
     # ---- submission --------------------------------------------------
 
@@ -389,6 +460,8 @@ class ServingEngine:
             if entry.arrival_s is None \
                     and entry.arrival_step <= self.step_idx:
                 entry.arrival_s = now
+                if self.tracer is not None:
+                    self.tracer.on_arrival(entry.orig.rid)
 
     def _admit(self) -> None:
         while self._arrived_head() and None in self.slots:
@@ -406,6 +479,11 @@ class ServingEngine:
             if t_pad > t0:
                 prompt = jnp.pad(prompt, ((0, 0), (0, t_pad - t0)),
                                  constant_values=self.serve.pad_token)
+            if self.tracer is not None:
+                # closes the queued span and arms prefill attribution
+                # for the trace_span below
+                self.tracer.on_admit(orig.rid, self.step_idx,
+                                     resumed=req is not orig)
             with trace_span("serve.prefill"):
                 logits, k_seq, v_seq = _prefill_padded(
                     self.params, self.cfg, prompt, jnp.int32(t0))
@@ -422,6 +500,7 @@ class ServingEngine:
                 arrival_s=entry.arrival_s,
                 first_token_s=entry.first_token_s)
             self.stats["prefill_buckets"].add(t_pad)
+            self._rates["admits"].add()
             self.metrics.decision(
                 "serve.admit", rid=orig.rid, step=self.step_idx,
                 slot=slot, prompt_tokens=t0, pages=n_pages,
@@ -456,6 +535,9 @@ class ServingEngine:
             s.first_token_s))
         self.slots[victim] = None
         self.stats["evictions"] += 1
+        self._rates["evictions"].add()
+        if self.tracer is not None:
+            self.tracer.on_evict(s.orig.rid, self.step_idx)
         self.metrics.count("serve.evictions")
         self.metrics.decision(
             "serve.evict", rid=s.orig.rid, step=self.step_idx,
@@ -503,6 +585,16 @@ class ServingEngine:
         tpot_ms = None
         if s.first_token_s is not None and n_tok > 1:
             tpot_ms = (now - s.first_token_s) * 1e3 / (n_tok - 1)
+        # O(1)-memory rolling percentiles for the live /metrics scrape
+        # (and summary()) — no per-request list grows under load
+        if ttft_ms is not None:
+            self.metrics.sketch("serve.ttft_ms", ttft_ms)
+        if tpot_ms is not None:
+            self.metrics.sketch("serve.tpot_ms", tpot_ms)
+        if self.tracer is not None:
+            self.tracer.on_retire(s.orig.rid, self.step_idx,
+                                  tokens=n_tok, ttft_ms=ttft_ms,
+                                  tpot_ms=tpot_ms)
         self.metrics.decision(
             "serve.retire", rid=s.orig.rid, step=self.step_idx,
             slot=slot, tokens=n_tok,
@@ -526,6 +618,13 @@ class ServingEngine:
         recorder when one is attached)."""
         t0_s = time.monotonic()
         sv = self.serve
+        if self.tracer is not None:
+            # open the step window BEFORE admissions: everything in
+            # this step (a neighbour's prefill compile included) rides
+            # a serve.step span on each active request's track
+            self.tracer.begin_step(
+                self.step_idx,
+                [self.slots[i].orig.rid for i in self._active()])
         self._mark_arrivals()
         self._admit()
 
@@ -595,6 +694,8 @@ class ServingEngine:
                 self.slots[i].length += 1
 
         # telemetry
+        if self.tracer is not None:
+            self.tracer.end_step()
         step_ms = (time.monotonic() - t0_s) * 1e3
         n_active = len(self._active())
         qd = len(self.queue)
@@ -608,6 +709,15 @@ class ServingEngine:
         self.metrics.gauge("serve.queue_depth", qd)
         self.metrics.gauge("serve.active_requests", n_active)
         self.metrics.gauge("serve.cache_occupancy", occ)
+        # rolling distributions + windowed rates for the live scrape
+        self.metrics.sketch("serve.step_ms", step_ms)
+        self.metrics.sketch("serve.queue_depth_dist", qd)
+        self.metrics.gauge("serve.tokens_per_s",
+                           self._rates["tokens"].add(emitted_now))
+        self.metrics.gauge("serve.admits_per_s",
+                           self._rates["admits"].rate())
+        self.metrics.gauge("serve.evictions_per_s",
+                           self._rates["evictions"].rate())
         rec = {
             "kind": "serve_step", "step": self.step_idx,
             "active": n_active, "queue_depth": qd,
@@ -629,14 +739,17 @@ class ServingEngine:
     def pending(self) -> bool:
         return bool(self.queue) or bool(self._active())
 
-    def run(self, requests=None, arrivals=None) -> dict:
+    def run(self, requests=None, arrivals=None, *, until=None) -> dict:
         """Drive to completion.  ``requests``: iterable of
         :class:`Request`; ``arrivals``: matching arrival steps (default
-        all 0 — the seeded arrival trace of a drill).  Returns
-        {rid: full token list (prompt + generated)}."""
+        all 0 — the seeded arrival trace of a drill).  ``until``: an
+        optional zero-arg predicate that PAUSES the drive early when it
+        turns true (the live-plane mid-drill scrape; call ``run()``
+        again to finish) — the max_steps wedge guard applies either
+        way.  Returns {rid: full token list (prompt + generated)}."""
         for idx, req in enumerate(requests or ()):
             self.submit(req, int(arrivals[idx]) if arrivals else 0)
-        while self.pending():
+        while self.pending() and not (until is not None and until()):
             if self.step_idx >= self.serve.max_steps:
                 raise RuntimeError(
                     f"engine exceeded max_steps={self.serve.max_steps} "
@@ -648,17 +761,16 @@ class ServingEngine:
         s = dict(self.stats)
         s["decode_buckets"] = sorted(s["decode_buckets"])
         s["prefill_buckets"] = sorted(s["prefill_buckets"])
-        retires = [d for d in self.metrics.decisions
-                   if d.get("decision") == "serve.retire"]
-        ttfts = [d["ttft_ms"] for d in retires
-                 if d.get("ttft_ms") is not None]
-        tpots = [d["tpot_ms"] for d in retires
-                 if d.get("tpot_ms") is not None]
-        if ttfts:
-            s["ttft_ms_mean"] = round(sum(ttfts) / len(ttfts), 3)
-            s["ttft_ms_max"] = round(max(ttfts), 3)
-        if tpots:
-            s["tpot_ms_mean"] = round(sum(tpots) / len(tpots), 3)
+        # O(1)-memory: the retire-time sketches, not a decision scan
+        # (the decision list grows without bound under sustained load)
+        tt = self.metrics.sketches.get("serve.ttft_ms")
+        if tt is not None and tt.n:
+            s["ttft_ms_mean"] = round(tt.mean, 3)
+            s["ttft_ms_max"] = round(tt.max, 3)
+            s["ttft_ms_p99"] = round(tt.quantile(0.99), 3)
+        tp = self.metrics.sketches.get("serve.tpot_ms")
+        if tp is not None and tp.n:
+            s["tpot_ms_mean"] = round(tp.mean, 3)
         s["decode_plan"] = list(self.decode_plan)
         s["prefill_plan"] = list(self.prefill_plan)
         return s

@@ -62,12 +62,21 @@ def pctl(values, q: float):
 def serve_load_sweep(loads, *, n_requests: int = 8, max_batch: int = 4,
                      prompt_len: int = 8, max_new: int = 6,
                      seed: int = 0, page_size: int = 8,
-                     num_pages: int = 64) -> list[dict]:
+                     num_pages: int = 64,
+                     telemetry_port: int | None = None) -> list[dict]:
     """One bench record per offered-load point (``loads``: arrival
     gaps in engine steps, descending = rising load).  ``vs_baseline``
     is each point's throughput relative to the LIGHTEST load measured
     — the saturation curve.  Deterministic token streams per seed;
-    latency numbers are wall-clock."""
+    latency numbers are wall-clock.
+
+    ``telemetry_port`` (``bench.py --serve --telemetry-port N``): one
+    scrape server spans the whole sweep, resolving to the CURRENT
+    point's metrics stream; each record then carries a mid-sweep
+    ``/metrics`` self-scrape (``telemetry_scrape``: exposition size,
+    whether the TTFT/TPOT summary quantiles were present and the text
+    parsed) — the live plane drilled by the same contract tests as the
+    rest of the bench surface."""
     import time
 
     import jax
@@ -83,6 +92,56 @@ def serve_load_sweep(loads, *, n_requests: int = 8, max_batch: int = 4,
         max_pages_per_slot=max(
             2, -(-(prompt_len + max_new) // page_size) + 1),
         ctx_bucket_pages=1, prompt_bucket=page_size)
+    holder = [Metrics()]
+    server = None
+    if telemetry_port is not None:
+        from flashmoe_tpu.telemetry_plane.server import maybe_server
+
+        server = maybe_server(telemetry_port,
+                              metrics_fn=lambda: holder[0])
+    try:
+        records = _sweep_points(loads, params, cfg, serve, holder,
+                                server, n_requests=n_requests,
+                                max_batch=max_batch,
+                                prompt_len=prompt_len, max_new=max_new,
+                                seed=seed)
+    finally:
+        if server is not None:
+            server.stop()
+    return records
+
+
+def _scrape_metrics(server) -> dict:
+    """The mid-sweep self-scrape: fetch ``/metrics`` off the live
+    server and report whether it parsed and carried the serving
+    summary quantiles."""
+    from flashmoe_tpu.telemetry_plane.server import scrape
+
+    try:
+        body, ctype = scrape(f"{server.url}/metrics")
+    except Exception as e:  # noqa: BLE001 — the record survives
+        return {"ok": False, "error": f"{type(e).__name__}: "
+                                      f"{str(e)[:120]}"}
+    return {
+        "ok": True,
+        "bytes": len(body),
+        "content_type": ctype,
+        "has_ttft_quantiles":
+            'flashmoe_serve_ttft_ms{quantile="' in body,
+        "has_tpot_quantiles":
+            'flashmoe_serve_tpot_ms{quantile="' in body,
+    }
+
+
+def _sweep_points(loads, params, cfg, serve, holder, server, *,
+                  n_requests, max_batch, prompt_len, max_new, seed):
+    import time
+
+    from flashmoe_tpu.serving.engine import ServingEngine
+    from flashmoe_tpu.utils.telemetry import Metrics
+
+    import jax
+
     records = []
     base_tps = None
     for every in loads:
@@ -93,10 +152,32 @@ def serve_load_sweep(loads, *, n_requests: int = 8, max_batch: int = 4,
             n_requests, vocab=cfg.vocab_size, prompt_len=prompt_len,
             max_new=max_new, seed=seed, arrival_every=int(every))
         mx = Metrics()   # private stream per point: clean retire stats
+        holder[0] = mx   # the live server scrapes THIS point now
         engine = ServingEngine(params, cfg, serve, metrics_obj=mx)
         t0 = time.monotonic()
-        engine.run(reqs, arrivals)
-        wall_s = max(time.monotonic() - t0, 1e-9)
+        scrape_rec = None
+        scrape_pause_s = 0.0
+        if server is not None:
+            # drive until the first retirement seeds the TTFT/TPOT
+            # sketches, scrape MID-DRILL (work still in flight), then
+            # run to completion — the live-plane acceptance: the
+            # scrape must carry the serving summary quantiles.  Both
+            # legs go through engine.run() (its max_steps wedge guard
+            # applies: a starved queue fails fast, never spins).  The
+            # scrape pause is EXCLUDED from the timed window so the
+            # throughput number stays comparable with a plain sweep —
+            # and the record's identity key is still tagged
+            # ``telemetry`` below, so the sentry never baselines an
+            # armed run against an unarmed one.
+            engine.run(reqs, arrivals,
+                       until=lambda: "serve.ttft_ms" in mx.sketches)
+            t_pause = time.monotonic()
+            scrape_rec = _scrape_metrics(server)
+            scrape_pause_s = time.monotonic() - t_pause
+            engine.run()
+        else:
+            engine.run(reqs, arrivals)
+        wall_s = max(time.monotonic() - t0 - scrape_pause_s, 1e-9)
         s = engine.summary()
         tps = s["tokens"] / wall_s
         base_tps = base_tps if base_tps is not None else tps
@@ -106,9 +187,12 @@ def serve_load_sweep(loads, *, n_requests: int = 8, max_batch: int = 4,
                  if d.get("ttft_ms") is not None]
         tpots = [d["tpot_ms"] for d in retires
                  if d.get("tpot_ms") is not None]
+        # telemetry arming rides the measurement identity: an armed
+        # run's numbers never baseline an unarmed run's in the sentry
+        tag = ",telemetry" if server is not None else ""
         records.append({
             "metric": f"serve_load[every={every},B={max_batch},"
-                      f"req={n_requests}]",
+                      f"req={n_requests}{tag}]",
             "value": round(tps, 1),
             "unit": "tokens_per_sec",
             "vs_baseline": round(tps / base_tps, 3) if base_tps
@@ -127,4 +211,7 @@ def serve_load_sweep(loads, *, n_requests: int = 8, max_batch: int = 4,
             "decode_plan": s["decode_plan"],
             "backend": jax.default_backend(),
         })
+        if scrape_rec is not None:
+            records[-1]["telemetry_scrape"] = scrape_rec
+            records[-1]["telemetry_port"] = server.port
     return records

@@ -1,0 +1,252 @@
+"""Perf-regression sentry: the repo's durable performance trajectory.
+
+Every hardware bench window this repo ever asked for hung (BENCH_r01..
+r05), so until now a modeled-cost regression in a PR was only caught if
+a golden number happened to move.  This module gives the framework a
+memory:
+
+* :func:`append_run` persists one run's metric points to
+  ``obs/history.jsonl`` — one JSON line per run: ``{"run", "meta",
+  "metrics": {key: {"value", "unit"}}}`` — keyed by the measurement-
+  identity strings the bench/serving records already carry (the PR 5/6/
+  12 convention: the ``metric`` field encodes path/d/chunks/wire/slices,
+  so a compressed timing can never baseline an uncompressed one);
+* :func:`collect_points` extracts those points from any record pile
+  (bench records, serving sweep records, ledger rows, drill summaries);
+* :func:`reference_points` computes the deterministic modeled points of
+  the golden planner configs (``predicted_ms`` at the golden 8-rank
+  mesh) — the CI-stable rows the committed baseline seed is built from;
+* :func:`check_regression` compares the NEWEST run against a rolling
+  baseline (median of up to ``baseline_n`` prior runs per key) with
+  per-unit tolerances, emitting one ``regress.detected`` decision per
+  offending metric.
+
+CLI: ``python -m flashmoe_tpu.observe --regression [--ci] [history]``
+renders the report; ``--ci`` exits rc 2 when anything regressed.
+``bench.py --regression`` appends the run it just measured.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+
+#: default history location (relative to the repo/session cwd)
+DEFAULT_HISTORY = os.path.join("obs", "history.jsonl")
+
+#: relative tolerance per unit before a move counts as a regression;
+#: ``_DIR`` says which direction is "worse" (+1 = higher is worse)
+UNIT_TOLERANCE = {
+    "ms": 0.15,
+    "tokens_per_sec": 0.15,
+    "ratio_vs_serialized": 0.15,
+}
+DEFAULT_TOLERANCE = 0.25
+_DIR = {
+    "ms": +1.0,                   # latency: up is worse
+    "tokens_per_sec": -1.0,       # throughput: down is worse
+    "ratio_vs_serialized": -1.0,  # overlap efficiency: down is worse
+}
+
+
+def collect_points(records) -> dict[str, dict]:
+    """Metric points of one run, keyed by their identity string.
+
+    A point is any record with a string ``metric`` and a finite numeric
+    ``value`` (skipped/partial/error records are not a run's numbers —
+    a wedged-tunnel ``skipped:true`` line must never enter the
+    baseline).  Serving-drill summaries (``ttft_ms_p50`` et al. on a
+    ``serve_load[...]`` record) ride along as derived points so the
+    sentry watches tail latency, not just the headline value."""
+    points: dict[str, dict] = {}
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        key = rec.get("metric")
+        val = rec.get("value")
+        if not isinstance(key, str) or rec.get("skipped") \
+                or rec.get("partial") or rec.get("error"):
+            continue
+        if isinstance(val, bool) or not isinstance(val, (int, float)):
+            continue
+        points[key] = {"value": float(val),
+                       "unit": str(rec.get("unit", ""))}
+        for sub in ("ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50",
+                    "predicted_ms"):
+            sv = rec.get(sub)
+            if isinstance(sv, (int, float)) and not isinstance(sv, bool):
+                points[f"{key}.{sub}"] = {"value": float(sv),
+                                          "unit": "ms"}
+    return points
+
+
+def reference_points(gen: str = "v5e") -> dict[str, dict]:
+    """Deterministic modeled points for the golden planner configs:
+    the resolved path's predicted latency on the golden 8-rank mesh.
+    Pure cost-model output — stable across machines, which is what the
+    committed baseline seed (and its clean-history CI gate) needs."""
+    from flashmoe_tpu.config import BENCH_CONFIGS
+    from flashmoe_tpu.planner.golden import GOLDEN_CONFIGS, GOLDEN_D
+    from flashmoe_tpu.planner.model import predict_paths
+
+    points: dict[str, dict] = {}
+    for name in GOLDEN_CONFIGS:
+        cfg = BENCH_CONFIGS[name].replace(ep=GOLDEN_D)
+        preds = [p for p in predict_paths(cfg, GOLDEN_D, gen)
+                 if p.feasible]
+        if not preds:
+            continue
+        win = preds[0]
+        points[f"planner_predicted_ms[{name},d={GOLDEN_D},{gen}]"] = {
+            "value": round(win.total_ms, 4), "unit": "ms",
+        }
+    return points
+
+
+def append_run(path: str, points: dict[str, dict], *,
+               run: str | None = None, meta: dict | None = None) -> dict:
+    """Append one run line to the history (creating directories as
+    needed).  Returns the entry written; a run with no points is not
+    written (and returns {})."""
+    if not points:
+        return {}
+    entry = {
+        "run": run or time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "meta": dict(meta or {}),
+        "metrics": points,
+    }
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    return entry
+
+
+def load_history(path: str) -> list[dict]:
+    """All run entries, oldest first.  Unparseable lines skipped (the
+    observe.load_jsonl convention)."""
+    if not os.path.exists(path):
+        return []
+    runs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and isinstance(rec.get("metrics"),
+                                                    dict):
+                runs.append(rec)
+    return runs
+
+
+def _tolerance(unit: str, overrides: dict | None) -> float:
+    if overrides and unit in overrides:
+        return float(overrides[unit])
+    return UNIT_TOLERANCE.get(unit, DEFAULT_TOLERANCE)
+
+
+def check_regression(runs: list[dict], *, baseline_n: int = 5,
+                     tolerances: dict | None = None,
+                     metrics_obj=None) -> dict:
+    """Judge the newest run against the rolling baseline.
+
+    For every metric key the newest run shares with at least one prior
+    run, baseline = median of that key's values over the last
+    ``baseline_n`` prior runs; the move is a regression when it exceeds
+    the unit's tolerance in the unit's "worse" direction (higher ms,
+    lower tokens/s).  Each regression emits one registered
+    ``regress.detected`` decision.  Returns the report dict the CLI
+    renders (``regressions`` non-empty = rc 2 under ``--ci``)."""
+    report = {"runs": len(runs), "compared": 0, "regressions": [],
+              "improvements": [], "new_metrics": [], "rows": []}
+    if len(runs) < 2:
+        report["note"] = ("need >= 2 runs to compare (newest vs rolling "
+                          "baseline); history has "
+                          f"{len(runs)}")
+        return report
+    newest = runs[-1]
+    prior = runs[:-1]
+    for key, pt in sorted(newest["metrics"].items()):
+        vals = [r["metrics"][key]["value"] for r in prior[-baseline_n:]
+                if key in r.get("metrics", {})
+                and isinstance(r["metrics"][key].get("value"),
+                               (int, float))]
+        if not vals:
+            report["new_metrics"].append(key)
+            continue
+        vals.sort()
+        # true median: even-sized windows average the middle pair (the
+        # upper-middle element alone made the sentry more lenient
+        # exactly when history is short)
+        mid = len(vals) // 2
+        baseline = (vals[mid] if len(vals) % 2
+                    else (vals[mid - 1] + vals[mid]) / 2.0)
+        value = float(pt["value"])
+        unit = str(pt.get("unit", ""))
+        tol = _tolerance(unit, tolerances)
+        direction = _DIR.get(unit, +1.0)  # unknown units: up is worse
+        if baseline == 0:
+            # rel carries the CHANGE's sign only (any move off a zero
+            # baseline is an unbounded relative change); finite
+            # sentinel keeps the --json report valid JSON, and the
+            # direction multiply below decides bad vs good exactly
+            # once — a throughput recovery from a 0-baseline run is an
+            # improvement, not a regression
+            rel = 0.0 if value == 0 else math.copysign(1e9, value)
+        else:
+            rel = (value - baseline) / abs(baseline)
+        worse = rel * direction       # positive = moved the bad way
+        row = {"metric": key, "value": value, "baseline": baseline,
+               "unit": unit, "rel_change": round(rel, 4),
+               "tolerance": tol, "n_baseline": len(vals),
+               "regressed": bool(worse > tol)}
+        report["rows"].append(row)
+        report["compared"] += 1
+        if worse > tol:
+            report["regressions"].append(row)
+            mo = metrics_obj
+            if mo is None:
+                from flashmoe_tpu.utils import telemetry as _t
+
+                mo = _t.metrics
+            mo.decision(
+                "regress.detected", metric=key, value=value,
+                baseline=baseline, unit=unit,
+                rel_change=row["rel_change"], tolerance=tol,
+                run=newest.get("run"))
+        elif -worse > tol:
+            report["improvements"].append(row)
+    return report
+
+
+def render_text(report: dict) -> str:
+    lines = [f"perf sentry: {report['runs']} runs on record, "
+             f"{report['compared']} metrics compared, "
+             f"{len(report['regressions'])} regression(s)"]
+    if report.get("note"):
+        lines.append(f"  {report['note']}")
+    for row in report["regressions"]:
+        lines.append(
+            f"  REGRESSED {row['metric']}: {row['value']:g} {row['unit']}"
+            f" vs baseline {row['baseline']:g} "
+            f"({row['rel_change']:+.1%}, tol ±{row['tolerance']:.0%}, "
+            f"n={row['n_baseline']})")
+    for row in report["improvements"]:
+        lines.append(
+            f"  improved  {row['metric']}: {row['value']:g} "
+            f"{row['unit']} vs {row['baseline']:g} "
+            f"({row['rel_change']:+.1%})")
+    if report["new_metrics"]:
+        lines.append("  new (no baseline yet): "
+                     + ", ".join(report["new_metrics"][:8])
+                     + (" ..." if len(report["new_metrics"]) > 8 else ""))
+    if not report["regressions"] and report["compared"]:
+        lines.append("  all within tolerance")
+    return "\n".join(lines)

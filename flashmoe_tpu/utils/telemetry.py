@@ -88,6 +88,9 @@ DECISION_NAMES: dict[str, str] = {
         "graceful drain completed: final step, remaining grace",
     "preempt.notice":
         "a preemption notice arrived (signal source, grace budget)",
+    "regress.detected":
+        "the perf sentry found a metric past its tolerance vs the "
+        "rolling baseline in obs/history.jsonl",
     "planner.phase_drift":
         "one MoE phase's measured time compared against its prediction",
     "postmortem.saved":
@@ -106,12 +109,19 @@ DECISION_NAMES: dict[str, str] = {
     "serve.retire":
         "a request completed (stop token or max length) with its "
         "TTFT/TPOT",
+    "serve.trace":
+        "a request's trace closed at retirement: trace_id, span count, "
+        "evictions, end-to-end duration (telemetry_plane/tracing.py)",
     "slo.breach":
         "a step/phase time exceeded its SLO budget",
     "slo.recovered":
         "a breached SLO target returned under budget",
     "supervisor.resume":
         "a restart resumed: incarnation, step, world size, ep x dp",
+    "telemetry.server_start":
+        "the live telemetry scrape server came up (bound port)",
+    "telemetry.server_stop":
+        "the live telemetry scrape server shut down",
     "trainer.grad_skip":
         "tier 1 skipped an anomalous update in-graph",
 }
@@ -139,6 +149,15 @@ SPAN_NAMES: dict[str, str] = {
         "serving engine: single-pass prompt prefill into cache pages",
     "serve.decode":
         "serving engine: one continuous-batching decode step",
+    "serve.queued":
+        "request trace: queue wait from arrival (or eviction — "
+        "``resumed``) to admission; the visible eviction gap",
+    "serve.request":
+        "request trace: the parent span of one request's whole "
+        "lifecycle (trace_id minted at serve.admit)",
+    "serve.step":
+        "request trace: the full engine-step window a request rode "
+        "(covers host sampling/compile between the jitted spans)",
     "train.data_pull": "host wait on the data iterator",
     "train.step": "one train step: dispatch + device execution",
     "train.checkpoint": "checkpoint save on the step loop",
@@ -191,6 +210,13 @@ def set_span_listener(listener) -> None:
     """Install (or, with ``None``, remove) the span listener the phase
     profiler uses to turn trace_span sites into a host-side timeline."""
     _SPAN_LISTENER[0] = listener
+
+
+def get_span_listener():
+    """The currently armed listener (None when nothing is armed) — the
+    request tracer (telemetry_plane/tracing.py) chains to it so phase
+    profiling and request tracing compose."""
+    return _SPAN_LISTENER[0]
 
 
 @contextlib.contextmanager
@@ -364,6 +390,12 @@ class FlightRecorder:
         return self._total
 
 
+#: the content type every Prometheus text-exposition response must
+#: carry (the 0.0.4 text format) — the scrape server
+#: (telemetry_plane/server.py) sends exactly this on ``/metrics``
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
 def _prom_name(name: str) -> str:
     """Sanitize to the Prometheus metric-name grammar
     ``[a-zA-Z_:][a-zA-Z0-9_:]*``."""
@@ -371,6 +403,41 @@ def _prom_name(name: str) -> str:
     if not n or n[0].isdigit():
         n = "_" + n
     return n
+
+
+def escape_label_value(value) -> str:
+    """Exposition-spec escaping for a label VALUE: backslash, newline,
+    and double-quote must be escaped (in that order — escaping the
+    backslash first keeps ``\\n`` from double-encoding), or a hostile
+    value (a path with quotes, a reason string with newlines) breaks
+    every parser downstream of ``/metrics``."""
+    return (str(value).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _snapshot(obj, copy=dict):
+    """Copy a registry container that another thread may be growing.
+
+    Even a plain ``dict(d)`` / ``list(d.items())`` can raise
+    "dictionary changed size during iteration" when the job thread
+    inserts a new key mid-copy (observed under a scrape-hammer on
+    CPython 3.10) — retry until a consistent copy lands; under the GIL
+    a handful of attempts always suffices."""
+    for _ in range(64):
+        try:
+            return copy(obj)
+        except RuntimeError:
+            continue
+    return copy(obj)    # last try: surface the error if truly stuck
+
+
+def _prom_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{_prom_name(str(k))}="{escape_label_value(v)}"'
+        for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
 
 
 class Metrics:
@@ -384,6 +451,9 @@ class Metrics:
         self.gauges: dict[str, float] = {}
         self.times: dict[str, list[float]] = defaultdict(list)
         self.histograms: dict[str, Histogram] = {}
+        self.sketches: dict = {}          # name -> QuantileSketch
+        # name -> {sorted (label, value) tuple -> gauge value}
+        self.labeled_gauges: dict[str, dict[tuple, float]] = {}
         self.decisions: list[dict] = []
 
     def count(self, name: str, inc: float = 1.0):
@@ -392,12 +462,36 @@ class Metrics:
     def gauge(self, name: str, value: float):
         self.gauges[name] = float(value)
 
+    def labeled_gauge(self, name: str, value: float, **labels):
+        """A gauge with label dimensions (one value per label set) —
+        e.g. ``labeled_gauge("serve.rate", 120.0, kind="tokens")``.
+        Label VALUES are exposition-escaped at render time, so hostile
+        strings (quotes, newlines, backslashes) cannot corrupt
+        ``/metrics``."""
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        self.labeled_gauges.setdefault(name, {})[key] = float(value)
+
     def histogram(self, name: str, value: float, buckets=None):
         h = self.histograms.get(name)
         if h is None:
             h = self.histograms[name] = Histogram(buckets)
         h.observe(value)
         return h
+
+    def sketch(self, name: str, value: float, quantiles=None):
+        """Observe ``value`` on the named streaming quantile sketch
+        (telemetry_plane/sketch.py): O(1)-memory rolling p50/p90/p99
+        instead of a full-history percentile list — the live plane's
+        replacement for unbounded TTFT/TPOT retention.  Rendered as a
+        Prometheus summary by :meth:`prometheus_text`."""
+        s = self.sketches.get(name)
+        if s is None:
+            from flashmoe_tpu.telemetry_plane.sketch import QuantileSketch
+
+            s = self.sketches[name] = QuantileSketch(
+                quantiles or QuantileSketch.DEFAULT_QS)
+        s.observe(value)
+        return s
 
     def decision(self, name: str, **fields) -> dict:
         """Record a structured decision (e.g. the planner's path choice
@@ -447,40 +541,88 @@ class Metrics:
         for k, h in self.histograms.items():
             for stat, val in h.summary().items():
                 out[f"{k}_{stat}"] = val
+        for k, s in self.sketches.items():
+            for stat, val in s.summary().items():
+                if val is not None:
+                    out[f"{k}_{stat}"] = val
         return out
 
     def prometheus_text(self, prefix: str = "flashmoe") -> str:
-        """Prometheus text-exposition rendering of the registry: counters
-        as ``*_total``, gauges as gauges, timers as summaries (seconds),
-        histograms with cumulative ``le`` buckets — scrape-ready from any
-        debug endpoint or dumped next to the flight recorder."""
+        """Prometheus text-exposition (format 0.0.4) rendering of the
+        registry: counters as ``*_total``, gauges (labeled included),
+        timers and quantile sketches as summaries, histograms with
+        cumulative ``le`` buckets.  Every family carries its ``# HELP``
+        and ``# TYPE`` lines and every label value is spec-escaped
+        (:func:`escape_label_value`); serve it with
+        :data:`PROM_CONTENT_TYPE` (the scrape server does).
+
+        Renders from SHALLOW SNAPSHOTS of the registry dicts: the
+        scrape server calls this from its own thread while the job
+        thread registers new metrics, and iterating the live dicts
+        would intermittently raise "dictionary changed size during
+        iteration" (an HTTP 500 on the first scrape that races a
+        first-time counter/sketch)."""
         lines: list[str] = []
+        counters = _snapshot(self.counters)
+        gauges = _snapshot(self.gauges)
+        labeled = {k: _snapshot(v)
+                   for k, v in _snapshot(self.labeled_gauges).items()}
+        times = {k: _snapshot(v, list)
+                 for k, v in _snapshot(self.times).items()}
+        sketches = _snapshot(self.sketches)
+        histograms = _snapshot(self.histograms)
 
         def fmt(v: float) -> str:
             return repr(float(v))
 
-        for name in sorted(self.counters):
+        def family(n: str, kind: str, desc: str):
+            lines.append(f"# HELP {n} {escape_label_value(desc)}")
+            lines.append(f"# TYPE {n} {kind}")
+
+        for name in sorted(counters):
             n = f"{prefix}_{_prom_name(name)}_total"
-            lines += [f"# TYPE {n} counter", f"{n} {fmt(self.counters[name])}"]
-        for name in sorted(self.gauges):
+            family(n, "counter", f"flashmoe counter {name}")
+            lines.append(f"{n} {fmt(counters[name])}")
+        for name in sorted(gauges):
             n = f"{prefix}_{_prom_name(name)}"
-            lines += [f"# TYPE {n} gauge", f"{n} {fmt(self.gauges[name])}"]
-        for name in sorted(self.times):
-            v = self.times[name]
+            family(n, "gauge", f"flashmoe gauge {name}")
+            lines.append(f"{n} {fmt(gauges[name])}")
+        for name in sorted(labeled):
+            series = labeled[name]
+            n = f"{prefix}_{_prom_name(name)}"
+            family(n, "gauge", f"flashmoe gauge {name}")
+            for key in sorted(series):
+                lines.append(f"{n}{_prom_labels(dict(key))} "
+                             f"{fmt(series[key])}")
+        for name in sorted(times):
+            v = times[name]
             if not v:
                 continue
             n = f"{prefix}_{_prom_name(name)}_seconds"
             s = sorted(v)
+            family(n, "summary", f"flashmoe timer {name} (seconds)")
             lines += [
-                f"# TYPE {n} summary",
                 f'{n}{{quantile="0.5"}} {fmt(s[len(s) // 2])}',
                 f"{n}_sum {fmt(sum(v))}",
                 f"{n}_count {len(v)}",
             ]
-        for name in sorted(self.histograms):
-            h = self.histograms[name]
+        for name in sorted(sketches):
+            sk = sketches[name]
+            if not sk.n:
+                continue
             n = f"{prefix}_{_prom_name(name)}"
-            lines.append(f"# TYPE {n} histogram")
+            family(n, "summary",
+                   f"flashmoe streaming quantile sketch {name}")
+            for q in sk.quantiles:
+                val = sk.quantile(q)
+                if val is not None:
+                    lines.append(f'{n}{{quantile="{q:g}"}} {fmt(val)}')
+            lines.append(f"{n}_sum {fmt(sk.total)}")
+            lines.append(f"{n}_count {sk.n}")
+        for name in sorted(histograms):
+            h = histograms[name]
+            n = f"{prefix}_{_prom_name(name)}"
+            family(n, "histogram", f"flashmoe histogram {name}")
             cum = 0
             for bound, c in zip(h.buckets, h.counts):
                 cum += c

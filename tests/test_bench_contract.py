@@ -453,3 +453,113 @@ def test_cli_scaling_emits_skipped_record_when_probe_hangs(monkeypatch,
     assert e.value.code == 2
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["error"].startswith("backend probe rc=1")
+
+
+def test_cli_serve_telemetry_port_plumbed(monkeypatch, capsys):
+    """`bench.py --serve --telemetry-port N` hands the port to the
+    load sweep (which self-scrapes /metrics mid-sweep)."""
+    import sys as _sys
+
+    import bench
+    from flashmoe_tpu.serving import loadgen
+
+    seen = {}
+
+    def fake_sweep(loads, *, n_requests=8, max_batch=4,
+                   telemetry_port=None, **kw):
+        seen.update(port=telemetry_port)
+        return [{"metric": "serve_load[every=4,B=4,req=8]",
+                 "value": 10.0, "unit": "tokens_per_sec",
+                 "telemetry_scrape": {"ok": True}}]
+
+    monkeypatch.setattr(loadgen, "serve_load_sweep", fake_sweep)
+    monkeypatch.setattr(_sys, "argv",
+                        ["bench.py", "--serve", "--telemetry-port",
+                         "0", "--deadline", "0"])
+    bench.main()
+    assert seen == {"port": 0}
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["telemetry_scrape"]["ok"] is True
+
+
+def test_cli_live_plane_flag_exclusivity(monkeypatch, capsys):
+    """The fail-fast contract on the new flags: --telemetry-port
+    without --serve and --regression with modes it cannot record are
+    rejected rc 2."""
+    import sys as _sys
+
+    import bench
+
+    cases = [
+        ["bench.py", "--telemetry-port", "9100"],
+        ["bench.py", "--telemetry-port", "9100", "--ckpt"],
+        ["bench.py", "--telemetry-port", "9100", "--profile-quick"],
+        ["bench.py", "--regression", "--ckpt"],
+        ["bench.py", "--regression", "--overlap", "4"],
+        ["bench.py", "--regression", "--sweep", "ep"],
+        ["bench.py", "--regression", "--tiles"],
+    ]
+    for argv in cases:
+        monkeypatch.setattr(_sys, "argv", argv)
+        with pytest.raises(SystemExit) as e:
+            bench.main()
+        assert e.value.code == 2, argv
+        capsys.readouterr()
+
+
+def test_cli_regression_appends_history(monkeypatch, capsys, tmp_path):
+    """`bench.py --serve --regression` appends ONE run entry keyed by
+    the records' measurement-identity strings to obs/history.jsonl
+    under --obs-dir."""
+    import sys as _sys
+
+    import bench
+    from flashmoe_tpu.serving import loadgen
+
+    monkeypatch.setattr(
+        loadgen, "serve_load_sweep",
+        lambda loads, **kw: [
+            {"metric": "serve_load[every=4,B=4,req=8]", "value": 50.0,
+             "unit": "tokens_per_sec", "ttft_ms_p50": 4.0},
+            {"metric": "serve_load[every=1,B=4,req=8]", "value": None,
+             "unit": "tokens_per_sec", "skipped": True},
+        ])
+    obs = tmp_path / "obs"
+    monkeypatch.setattr(_sys, "argv",
+                        ["bench.py", "--serve", "--regression",
+                         "--obs-dir", str(obs), "--deadline", "0"])
+    bench.main()
+    capsys.readouterr()
+    runs = [json.loads(l) for l in
+            (obs / "history.jsonl").read_text().splitlines()]
+    assert len(runs) == 1
+    keys = set(runs[0]["metrics"])
+    assert "serve_load[every=4,B=4,req=8]" in keys
+    assert "serve_load[every=4,B=4,req=8].ttft_ms_p50" in keys
+    # the skipped point never entered the baseline
+    assert not any(k.startswith("serve_load[every=1") for k in keys)
+
+
+def test_cli_regression_wedged_probe_skip_stays_rc0(monkeypatch,
+                                                    capsys, tmp_path):
+    """The wedged-tunnel contract survives the new flag: a hung probe
+    with --regression still yields ONE skipped:true record, rc 0, and
+    writes NO history entry (a skip is not a run)."""
+    import sys as _sys
+
+    import bench
+
+    monkeypatch.setattr(
+        bench, "_probe_backend_retry",
+        lambda budget_s, each_s=90, max_attempts=0:
+        (False, "backend probe hung >10s after 2 attempts / 20s", True))
+    obs = tmp_path / "obs"
+    monkeypatch.setattr(_sys, "argv",
+                        ["bench.py", "--regression", "--obs-dir",
+                         str(obs), "--probe-attempts", "2"])
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["skipped"] is True and "hung" in rec["reason"]
+    assert not (obs / "history.jsonl").exists()

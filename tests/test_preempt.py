@@ -236,3 +236,43 @@ def test_supervise_resumes_after_drain(devices, tmp_path):
     finally:
         reset_path_failures()
 
+
+
+def test_supervise_live_plane_healthz(devices, tmp_path):
+    """`supervise(telemetry_port=0)` serves ONE /healthz across the
+    job with step progress (the shared `steps` counter), the SLO
+    episode state, and the checkpoint frontier (PR 13 live plane:
+    supervise hands its own watchdog down so /healthz and the inner
+    loop judge the same episodes)."""
+    import json as _json
+    import urllib.request
+
+    from flashmoe_tpu.profiler.slo import SLOConfig
+
+    rcfg = ResilienceConfig(checkpoint_dir=str(tmp_path / "ck_lp"),
+                            checkpoint_every=2)
+    metrics = Metrics()
+    seen = {}
+
+    def probe(i):
+        if i == 3 and "hz" not in seen:
+            start = metrics.last_decision("telemetry.server_start")
+            url = f"http://127.0.0.1:{start['port']}/healthz"
+            with urllib.request.urlopen(url, timeout=5) as r:
+                seen["hz"] = _json.loads(r.read().decode())
+
+    final, _ = supervise(
+        CFG, lambda fcfg: _token_loader(tmp_path), 4, rcfg,
+        metrics=metrics, devices_fn=lambda: jax.devices()[:1],
+        fail_injector=probe, telemetry_port=0,
+        slo=SLOConfig(step_ms=1e9))
+    assert int(final.step) == 4
+    hz = seen["hz"]
+    assert hz["phase"] == "supervise" and hz["incarnation"] == 0
+    assert hz["steps_done"] == 3          # live progress mid-run
+    assert hz["last_checkpoint_step"] == 2
+    assert hz["slo"]["budgets"] == {"step_ms": 1e9}
+    assert hz["slo"]["in_breach"] == []
+    names = [d["decision"] for d in metrics.decisions]
+    assert names.count("telemetry.server_start") == 1
+    assert names.count("telemetry.server_stop") == 1

@@ -215,3 +215,41 @@ def test_fold_parallelism_warns_on_dropped_axes():
     with _w.catch_warnings():
         _w.simplefilter("error")
         fold_parallelism(CFG, 4)
+
+
+def test_resilient_train_live_plane_healthz(devices, tmp_path):
+    """`resilient_train(telemetry_port=0)` serves /healthz with step
+    progress and the last DURABLE checkpoint step while the loop runs,
+    and tears the thread down on exit (PR 13 live plane)."""
+    import json as _json
+    import urllib.request
+
+    state, step, data = _fixture(devices)
+    rcfg = ResilienceConfig(checkpoint_dir=str(tmp_path / "ck_tp"),
+                            checkpoint_every=2)
+    metrics = Metrics()
+    seen = {}
+    real_injector_calls = {"n": 0}
+
+    def probing_injector(i):
+        # piggyback on the per-step hook to scrape mid-run: the server
+        # must answer while training is in flight
+        real_injector_calls["n"] += 1
+        if i == 3 and "hz" not in seen:
+            start = metrics.last_decision("telemetry.server_start")
+            url = f"http://127.0.0.1:{start['port']}/healthz"
+            with urllib.request.urlopen(url, timeout=5) as r:
+                seen["hz"] = _json.loads(r.read().decode())
+
+    final, _ = resilient_train(state, step, data, num_steps=4,
+                               rcfg=rcfg, metrics=metrics,
+                               fail_injector=probing_injector,
+                               telemetry_port=0)
+    assert int(final.step) == 4
+    hz = seen["hz"]
+    assert hz["ok"] is True and hz["phase"] == "train"
+    assert hz["step"] == 3 and hz["num_steps"] == 4
+    assert hz["last_checkpoint_step"] == 2   # durable boundary at 2
+    names = [d["decision"] for d in metrics.decisions]
+    assert names.count("telemetry.server_start") == 1
+    assert names.count("telemetry.server_stop") == 1

@@ -509,3 +509,109 @@ def test_observe_serving_report(params, prompts, tmp_path, capsys):
     empty = tmp_path / "empty.jsonl"
     empty.write_text('{"step": 1}\n')
     assert observe.main(["--serving", str(empty)]) == 2
+
+
+# ----------------------------------------------------------------------
+# Live telemetry plane on the real engine (PR 13 acceptance)
+# ----------------------------------------------------------------------
+
+def test_live_plane_drill_8_concurrent_traced_bit_identical(params,
+                                                            prompts):
+    """The acceptance drill: 8 concurrent requests under page pressure
+    (at least one evicted/re-prefilled), tracing + scrape server ON —
+    outputs token-bit-equal to the plane-off engine, every request
+    reconstructs to a contiguous per-request Perfetto track (no orphan
+    spans, eviction gap visible) passing validate_trace, and a LIVE
+    /metrics scrape mid-drill returns parseable exposition text with
+    the TTFT/TPOT summary quantiles."""
+    import urllib.request
+
+    from flashmoe_tpu.profiler.export import (
+        request_trace_document, validate_trace,
+    )
+
+    # pool sized so all 8 requests are concurrently resident (2 pages
+    # each) and the THIRD page (length 16, ~8 decode steps in) starves
+    # the pool: 8-concurrent first, eviction/re-prefill after
+    serve = ServeConfig(max_batch=8, page_size=8, num_pages=20,
+                        max_pages_per_slot=4, ctx_bucket_pages=1,
+                        prompt_bucket=8)
+    reqs = _requests(prompts, 8, max_new=10)
+    arrivals = [0, 0, 0, 0, 1, 1, 2, 3]
+
+    m_on = Metrics()
+    on = ServingEngine(params, CFG, serve, metrics_obj=m_on,
+                       tracer=True, telemetry_port=0)
+    try:
+        for req, arr in zip(reqs, arrivals):
+            on.submit(req, arr)
+        # drive until the first retirement seeds the sketches, then
+        # scrape while work is still in flight
+        while on.pending() and "serve.ttft_ms" not in m_on.sketches:
+            on.step()
+        assert on.pending()
+        url = f"http://127.0.0.1:{on.telemetry.port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            body = r.read().decode()
+            assert r.headers.get("Content-Type") == \
+                "text/plain; version=0.0.4"
+        assert 'flashmoe_serve_ttft_ms{quantile="' in body
+        assert 'flashmoe_serve_tpot_ms{quantile="' in body
+        assert "flashmoe_serve_queue_depth" in body
+        while on.pending():
+            on.step()
+        out_on = dict(on.outputs)
+        s_on = on.summary()
+    finally:
+        on.close()
+
+    assert s_on["completed"] == 8 and s_on["max_active"] == 8
+    assert s_on["evictions"] > 0            # re-prefill cycle exercised
+
+    # plane off: bit-identical token streams
+    off = ServingEngine(params, CFG, serve, metrics_obj=Metrics())
+    out_off = off.run(_requests(prompts, 8, max_new=10), arrivals)
+    for i in range(8):
+        np.testing.assert_array_equal(np.asarray(out_on[i]),
+                                      np.asarray(out_off[i]))
+
+    # every request: contiguous track, eviction gaps visible
+    tr = on.tracer
+    assert tr.validate() == []
+    assert len(tr.requests) == 8
+    evicted = [rid for rid, st in tr.requests.items() if st.evictions]
+    assert evicted
+    for rid in evicted:
+        gaps = [s for s in tr.request_track(rid)
+                if s["name"] == "serve.queued" and s.get("resumed")]
+        assert len(gaps) == tr.requests[rid].evictions
+    doc = request_trace_document(tr)
+    assert validate_trace(doc) == []
+    assert len({e["pid"] for e in doc["traceEvents"]}) == 8
+    # every retirement closed its trace
+    traces = [d for d in m_on.decisions
+              if d["decision"] == "serve.trace"]
+    assert len(traces) == 8
+    assert {d["rid"] for d in traces} == set(range(8))
+
+
+def test_engine_summary_uses_sketches_not_decision_scan(params,
+                                                        prompts):
+    """summary() reads the O(1)-memory retire sketches — a foreign
+    decision stream (e.g. another engine on the same Metrics) cannot
+    change this engine's numbers, and p99 is reported."""
+    mx = Metrics()
+    engine = ServingEngine(
+        params, CFG,
+        ServeConfig(max_batch=4, page_size=8, num_pages=32,
+                    max_pages_per_slot=4, ctx_bucket_pages=1,
+                    prompt_bucket=8),
+        metrics_obj=mx)
+    engine.run(_requests(prompts, 3, max_new=3))
+    s = engine.summary()
+    assert s["ttft_ms_mean"] is not None
+    assert s["ttft_ms_p99"] >= s["ttft_ms_mean"] * 0.5
+    assert mx.sketches["serve.ttft_ms"].n == 3
+    assert mx.sketches["serve.step_ms"].n == s["steps"]
+    # windowed rates ride the gauges
+    assert "serve.tokens_per_s" in mx.gauges
