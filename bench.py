@@ -204,6 +204,28 @@ def _wire_fields(cfg: MoEConfig) -> dict:
     return out
 
 
+def _quant_fields(cfg: MoEConfig) -> dict:
+    """Quantized-expert-store identity + modeled weight bytes saved for
+    one bench record.  ``quant_modeled_weight_mb`` is one full stream
+    of this rank's expert weights at the store width (scale sidecars
+    included); ``quant_modeled_weight_saved_mb`` the drop vs the same
+    stream at full precision — the term the fused rowwin race and every
+    HBM-bound path move by."""
+    from flashmoe_tpu.analysis import expert_weight_stream_bytes
+    from flashmoe_tpu.quant import core as qcore
+
+    out = {"expert_quant": qcore.canonical_name(cfg.expert_quant)}
+    if cfg.expert_quant is None:
+        return out
+    nlx = cfg.num_experts // max(cfg.ep, 1)
+    on = expert_weight_stream_bytes(cfg, nlx)
+    off = expert_weight_stream_bytes(
+        cfg.replace(expert_quant=None), nlx)
+    out["quant_modeled_weight_mb"] = round(on / 2**20, 3)
+    out["quant_modeled_weight_saved_mb"] = round((off - on) / 2**20, 3)
+    return out
+
+
 def _emit(cfg, name, t_fused, t_xla, note: str | None = None):
     """One JSON record.  ``t_xla=None`` marks a partial measurement (the
     xla leg never completed): vs_baseline is ``null`` — not a number a
@@ -247,6 +269,13 @@ def _emit(cfg, name, t_fused, t_xla, note: str | None = None):
         rec.update(_wire_fields(cfg))
     except Exception as e:  # noqa: BLE001 — never lose the record
         rec["wire_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    try:
+        # quantized-store identity rides every record like the wire
+        # knobs: an int8-weights timing never overrides a
+        # full-precision selection (planner/select.py)
+        rec.update(_quant_fields(cfg))
+    except Exception as e:  # noqa: BLE001 — never lose the record
+        rec["quant_error_field"] = f"{type(e).__name__}: {str(e)[:120]}"
     try:
         rec.update(_planner_fields(cfg, t_fused, t_xla))
     except Exception as e:  # noqa: BLE001 — never lose the record
@@ -943,6 +972,108 @@ def _bench_tiles(cfg: MoEConfig, name: str, trials: int, chain: int):
         tuning._load.cache_clear()
 
 
+def _bench_quant(cfg: MoEConfig, name: str, trials: int, chain: int):
+    """Per-(store x path) records of the quantized expert store
+    (ISSUE 15): the MoE layer timed at full precision and at each
+    quant store (int8 / e4m3) on the single-chip explicit path, each
+    record carrying the modeled weight bytes saved
+    (``analysis.expert_weight_stream_bytes``) and measured-vs-predicted
+    drift through the planner drift monitor — a quant sweep doubles as
+    a calibration run for the store-width byte model the golden quant
+    dimension freezes."""
+    from flashmoe_tpu import quant as qtpkg
+    from flashmoe_tpu.models.reference import init_moe_params as _init
+    from flashmoe_tpu.ops.moe import moe_layer
+    from flashmoe_tpu.parallel.topology import (
+        _PEAK_TFLOPS, tpu_generation,
+    )
+    from flashmoe_tpu.planner.model import predict_paths
+
+    cfg = cfg.replace(ep=1, tp=1)
+    params = _init(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1),
+                          (cfg.tokens, cfg.hidden_size), cfg.dtype)
+    use_pallas = jax.default_backend() == "tpu"
+    gen = tpu_generation(jax.devices()[0])
+    if gen not in _PEAK_TFLOPS:
+        gen = os.environ.get("FLASHMOE_TPU_GEN", "")
+
+    def timed(p, c):
+        # params are TRACED arguments (the headline bench's
+        # convention), not closure constants: baked-in weights would
+        # let XLA hoist/constant-fold the dequantize out of the
+        # scanned chain, and the sweep would time a plain
+        # full-precision matmul (code-review finding)
+        def chained(n):
+            def run(pp, xx):
+                def body(cu, _):
+                    return moe_layer(pp, cu, c,
+                                     use_pallas=use_pallas
+                                     ).out.astype(cu.dtype), None
+                cu, _ = jax.lax.scan(body, xx, None, length=n)
+                return cu.astype(jnp.float32).sum()
+            return jax.jit(run)
+
+        t1 = _time_chain(chained(1), p, x, trials)
+        tn = _time_chain(chained(chain), p, x, trials)
+        return max(tn - t1, 1e-9) / (chain - 1)
+
+    t_base = timed(params, cfg)
+    base_rec = {
+        "metric": f"quant_ms[{name}:off,explicit,"
+                  f"{jnp.dtype(cfg.dtype).name}]",
+        "value": round(t_base * 1e3, 3), "unit": "ms",
+        "vs_baseline": 1.0, "path": "explicit", "d": 1,
+        "expert_quant": "off", "backend": jax.default_backend(),
+    }
+    print(json.dumps(base_rec), flush=True)
+    _flush_observability(base_rec)
+
+    for qname in ("int8", "e4m3"):
+        try:
+            cq = cfg.replace(expert_quant=qname)
+        except ValueError as e:  # e.g. e4m3 on a float8-less jax
+            rec = {"metric": f"quant_ms[{name}:{qname},explicit,"
+                             f"{jnp.dtype(cfg.dtype).name}]",
+                   "value": None, "unit": "ms", "skipped": True,
+                   "reason": f"{type(e).__name__}: {str(e)[:160]}"}
+            print(json.dumps(rec), flush=True)
+            _flush_observability(rec)
+            continue
+        qparams = qtpkg.quantize_state(params, qname).params
+        t_q = timed(qparams, cq)
+        rec = {
+            "metric": f"quant_ms[{name}:{qname},explicit,"
+                      f"{jnp.dtype(cfg.dtype).name}]",
+            "value": round(t_q * 1e3, 3), "unit": "ms",
+            "vs_baseline": round(t_base / t_q, 3),
+            "path": "explicit", "d": 1,
+            "backend": jax.default_backend(),
+        }
+        rec.update(_quant_fields(cq))
+        if gen in _PEAK_TFLOPS:
+            try:
+                preds = {p.path: p for p in predict_paths(cq, 1, gen)}
+                p = preds.get("explicit")
+                if p is not None:
+                    rec["planner_gen"] = gen
+                    rec["predicted_ms"] = round(p.total_ms, 3)
+                    rec["prediction_error"] = round(
+                        t_q * 1e3 / p.total_ms - 1.0, 3)
+                    from flashmoe_tpu.planner.drift import record_drift
+
+                    dr = record_drift(cq, "explicit", t_q * 1e3, d=1,
+                                      gen=gen,
+                                      predicted_ms=rec["predicted_ms"],
+                                      warn=False)
+                    rec["drift_exceeded"] = dr.exceeded
+            except Exception as e:  # noqa: BLE001 — keep the record
+                rec["planner_error"] = (f"{type(e).__name__}: "
+                                        f"{str(e)[:120]}")
+        print(json.dumps(rec), flush=True)
+        _flush_observability(rec)
+
+
 def _probe_backend(timeout_s: int):
     """Run one trivial op on the default backend in a subprocess with a hard
     timeout.  The tunneled TPU backend can wedge so that even ``jax.devices()``
@@ -1030,6 +1161,14 @@ def main():
                          "tile choice through the planner drift "
                          "monitor (the measured counterpart of the "
                          "IO-aware chooser; see docs/PERF.md)")
+    ap.add_argument("--quant", action="store_true",
+                    help="sweep the quantized expert store "
+                         "(MoEConfig.expert_quant int8/e4m3) at "
+                         "--config instead of the latency bench — one "
+                         "JSON record per (store, path) with modeled "
+                         "weight bytes saved and measured-vs-predicted "
+                         "drift (see docs/PERF.md 'Quantized expert "
+                         "storage')")
     ap.add_argument("--ckpt", action="store_true",
                     help="measure step-loop checkpoint blocking time, "
                          "sync vs async save, instead of the latency "
@@ -1122,10 +1261,10 @@ def main():
                  "live scrape plane rides the serving sweep; the "
                  "train CLIs take their own --telemetry-port)")
     if args.regression and (args.ckpt or args.overlap or args.sweep
-                            or args.tiles):
+                            or args.tiles or args.quant):
         ap.error("--regression appends measured runs from the "
                  "headline bench, --serve, --profile, or --scaling; "
-                 "drop --ckpt/--overlap/--sweep/--tiles")
+                 "drop --ckpt/--overlap/--sweep/--tiles/--quant")
     _REG[0] = (os.path.join(args.obs_dir or "obs", "history.jsonl")
                if args.regression else None)
     _REG[1].clear()
@@ -1134,6 +1273,7 @@ def main():
     # or scaling-sweep skip/error is machine-distinguishable from a
     # latency-bench one
     headline_metric = (f"fused_tiles_ms[{args.config}]" if args.tiles
+                       else f"quant_ms[{args.config}]" if args.quant
                        else "scaling_ms[slices]" if args.scaling
                        else f"moe_layer_fwd_ms[{args.config}]")
 
@@ -1186,6 +1326,22 @@ def main():
         # other mode would silently ignore it
         ap.error("--wire-dcn applies to --scaling only (the other "
                  "modes run no cross-slice hop)")
+    if args.quant:
+        # the --profile/--ckpt fail-fast contract: the quant sweep pins
+        # its own (store x path) matrix at ep=1 — refuse knobs/modes it
+        # would silently ignore.  --ckpt and --overlap are the
+        # shape-changing combinations the ISSUE names; the rest follow
+        # the same rule.
+        if args.wire_dtype or args.wire_combine or args.a2a_chunks:
+            ap.error("--quant sweeps the expert weight store; "
+                     "--wire-dtype/--wire-combine/--a2a-chunks do not "
+                     "apply")
+        if args.overlap or args.ckpt or args.sweep or args.serve \
+                or args.profile or args.profile_quick or args.tiles \
+                or args.scaling:
+            ap.error("--quant is its own mode; drop "
+                     "--overlap/--ckpt/--sweep/--serve/--profile/"
+                     "--tiles/--scaling")
     if args.scaling:
         if args.overlap or args.ckpt or args.sweep or args.serve \
                 or args.profile or args.profile_quick or args.tiles:
@@ -1343,6 +1499,13 @@ def main():
     if args.tiles:
         try:
             _bench_tiles(cfg, args.config, args.trials, args.chain)
+        except Exception as e:  # noqa: BLE001 — always leave a record
+            emit_error(f"{type(e).__name__}: {str(e)[:300]}")
+        return
+
+    if args.quant:
+        try:
+            _bench_quant(cfg, args.config, args.trials, args.chain)
         except Exception as e:  # noqa: BLE001 — always leave a record
             emit_error(f"{type(e).__name__}: {str(e)[:300]}")
         return

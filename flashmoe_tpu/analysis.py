@@ -85,6 +85,38 @@ def wire_row_bytes(cfg: MoEConfig, leg: str = "dispatch",
             + wr.scale_bytes(wd))
 
 
+def expert_weight_stream_bytes(cfg: MoEConfig, nlx: int, *,
+                               quantized: bool = True) -> float:
+    """HBM bytes ONE stream of ``nlx`` local experts' FFN weights
+    costs.  With ``MoEConfig.expert_quant`` set (and ``quantized`` —
+    the engine being priced actually streams the narrow store), each
+    element moves at the store width (1 B for int8/e4m3,
+    :func:`flashmoe_tpu.quant.core.weight_itemsize`) plus the f32
+    per-output-channel scale sidecar; otherwise at the compute width.
+    Every weight term in :func:`path_costs` prices through this one
+    function, so the byte model can never disagree with the store
+    about what actually streams.
+
+    ``quantized=False`` is the honesty valve for engines that
+    boundary-dequantize (the fused weights-once schedules — see
+    ``parallel/fused.py:_fused_shard``): they stream compute-width
+    weights even under a quantized store."""
+    h, i = cfg.hidden_size, cfg.intermediate_size
+    dt = jnp.dtype(cfg.dtype).itemsize
+    w_mult = 3 if cfg.gated_ffn else 2
+    if cfg.expert_quant is None or not quantized:
+        return float(nlx * w_mult * h * i * dt)
+    from flashmoe_tpu.quant import core as qcore
+
+    wdt = qcore.weight_itemsize(cfg.expert_quant, cfg.dtype)
+    # per-output-channel f32 scales: I channels each for up (+gate),
+    # H for down — the tiny sidecar the stream also reads
+    chans = (2 if cfg.gated_ffn else 1) * i + h
+    return float(nlx * (w_mult * h * i * wdt
+                        + qcore.scale_overhead_bytes(cfg.expert_quant,
+                                                     chans)))
+
+
 def layer_flops(cfg: MoEConfig, tokens: int | None = None) -> float:
     """Model FLOPs of one MoE-layer forward: gate GEMM + routed expert
     FFN (2 GEMMs, or 3 with the gated/SwiGLU branch), matching the
@@ -189,9 +221,18 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1,
     # ratio (plus the fp8 scale sidecar).
     a2a_row = (wire_row_bytes(cfg, "dispatch")
                + wire_row_bytes(cfg, "combine")) if d_world > 1 else 0.0
-    w_mult = 3 if g["gated"] else 2    # matrices per expert (gate/up/down)
-    # weight bytes of the experts THIS chip computes, once per stream
-    w_once = nlx * w_mult * h * i * dt
+    # weight bytes of the experts THIS chip computes, once per stream —
+    # at the QUANTIZED store width when expert_quant is on.  Modeling
+    # assumption (docs/PERF.md): dequant-in-compute reads the payload
+    # at 1 B/elem with the convert fused into the matmul's operand
+    # stream — exact for the rowwin streamer (in-VMEM dequant) and the
+    # XLA einsum arm; the grouped Pallas kernels currently materialize
+    # the dequantized copy layer-side, so their realized saving is
+    # smaller than modeled until they grow an int8 arm — exactly the
+    # class of drift `bench.py --quant` monitors.  The fused
+    # weights-once schedules boundary-dequantize and are priced at
+    # compute width below.
+    w_once = expert_weight_stream_bytes(cfg, nlx)
     # Weight-streaming multiplicity differs per engine:
     #   * the grouped kernels (ops/expert.py) sort rows by expert, so a
     #     weight block is fetched once per consecutive expert run —
@@ -306,8 +347,14 @@ def path_costs(cfg: MoEConfig, path: str, d_world: int = 1,
             # inside the kernel, off the post-kernel critical path
             combine = rows * h * dt + (rows * 4) + s * h * 4
             post = 0.0
-        return PathCost(path, w_once * fused_streams, act_bytes, dispatch,
-                        comm, combine, post, flops)
+        # only the rowwin streamer fetches the quantized store
+        # in-kernel; the weights-once schedules boundary-dequantize
+        # (parallel/fused.py:_fused_shard) and stream compute-width
+        # weights, so their column must not claim the int8 discount
+        w_stream = expert_weight_stream_bytes(
+            cfg, nlx, quantized=(g["schedule"] == "rowwin"))
+        return PathCost(path, w_stream * fused_streams, act_bytes,
+                        dispatch, comm, combine, post, flops)
     raise ValueError(f"unknown path {path!r}")
 
 

@@ -257,6 +257,25 @@ class MoEConfig:
     # staticcheck/registry.py SELECTOR_FIELDS).
     fused_schedule: str | None = None
 
+    # Quantized expert weight storage & compute (flashmoe_tpu/quant/):
+    # "int8" or "e4m3" stores the MoE FFN expert weights (w_up /
+    # w_gate / w_down) at 1 byte per element with per-output-channel
+    # f32 scales, dequantized IN COMPUTE — every matmul still
+    # accumulates f32, biases/router stay full-precision.  With
+    # pre-quantized params (quant.quantize_state) the weights stream
+    # from HBM and live in memory at the narrow width (the planner
+    # prices exactly this: analysis.path_costs weight terms, the fused
+    # rowwin K-window geometry at 1 B/elem); with ordinary params the
+    # layers fake-quant in-graph (round-trip) — same numerics, no
+    # storage savings.  Default None: OFF, no quant code runs and the
+    # graph is bit-identical to a pre-quant build (the collect_stats /
+    # wire_dtype convention; registered in staticcheck/registry.py,
+    # proven by the invariant engine).  Inference-only: post-training
+    # quantization has no gradient story (jnp.round kills them), so
+    # is_training=True rejects the knob — train at full precision and
+    # quantize the checkpoint.
+    expert_quant: str | None = None
+
     # Inference-only: fuse the dispatch gather into the FFN kernel
     # (ops/expert.py:grouped_ffn_tokens — no [E, C, H] HBM buffer).
     # None = auto: follow the FLASHMOE_GATHER_FUSED env var, else stay on
@@ -322,6 +341,26 @@ class MoEConfig:
                     f"{jnp.dtype(self.dtype).name} "
                     f"({jnp.dtype(self.dtype).itemsize} B); a wire must "
                     f"compress, not inflate")
+        # quantized expert storage: reject unsupported combinations at
+        # config time (unknown name, e4m3 without float8 support,
+        # training jobs, tensor-parallel experts) instead of failing
+        # inside a layer trace
+        if self.expert_quant is not None:
+            from flashmoe_tpu.quant import core as _qcore
+
+            _qcore.resolve(self.expert_quant)  # ValueError on unknown
+            if self.is_training:
+                raise ValueError(
+                    "expert_quant is post-training (inference-only): "
+                    "jnp.round has no useful gradient, so a quantized "
+                    "training step would silently learn nothing — "
+                    "train at full precision and quantize_state() the "
+                    "checkpoint")
+            if self.tp > 1:
+                raise ValueError(
+                    "expert_quant does not compose with tp>1 (the "
+                    "Megatron intermediate split would shard w_up's "
+                    "per-output-channel scales); use tp=1")
         # chunked a2a pipeline: reject impossible chunk counts at config
         # time (clear ValueError) instead of a shape error inside the
         # pipeline loop; the shard body re-checks against the actual

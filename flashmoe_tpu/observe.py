@@ -192,6 +192,26 @@ def wire_report(flight: list[dict]) -> dict:
     }
 
 
+def quant_report(flight: list[dict]) -> dict:
+    """Quantized-expert-store health (flashmoe_tpu/quant/): the
+    weight-space round-trip error proxy the layers attach to MoEStats
+    when ``MoEConfig.expert_quant`` is on.  Non-zero on fake-quant runs
+    (the real quantization loss); pre-quantized states report ~0 here —
+    their baked loss lives in the checkpoint's quant metadata block."""
+    errs = []
+    for rec in flight:
+        for m in _layer_stats(rec):
+            e = m.get("quant_error")
+            if isinstance(e, (int, float)) and e > 0:
+                errs.append(float(e))
+    return {
+        "steps_with_quant": len(errs),
+        "mean_quant_error": round(sum(errs) / len(errs), 6) if errs
+        else None,
+        "max_quant_error": round(max(errs), 6) if errs else None,
+    }
+
+
 def resilience_report(records: list[dict]) -> dict:
     """Fault-tolerance narrative from the decision stream
     (docs/RESILIENCE.md): how often each recovery rung fired, every
@@ -322,6 +342,7 @@ def summarize(records: list[dict]) -> dict:
         "drops": drop_report(flight),
         "degradation": degradation_report(flight),
         "wire": wire_report(flight),
+        "quant": quant_report(flight),
         "resilience": resilience_report(records),
         "adaptation": adaptation_report(records),
         "phases": phase_report(records),
@@ -445,6 +466,7 @@ def serving_report(records: list[dict]) -> dict:
     rids: set = set()
     seen_req_recs = False
     plan = None
+    quant = None
     admissions = evictions = slo_ttft = slo_tpot = 0
     for r in records:
         kind, dec = r.get("kind"), r.get("decision")
@@ -473,6 +495,8 @@ def serving_report(records: list[dict]) -> dict:
                 tp.observe(r["tpot_ms"])
         if dec == "serve.plan":
             plan = r
+        elif dec == "serve.quant":
+            quant = r
         elif dec == "serve.admit":
             admissions += 1
         elif dec == "serve.evict":
@@ -515,6 +539,14 @@ def serving_report(records: list[dict]) -> dict:
                  if plan else None),
         "slo_breaches": {"ttft": slo_ttft, "tpot": slo_tpot}
         if slo else None,
+        # quantized expert storage: the HBM the narrow store freed,
+        # expressed as the extra KV-cache pages that headroom buys on
+        # this engine's page size (serve.quant decision)
+        "quant": ({"expert_quant": quant.get("expert_quant"),
+                   "freed_mb": quant.get("freed_mb"),
+                   "extra_kv_pages": quant.get("extra_kv_pages"),
+                   "num_pages": quant.get("num_pages")}
+                  if quant else None),
     }
 
 
@@ -554,6 +586,12 @@ def render_serving_text(rep: dict) -> str:
             f"(c{plan['decode'][1]})"
             + ("  [heterogeneous]" if plan.get("heterogeneous")
                else "  [same plan]"))
+    if rep.get("quant"):
+        q = rep["quant"]
+        lines.append(
+            f"  quantized experts: {q['expert_quant']} freed "
+            f"{q['freed_mb']} MB of weight HBM = +{q['extra_kv_pages']} "
+            f"KV pages of headroom (pool {q['num_pages']})")
     if rep.get("slo_breaches"):
         b = rep["slo_breaches"]
         lines.append(f"  SLO breaches: ttft={b['ttft']} "
@@ -779,6 +817,14 @@ def render_text(s: dict) -> str:
                      f"{wire['steps_with_wire']} layer-steps, round-trip "
                      f"quantization error mean {wire['mean_rtq_error']} "
                      f"max {wire['max_rtq_error']}")
+    quant = s.get("quant", {})
+    if quant.get("steps_with_quant"):
+        lines.append("")
+        lines.append(f"quantized experts: active on "
+                     f"{quant['steps_with_quant']} layer-steps, "
+                     f"weight round-trip error mean "
+                     f"{quant['mean_quant_error']} max "
+                     f"{quant['max_quant_error']}")
     res = s.get("resilience", {})
     if res.get("events"):
         lines.append("")

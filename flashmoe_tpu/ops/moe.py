@@ -74,6 +74,19 @@ def dense_ffn(params, x, cfg: MoEConfig):
 
 def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
                     capacity: int | None, interpret: bool) -> MoEOutput:
+    # quantized expert storage (flashmoe_tpu/quant/): resolve the FFN
+    # weights to their dequant-in-compute form — payloads dequantize,
+    # full-precision params fake-quant in-graph.  Called
+    # UNCONDITIONALLY: with the knob off it returns the dict untouched
+    # (bit-identical graph, invariant-engine-proven) but REFUSES a
+    # quantized state whose scales would otherwise be silently ignored
+    # (code-review finding).
+    from flashmoe_tpu import quant as qt
+
+    qerr = (qt.weight_quant_error(params, cfg)
+            if cfg.expert_quant is not None and cfg.collect_stats
+            else None)
+    params = qt.ffn_compute_params(params, cfg)
     r = router(x, params["gate_w"], cfg, use_pallas=use_pallas,
                interpret=interpret)
     s, h = x.shape
@@ -155,6 +168,10 @@ def _moe_layer_impl(params, x, cfg: MoEConfig, use_pallas: bool,
         out = dsp.combine(ybuf, plan, combine_w, cfg, cap_p)
     if degrade and stats is not None:
         stats = hlt.attach_degradation(stats, healthy, r.expert_idx)
+    if qerr is not None and stats is not None:
+        from flashmoe_tpu.ops.stats import with_quant_error
+
+        stats = with_quant_error(stats, qerr)
     if cfg.num_shared_experts:
         out = out + shared_expert_ffn(x.astype(cfg.dtype), params, cfg).astype(
             out.dtype

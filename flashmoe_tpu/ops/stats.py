@@ -64,6 +64,14 @@ class MoEStats(NamedTuple):
                           separately from the in-slice hop.  0.0 when
                           the DCN override is off or the exchange is
                           flat.
+    quant_error:          [] round-trip error proxy of the quantized
+                          expert weight store (flashmoe_tpu/quant/,
+                          ``MoEConfig.expert_quant``): max over this
+                          layer's FFN weight matrices of the store's
+                          relative L1 round-trip error.  Real loss on
+                          fake-quant runs; ~0 on pre-quantized states
+                          (the baked loss lives in the state's quant
+                          metadata).  0.0 when expert_quant is off.
     """
 
     expert_load: jnp.ndarray
@@ -76,6 +84,7 @@ class MoEStats(NamedTuple):
     masked_fraction: jnp.ndarray
     wire_rtq_error: jnp.ndarray
     wire_rtq_error_dcn: jnp.ndarray
+    quant_error: jnp.ndarray
 
 
 def load_imbalance(expert_load) -> jnp.ndarray:
@@ -154,6 +163,9 @@ def moe_stats(router_out, cfg: MoEConfig, capacity: int | None
         # _dcn twin covers the cross-slice hop's own wire)
         wire_rtq_error=zero,
         wire_rtq_error_dcn=zero,
+        # quantized-weight store error: filled in by the layers via
+        # with_quant_error() when expert_quant is on
+        quant_error=zero,
     )
 
 
@@ -191,6 +203,22 @@ def with_wire_error(stats: MoEStats, wire_rtq_error=None,
     return stats._replace(**fields) if fields else stats
 
 
+def with_quant_error(stats: MoEStats, quant_error,
+                     reduce_axes=None) -> MoEStats:
+    """Attach the quantized-weight round-trip error proxy
+    (:func:`flashmoe_tpu.quant.state.weight_quant_error`) to a stats
+    tuple.  Inside a shard_map body pass ``reduce_axes`` to pmean the
+    per-shard proxy (each rank measures its own expert shard)."""
+    import jax
+
+    if quant_error is None:
+        return stats
+    v = jnp.asarray(quant_error, jnp.float32)
+    if reduce_axes is not None:
+        v = jax.lax.pmean(v, reduce_axes)
+    return stats._replace(quant_error=v)
+
+
 def reduce_stats(local: MoEStats, probs_mean, reduce_axes) -> MoEStats:
     """Cross-rank reduction of per-shard stats inside a shard_map body.
 
@@ -221,6 +249,7 @@ def reduce_stats(local: MoEStats, probs_mean, reduce_axes) -> MoEStats:
         masked_fraction=local.masked_fraction,
         wire_rtq_error=local.wire_rtq_error,
         wire_rtq_error_dcn=local.wire_rtq_error_dcn,
+        quant_error=local.quant_error,
     )
 
 
@@ -245,4 +274,5 @@ def stats_to_host(stats: MoEStats) -> dict:
         "masked_fraction": float(host.masked_fraction),
         "wire_rtq_error": float(host.wire_rtq_error),
         "wire_rtq_error_dcn": float(host.wire_rtq_error_dcn),
+        "quant_error": float(host.quant_error),
     }

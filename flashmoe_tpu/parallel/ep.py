@@ -217,6 +217,20 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
     s_loc, h = x.shape
     e, nlx = cfg.num_experts, cfg.num_experts // d
     cap = local_capacity(cfg, s_loc)
+    # quantized expert storage (flashmoe_tpu/quant/): resolve this
+    # rank's FFN weight shard to its dequant-in-compute form before
+    # any slicing/exchange logic sees it — payloads (and their _qscale
+    # siblings, sharded P('ep') like everything else) dequantize here;
+    # full-precision params fake-quant in-graph.  Called
+    # UNCONDITIONALLY: off returns the dict untouched (bit-identical
+    # graph) but a quantized state under a quant-off config is refused
+    # instead of matmuling raw payloads (code-review finding).
+    from flashmoe_tpu import quant as qt
+
+    quant_err = (qt.weight_quant_error(params, cfg)
+                 if cfg.expert_quant is not None and cfg.collect_stats
+                 else None)
+    params = qt.ffn_compute_params(params, cfg)
     wire_disp = wr.resolve(cfg.wire_dtype)
     wire_comb = wr.resolve(cfg.wire_dtype_combine)
     # the DCN-hop override only exists on a two-stage exchange; resolve
@@ -432,6 +446,8 @@ def _ep_moe_shard(params, x, cfg: MoEConfig, *, axis: str, use_pallas: bool,
         if wire_err is not None or dcn_err is not None:
             stats = st.with_wire_error(stats, wire_err, reduce_axes,
                                        dcn_error=dcn_err)
+        if quant_err is not None:
+            stats = st.with_quant_error(stats, quant_err, reduce_axes)
     return MoEOutput(out.astype(cfg.dtype), aux, z, counts, stats)
 
 

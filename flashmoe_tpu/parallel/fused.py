@@ -119,6 +119,11 @@ def _fused_kernel(
                                           #   return rows (None = XLA combine)
     w_sorted,                             # ANY [rows_pad, 1] f32 weights
     x_send, w_up, b_up, w_down, b_down,   # inputs (ANY/VMEM)
+    wup_sc, wdn_sc,                       # VMEM f32 per-output-channel
+                                          #   scales of a quantized
+                                          #   weight store ([nLx, I or
+                                          #   2I] / [nLx, H]; None at
+                                          #   full precision)
     x_recv, y_back, y_stage, out,         # outputs (y_back: the [D,nLx,C,H]
                                           #   slab y_recv, or the token-sorted
                                           #   [rows_pad, H] return buffer when
@@ -141,7 +146,7 @@ def _fused_kernel(
                                           #   streaming)
     copy_sems, send_x_sems, recv_x_sems, send_y_sems, recv_y_sems,
     *, axis, act_name, cm, bi, gated, fuse_combine, k, cu,
-    schedule, bh,
+    schedule, bh, quant=False,
 ):
     """One grid step = one source slab (ring order).
 
@@ -630,6 +635,26 @@ def _fused_kernel(
                 wu_dma(j, slot).wait()
                 wd_dma(j, slot).wait()
 
+                # quantized store (MoEConfig.expert_quant): the window
+                # buffers hold int8/e4m3 payloads straight off HBM —
+                # dequantize IN VMEM against the resident per-output-
+                # channel f32 scales (w_up's channels are this window's
+                # K columns; w_down's are the full H row), then compute
+                # at the activation dtype exactly like the raw path.
+                if quant:
+                    up_cols = 2 * bi if gated else bi
+                    wu_win = (
+                        wup_vmem[slot].astype(jnp.float32)
+                        * wup_sc[e, pl.ds(j * up_cols, up_cols)][None, :]
+                    ).astype(xs_vmem.dtype)
+                    wd_win = (
+                        wdn_vmem[slot].astype(jnp.float32)
+                        * wdn_sc[e, :][None, :]
+                    ).astype(xs_vmem.dtype)
+                else:
+                    wu_win = wup_vmem[slot]
+                    wd_win = wdn_vmem[slot]
+
                 def src_body(q, c1):
                     sq = src_of(q)
                     ntq = tiles_of(recv_cnt[sq, e])
@@ -662,11 +687,11 @@ def _fused_kernel(
                             xd.wait()
                             if gated:
                                 g = jnp.dot(
-                                    xs_vmem[:], wup_vmem[slot, :, :bi],
+                                    xs_vmem[:], wu_win[:, :bi],
                                     preferred_element_type=jnp.float32,
                                 )
                                 up = jnp.dot(
-                                    xs_vmem[:], wup_vmem[slot, :, bi:],
+                                    xs_vmem[:], wu_win[:, bi:],
                                     preferred_element_type=jnp.float32,
                                 ) + bup_vmem[0, pl.ds(j * bi, bi)].astype(
                                     jnp.float32)
@@ -674,13 +699,13 @@ def _fused_kernel(
                                     xs_vmem.dtype)
                             else:
                                 up = jnp.dot(
-                                    xs_vmem[:], wup_vmem[slot],
+                                    xs_vmem[:], wu_win,
                                     preferred_element_type=jnp.float32,
                                 ) + bup_vmem[0, pl.ds(j * bi, bi)].astype(
                                     jnp.float32)
                                 hidden = act(up).astype(xs_vmem.dtype)
                             acc[:] += jnp.dot(
-                                hidden, wdn_vmem[slot],
+                                hidden, wd_win,
                                 preferred_element_type=jnp.float32,
                             )
 
@@ -995,7 +1020,8 @@ _KW_CANDIDATES = (2048, 1024, 512, 256, 128, 64, 32, 16, 8)
 
 def _rowwin_budget_ok(cap: int, h: int, i_dim: int, dt_size: int,
                       gated: bool, cm: int, kw: int, fuse_combine: bool,
-                      k: int) -> bool:
+                      k: int, *, w_dt: int | None = None,
+                      sc_bytes: float = 0.0) -> bool:
     """VMEM feasibility of the row-windowed schedule at (cm row tile,
     kw K-window): the double-buffered window pair (w_up [h, kw] — or
     [h, 2*kw] gated — plus w_down [kw, h]) + one x row tile + the f32
@@ -1003,38 +1029,54 @@ def _rowwin_budget_ok(cap: int, h: int, i_dim: int, dt_size: int,
     cross-window state lives in HBM (``acc_hbm``), so — unlike the
     weights-once schedules — NOTHING here scales with the capacity or
     the source count: this is the schedule that stays feasible when the
-    expert is simply bigger than VMEM (mixtral's i=14336)."""
-    wu2 = 2 * h * (2 * kw if gated else kw) * dt_size
-    wd2 = 2 * kw * h * dt_size
+    expert is simply bigger than VMEM (mixtral's i=14336).
+
+    ``w_dt``: bytes per WEIGHT element in the window buffers (default =
+    ``dt_size``).  Quantized expert storage (``MoEConfig.expert_quant``,
+    flashmoe_tpu/quant/) streams int8/e4m3 slabs and dequantizes in
+    VMEM, so its windows budget at 1 B/elem — which is exactly why the
+    chooser re-solves to wider K-windows under quant; ``sc_bytes``
+    charges the resident f32 scale arrays that ride along."""
+    wdt = dt_size if w_dt is None else w_dt
+    wu2 = 2 * h * (2 * kw if gated else kw) * wdt
+    wd2 = 2 * kw * h * wdt
     tiles = cm * h * dt_size + cm * h * 4 + cm * h * dt_size  # xs+acc+yv
     bias = i_dim * 4 + h * 4
     chunk = (_combine_chunk_rows(k) * k * (h * dt_size + 4)
              + _combine_chunk_rows(k) * h * 4) if fuse_combine else 0
-    return wu2 + wd2 + tiles + bias + chunk <= 15 * 2**20
+    return wu2 + wd2 + tiles + bias + chunk + sc_bytes <= 15 * 2**20
 
 
 def rowwin_tile_candidates(cap: int, h: int, i_dim: int, dt_size: int,
                            gated: bool, fuse_combine: bool,
-                           k: int) -> list[tuple[int, int]]:
+                           k: int, *,
+                           w_dt: int | None = None,
+                           sc_bytes: float = 0.0
+                           ) -> list[tuple[int, int]]:
     """Every VMEM-feasible (cm row tile, kw K-window) pair of the
     rowwin schedule at this shape — THE candidate grid shared by the
     IO-aware chooser (:func:`_rowwin_tiles`), ``bench.py --tiles`` and
     ``tune_sweep.py --stage tiles`` (via
     :func:`rowwin_sweep_candidates`), and the contract tests, so the
     measured sweeps can never silently drift from the pairs the
-    chooser can actually pick."""
+    chooser can actually pick.  ``w_dt``/``sc_bytes``: quantized-store
+    weight width + scale residency (:func:`_rowwin_budget_ok`)."""
     return [
         (cm, kw)
         for cm in (256, 128, 64, 32, 16, 8) if cap % cm == 0
         for kw in _KW_CANDIDATES if i_dim % kw == 0
         and _rowwin_budget_ok(cap, h, i_dim, dt_size, gated, cm, kw,
-                              fuse_combine, k)
+                              fuse_combine, k, w_dt=w_dt,
+                              sc_bytes=sc_bytes)
     ]
 
 
 def rowwin_sweep_candidates(cap: int, h: int, i_dim: int, dt_size: int,
                             gated: bool, fuse_combine: bool,
-                            k: int) -> list[tuple[int, int]]:
+                            k: int, *,
+                            w_dt: int | None = None,
+                            sc_bytes: float = 0.0
+                            ) -> list[tuple[int, int]]:
     """The measurement subset of :func:`rowwin_tile_candidates` the
     tiles sweeps time: ONE candidate per feasible K-window, at its
     widest feasible row tile.  cm moves no modeled HBM bytes (the
@@ -1044,7 +1086,8 @@ def rowwin_sweep_candidates(cap: int, h: int, i_dim: int, dt_size: int,
     points instead of the full grid."""
     best_cm: dict[int, int] = {}
     for cm, kw in rowwin_tile_candidates(cap, h, i_dim, dt_size, gated,
-                                         fuse_combine, k):
+                                         fuse_combine, k, w_dt=w_dt,
+                                         sc_bytes=sc_bytes):
         best_cm[kw] = max(best_cm.get(kw, 0), cm)
     return sorted(((cm, kw) for kw, cm in best_cm.items()),
                   key=lambda t: -t[1])
@@ -1052,8 +1095,10 @@ def rowwin_sweep_candidates(cap: int, h: int, i_dim: int, dt_size: int,
 
 def _rowwin_tiles(cap: int, h: int, i_dim: int, dt_size: int,
                   dtype_name: str | None, gated: bool,
-                  fuse_combine: bool, k: int) -> tuple[int | None,
-                                                       int | None]:
+                  fuse_combine: bool, k: int, *,
+                  w_dt: int | None = None,
+                  sc_bytes: float = 0.0) -> tuple[int | None,
+                                                  int | None]:
     """IO-aware (row tile, K-window) chooser for the rowwin schedule:
     among VMEM-feasible (cm, kw) pairs, minimize the schedule's modeled
     HBM traffic (the SonicMoE stance, arXiv 2512.14080: optimize bytes,
@@ -1075,7 +1120,8 @@ def _rowwin_tiles(cap: int, h: int, i_dim: int, dt_size: int,
     pair fits the budget."""
     best = None  # (modeled activation bytes/row, -cm, cm, kw)
     for cm, kw in rowwin_tile_candidates(cap, h, i_dim, dt_size, gated,
-                                         fuse_combine, k):
+                                         fuse_combine, k, w_dt=w_dt,
+                                         sc_bytes=sc_bytes):
         n_win = i_dim // kw
         bytes_per_row = n_win * h * dt_size + (n_win - 1) * h * 8
         cand = (bytes_per_row, -cm, cm, kw)
@@ -1092,7 +1138,8 @@ def _rowwin_tiles(cap: int, h: int, i_dim: int, dt_size: int,
         tcm, tkw = tuned.get("cm"), tuned.get("kw")
         if (tcm and tkw and cap % tcm == 0 and i_dim % tkw == 0
                 and _rowwin_budget_ok(cap, h, i_dim, dt_size, gated,
-                                      tcm, tkw, fuse_combine, k)):
+                                      tcm, tkw, fuse_combine, k,
+                                      w_dt=w_dt, sc_bytes=sc_bytes)):
             cm, kw = tcm, tkw
     return cm, kw
 
@@ -1100,7 +1147,9 @@ def _rowwin_tiles(cap: int, h: int, i_dim: int, dt_size: int,
 def _rowwin_choice(cap: int, h: int, i_dim: int, dt_size: int,
                    dtype_name: str | None, gated: bool, cm_stream: int,
                    fuse_combine: bool, k: int, d_world: int,
-                   tuned: dict) -> tuple[bool, int | None]:
+                   tuned: dict, *,
+                   w_dt: int | None = None,
+                   sc_bytes: float = 0.0) -> tuple[bool, int | None]:
     """Static stream-vs-rowwin decision (both are the fallbacks when no
     weights-once schedule fits VMEM).  Byte crossover, per local
     expert: weight streams saved by row-windowing — stream pays
@@ -1119,7 +1168,8 @@ def _rowwin_choice(cap: int, h: int, i_dim: int, dt_size: int,
     or ``MoEConfig.fused_schedule='rowwin'`` still forces past them).
     Returns ``(enabled, kw)``."""
     cm, kw = _rowwin_tiles(cap, h, i_dim, dt_size, dtype_name, gated,
-                           fuse_combine, k)
+                           fuse_combine, k, w_dt=w_dt,
+                           sc_bytes=sc_bytes)
     if cm is None:
         return False, None
     if os.environ.get("FLASHMOE_FUSED_ROWWIN") == "0":
@@ -1136,7 +1186,11 @@ def _rowwin_choice(cap: int, h: int, i_dim: int, dt_size: int,
         passes = 2 if d_world > 1 else 1
         streams_saved = d_world * n_row_tiles - passes
         wu_mult = 3 if gated else 2
-        saved = streams_saved * wu_mult * h * i_dim * dt_size
+        # weight streams saved are priced at the STORED width: under a
+        # quantized store (w_dt=1) the byte trade rowwin wins shrinks,
+        # while the activation re-streaming it pays does not
+        saved = (streams_saved * wu_mult * h * i_dim
+                 * (dt_size if w_dt is None else w_dt))
         n_win = i_dim // kw
         rows = d_world * cap
         extra = rows * h * ((n_win - 1) * dt_size + (n_win - 1) * 8)
@@ -1149,7 +1203,9 @@ def _fused_schedule(cap: int, h: int, i_dim: int, dt_size: int,
                     gated: bool, cm: int, bi: int, fuse_combine: bool,
                     k: int, d_world: int,
                     tuned: dict, *, dtype_name: str | None = None,
-                    forced: str | None = None) -> tuple[str, int | None]:
+                    forced: str | None = None,
+                    w_dt: int | None = None,
+                    sc_bytes: float = 0.0) -> tuple[str, int | None]:
     """Static FFN-schedule choice for the fused kernel:
 
       batched    own slab at step 0, ALL remote slabs expert-major at the
@@ -1208,7 +1264,8 @@ def _fused_schedule(cap: int, h: int, i_dim: int, dt_size: int,
             return forced, bh
         if forced == "rowwin":
             cmr, kwr = _rowwin_tiles(cap, h, i_dim, dt_size, dtype_name,
-                                     gated, fuse_combine, k)
+                                     gated, fuse_combine, k, w_dt=w_dt,
+                                     sc_bytes=sc_bytes)
             if cmr is None:
                 raise ValueError(
                     "fused_schedule='rowwin' is VMEM-infeasible at this "
@@ -1232,7 +1289,7 @@ def _fused_schedule(cap: int, h: int, i_dim: int, dt_size: int,
         return "resident", bh
     rowwin, kw = _rowwin_choice(cap, h, i_dim, dt_size, dtype_name,
                                 gated, cm, fuse_combine, k, d_world,
-                                tuned)
+                                tuned, w_dt=w_dt, sc_bytes=sc_bytes)
     if rowwin:
         return "rowwin", kw
     return "stream", None
@@ -1287,6 +1344,14 @@ def schedule_table(cfg: MoEConfig, d_world: int, *,
     cm, bi = _resolve_tiles(cap, h, i_dim, name, fuse_combine)
     gated = cfg.gated_ffn
     k = cfg.expert_top_k
+    # quantized expert storage (MoEConfig.expert_quant): the rowwin
+    # K-window streamer fetches int8/e4m3 slabs and dequantizes in
+    # VMEM, so its window geometry re-solves at the QUANTIZED bytes
+    # per element (wider feasible windows -> fewer HBM accumulator
+    # round-trips), with the resident f32 scale arrays charged against
+    # the budget.  The weights-once schedules boundary-dequantize
+    # layer-side and keep pricing at the compute width.
+    wdt, sc_bytes = _quant_geometry(cfg, d_world)
     tuned = tuning.lookup("fused_ep", h=h, i=i_dim, dtype=name)
     batched_ok = d_world >= 2 and _resident_budget_ok(
         cap, h, i_dim, dt, gated, cm, bi, fuse_combine, k,
@@ -1295,19 +1360,21 @@ def schedule_table(cfg: MoEConfig, d_world: int, *,
         cap, h, i_dim, dt, gated, cm, bi, fuse_combine, k,
         hid_rows=cap)[0]
     rw_cm, rw_kw = _rowwin_tiles(cap, h, i_dim, dt, name, gated,
-                                 fuse_combine, k)
+                                 fuse_combine, k, w_dt=wdt,
+                                 sc_bytes=sc_bytes)
     feasible = {"batched": batched_ok, "resident": resident_ok,
                 "stream": True, "rowwin": rw_cm is not None}
     forced_infeasible = None
     try:
         resolved, _aux = _fused_schedule(
             cap, h, i_dim, dt, gated, cm, bi, fuse_combine, k, d_world,
-            tuned, dtype_name=name, forced=cfg.fused_schedule)
+            tuned, dtype_name=name, forced=cfg.fused_schedule,
+            w_dt=wdt, sc_bytes=sc_bytes)
     except ValueError as e:
         forced_infeasible = str(e)
         resolved, _aux = _fused_schedule(
             cap, h, i_dim, dt, gated, cm, bi, fuse_combine, k, d_world,
-            tuned, dtype_name=name)
+            tuned, dtype_name=name, w_dt=wdt, sc_bytes=sc_bytes)
     priced = schedule if schedule is not None else resolved
     if priced not in feasible:
         raise ValueError(
@@ -1321,8 +1388,29 @@ def schedule_table(cfg: MoEConfig, d_world: int, *,
         "kw": rw_kw if priced == "rowwin" else None,
         "n_row_tiles": cap // cm, "n_i_chunks": i_dim // bi,
         "s_loc": s_loc, "h": h, "i": i_dim, "dt": dt, "gated": gated,
+        # bytes per weight element the ROWWIN streamer fetches (1 under
+        # a quantized store, = dt otherwise); the weights-once
+        # schedules stream boundary-dequantized compute-width weights
+        "wdt": wdt if wdt is not None else dt,
         "forced_infeasible": forced_infeasible,
     }
+
+
+def _quant_geometry(cfg: MoEConfig, d_world: int
+                    ) -> tuple[int | None, float]:
+    """(weight bytes/elem for the rowwin window buffers, resident
+    scale-array VMEM bytes) under ``cfg.expert_quant`` — (None, 0.0)
+    when quant is off, so every geometry resolution stays byte-
+    identical to a pre-quant build."""
+    if cfg.expert_quant is None:
+        return None, 0.0
+    from flashmoe_tpu.quant import core as qcore
+
+    wdt = int(qcore.weight_itemsize(cfg.expert_quant, cfg.dtype))
+    nlx = max(cfg.num_experts // max(d_world, 1), 1)
+    chans = (2 if cfg.gated_ffn else 1) * cfg.intermediate_size \
+        + cfg.hidden_size
+    return wdt, float(nlx * chans * 4)
 
 
 def schedule_metadata(cfg: MoEConfig, d_world: int, *,
@@ -1340,16 +1428,27 @@ def _fused_shard(send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
                  b_down, *,
                  cfg: MoEConfig, axis: str, interpret, collective_id: int,
                  detect_races: bool = False, w_gate=None,
-                 recv_pos=None, w_sorted=None, cu: int | None = None):
+                 recv_pos=None, w_sorted=None, cu: int | None = None,
+                 wup_sc=None, wdn_sc=None, wg_sc=None):
     """Launch the fused kernel.  With ``recv_pos``/``w_sorted``/``cu`` the
     combine runs in-kernel and the call returns ``(out [s_out_pad, h] f32,
     y_sorted [rows_pad, h])``; otherwise it returns the slab ``y_recv``
-    for the XLA combine."""
+    for the XLA combine.
+
+    ``wup_sc``/``wdn_sc``/``wg_sc`` (``MoEConfig.expert_quant``): f32
+    per-output-channel scales of a QUANTIZED weight store — ``w_up`` /
+    ``w_down`` / ``w_gate`` then carry int8/e4m3 payloads.  When the
+    resolved schedule is ``rowwin``, the K-window streamer fetches the
+    quantized slabs and dequantizes in VMEM (geometry re-solved at 1
+    B/elem); the weights-once schedules dequantize at this boundary
+    instead (XLA-side — their VMEM residency is capacity-bound, not
+    weight-width-bound) and launch exactly as at full precision."""
     d_world, nlx, cap, h = x_send.shape
     i_dim = w_down.shape[1]
     gated = w_gate is not None
     fuse_combine = recv_pos is not None
     k = cfg.expert_top_k
+    quant = wup_sc is not None
     # one resolution of (cm, bi) shared with the combine budget gate, so
     # the VMEM estimate that approved the opt-in describes the kernel that
     # actually launches (advisor r4 #1)
@@ -1358,12 +1457,36 @@ def _fused_shard(send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
     cm, bi = _resolve_tiles(cap, h, i_dim, dt_name, fuse_combine)
     from flashmoe_tpu import tuning
 
+    # per-K-GROUP scales always take the boundary-dequant path (the
+    # in-kernel dequant is per-output-channel only), so their geometry
+    # must budget at the COMPUTE width the kernel will actually stream
+    grouped = quant and any(
+        s is not None and s.shape[-2] != 1
+        for s in (wup_sc, wdn_sc, wg_sc))
+    w_dt, sc_bytes = (_quant_geometry(cfg, d_world)
+                      if quant and not grouped else (None, 0.0))
     schedule, aux = _fused_schedule(
         cap, h, i_dim, dt_size, gated, cm, bi,
         fuse_combine, k, d_world,
         tuning.lookup("fused_ep", h=h, i=i_dim, dtype=dt_name),
         dtype_name=dt_name, forced=cfg.fused_schedule,
+        w_dt=w_dt, sc_bytes=sc_bytes,
     )
+    if quant and (schedule != "rowwin" or grouped):
+        # weights-once schedules hold capacity-scaled hidden slabs, not
+        # weight windows — dequantize at the boundary and launch the
+        # unchanged full-precision kernel (the planner prices their
+        # weight streams at the compute width for the same reason).
+        # Per-K-GROUP scales take the same boundary path on rowwin too:
+        # the in-kernel dequant is per-output-channel only.
+        from flashmoe_tpu.quant import core as qcore
+
+        w_up = qcore.dequantize_channelwise(w_up, wup_sc, cfg.dtype)
+        w_down = qcore.dequantize_channelwise(w_down, wdn_sc, cfg.dtype)
+        if gated:
+            w_gate = qcore.dequantize_channelwise(w_gate, wg_sc,
+                                                  cfg.dtype)
+        quant = False
     bh = None
     if schedule == "rowwin":
         # the IO-aware chooser owns BOTH tiles on the rowwin schedule:
@@ -1372,11 +1495,13 @@ def _fused_shard(send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
         # the wu/wd window DMAs, the [2, bi, h] w_down slots — windows
         # the K dimension without a second code path
         cm, bi = _rowwin_tiles(cap, h, i_dim, dt_size, dt_name, gated,
-                               fuse_combine, k)
+                               fuse_combine, k, w_dt=w_dt,
+                               sc_bytes=sc_bytes)
     else:
         bh = aux
     if i_dim % bi:
         raise ValueError(f"intermediate {i_dim} not divisible by {bi}")
+    sc_args = None
     if gated:
         # interleave per-chunk: [nlx, H, nj*2*bi] as [gate_chunk | up_chunk]
         nj = i_dim // bi
@@ -1385,11 +1510,21 @@ def _fused_shard(send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
         w_up = jnp.concatenate([wg, wu], axis=-1).reshape(
             nlx, h, nj * 2 * bi
         )
+        if quant:
+            # scales interleave exactly like their payload columns
+            sgp = wg_sc.reshape(nlx, nj, bi)
+            sup = wup_sc.reshape(nlx, nj, bi)
+            sc_args = (jnp.concatenate([sgp, sup], axis=-1).reshape(
+                nlx, nj * 2 * bi).astype(jnp.float32),
+                wdn_sc.reshape(nlx, h).astype(jnp.float32))
+    elif quant:
+        sc_args = (wup_sc.reshape(nlx, i_dim).astype(jnp.float32),
+                   wdn_sc.reshape(nlx, h).astype(jnp.float32))
 
     unified = functools.partial(
         _fused_kernel, axis=axis, act_name=cfg.hidden_act, cm=cm, bi=bi,
         gated=gated, fuse_combine=fuse_combine, k=k, cu=cu,
-        schedule=schedule, bh=bh,
+        schedule=schedule, bh=bh, quant=quant,
     )
     out_shapes = [
         jax.ShapeDtypeStruct((d_world, nlx, cap, h), x_send.dtype),  # x_recv
@@ -1433,6 +1568,11 @@ def _fused_shard(send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
         out_specs.append(any_spec)
     in_specs += [any_spec] * 5
     inputs += [x_send, w_up, b_up, w_down, b_down]
+    if quant:
+        # per-output-channel f32 scales: tiny ([nLx, I(+I)] + [nLx, H])
+        # and read every window, so they live whole in VMEM
+        in_specs += [pl.BlockSpec(memory_space=pltpu.VMEM)] * 2
+        inputs += list(sc_args)
 
     # one generic wrapper splits the positional refs by the static layout
     # (inputs / outputs / scratch counts vary with fuse_combine and
@@ -1447,6 +1587,10 @@ def _fused_shard(send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
             i0 = 5
         xw = refs[i0:i0 + 5]
         i0 += 5
+        wup_sc_ = wdn_sc_ = None
+        if quant:
+            wup_sc_, wdn_sc_ = refs[i0:i0 + 2]
+            i0 += 2
         x_recv_, y_back_, y_stage_ = refs[i0:i0 + 3]
         i0 += 3
         out_ = None
@@ -1467,7 +1611,8 @@ def _fused_shard(send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
             hid = refs[i0]
             i0 += 1
         unified(send_cnt_, recv_cnt_, src_order_, recv_pos_, w_sorted_,
-                *xw, x_recv_, y_back_, y_stage_, out_, acc_hbm_,
+                *xw, wup_sc_, wdn_sc_,
+                x_recv_, y_back_, y_stage_, out_, acc_hbm_,
                 xs, wup, wdn, acc_, yv_, bup, bdn, ys, ws, ov, hid,
                 *refs[i0:])
 
@@ -1482,10 +1627,14 @@ def _fused_shard(send_cnt, recv_cnt, src_order, x_send, w_up, b_up, w_down,
     two_pass = schedule in ("resident", "batched")
     scratch = [
         pltpu.VMEM((cm, h), x_send.dtype),        # xs
+        # weight slots hold whatever streams from HBM: the compute
+        # dtype at full precision, the int8/e4m3 payload under a
+        # quantized store (w_up.dtype == x_send.dtype when quant off,
+        # so the allocation is byte-identical to the pre-quant build)
         pltpu.VMEM((2, h, 2 * bi if gated else bi),
-                   x_send.dtype),                 # w_up (+gate) 2 slots
-        (pltpu.VMEM((2, i_dim, bh), x_send.dtype) if two_pass
-         else pltpu.VMEM((2, bi, h), x_send.dtype)),  # w_down 2 slots
+                   w_up.dtype),                   # w_up (+gate) 2 slots
+        (pltpu.VMEM((2, i_dim, bh), w_down.dtype) if two_pass
+         else pltpu.VMEM((2, bi, h), w_down.dtype)),  # w_down 2 slots
         pltpu.VMEM((cm, bh if two_pass else h),
                    jnp.float32),                  # acc
         pltpu.VMEM((cm, bh if two_pass else h),
@@ -1926,19 +2075,68 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
             tiled=False,
         ).reshape(d, nlx)
 
-        w_args = (
-            params["w_up"].astype(cfg.dtype), params["b_up"],
-            params["w_down"].astype(cfg.dtype), params["b_down"],
-            (params["w_gate"].astype(cfg.dtype)
-             if cfg.gated_ffn else None),
-        )
+        quant_on = cfg.expert_quant is not None
+        quant_err = None
+        sc_kw = {}
+        if quant_on:
+            # quantized expert storage (flashmoe_tpu/quant/): the
+            # kernel streams int8/e4m3 payloads (rowwin dequantizes in
+            # VMEM; weights-once schedules dequantize at the
+            # _fused_shard boundary).  Full-precision params quantize
+            # in-graph first so the knob behaves identically whether
+            # the state was stored quantized or not.  Inference-only
+            # (config.py rejects is_training), so the custom-VJP
+            # wrapper is bypassed below.
+            from flashmoe_tpu import quant as qt
+
+            if cfg.collect_stats:
+                quant_err = qt.weight_quant_error(params, cfg)
+            if not any(kk + qt.SCALE_SUFFIX in params
+                       for kk in qt.QUANT_WEIGHT_KEYS):
+                params = qt.quantize_ffn_params(params, cfg.expert_quant)
+            w_args = (
+                params["w_up"], params["b_up"],
+                params["w_down"], params["b_down"],
+                params.get("w_gate") if cfg.gated_ffn else None,
+            )
+            sc_kw = dict(
+                wup_sc=params["w_up" + qt.SCALE_SUFFIX],
+                wdn_sc=params["w_down" + qt.SCALE_SUFFIX],
+                wg_sc=(params.get("w_gate" + qt.SCALE_SUFFIX)
+                       if cfg.gated_ffn else None))
+            if any(s is not None and s.shape[-2] != 1
+                   for s in sc_kw.values()):
+                # per-K-GROUP scales would boundary-dequantize here
+                # while the planner prices the per-channel int8
+                # streamer — a schedule/geometry the kernel never runs
+                # (code-review finding).  Refuse instead of diverging.
+                raise ValueError(
+                    "the fused path supports per-OUTPUT-CHANNEL quant "
+                    "scales only (quantize_state without group_size); "
+                    "per-K-group states run on the collective/ragged "
+                    "paths, or dequantize_state() + requantize "
+                    "per-channel")
+        else:
+            # the same quant-off guard every layer path applies: a
+            # quantized state must never astype raw payloads below
+            from flashmoe_tpu.quant import ensure_unquantized
+
+            ensure_unquantized(params)
+            w_args = (
+                params["w_up"].astype(cfg.dtype), params["b_up"],
+                params["w_down"].astype(cfg.dtype), params["b_down"],
+                (params["w_gate"].astype(cfg.dtype)
+                 if cfg.gated_ffn else None),
+            )
         i_dim = params["w_down"].shape[1]
         # tier-0 degradation needs the per-expert outputs BEFORE the
         # weighted combine, so the in-kernel (fused) combine is
         # incompatible with it — degrade forces the XLA combine branch
-        # (same math, explicit ybuf)
+        # (same math, explicit ybuf).  A quantized store also keeps the
+        # XLA combine: the sorted-return path has no quant arm.
         if (_fuse_combine_enabled(cfg, s_loc, h, i_dim, cap_pad, d)
-                and not cfg.degrade_unhealthy_experts):
+                and not cfg.degrade_unhealthy_experts
+                and not quant_on):
             kk = cfg.expert_top_k
             cu = _combine_chunk_rows(kk)
             rows_pad = -(-(s_loc * kk) // (cu * kk)) * (cu * kk)
@@ -1964,10 +2162,22 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
                     prof.fence(out)
         else:
             with trace_span("moe.fused_kernel"):
-                y_recv = _fused_core(
-                    send_cnt, recv_cnt, src_order, x_send, *w_args,
-                    cfg, "ep", interpret, collective_id, detect_races,
-                )
+                if quant_on:
+                    # direct launch: the custom-VJP wrapper only exists
+                    # for training, which config.py rejects under quant
+                    y_recv = _fused_shard(
+                        send_cnt, recv_cnt, src_order, x_send,
+                        w_args[0], w_args[1], w_args[2], w_args[3],
+                        cfg=cfg, axis="ep", interpret=interpret,
+                        collective_id=collective_id,
+                        detect_races=detect_races, w_gate=w_args[4],
+                        **sc_kw)
+                else:
+                    y_recv = _fused_core(
+                        send_cnt, recv_cnt, src_order, x_send, *w_args,
+                        cfg, "ep", interpret, collective_id,
+                        detect_races,
+                    )
                 if cfg.profile_phases:
                     prof.fence(y_recv)
             with trace_span("moe.combine"):
@@ -2005,6 +2215,9 @@ def fused_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
 
                 stats = hlt.attach_degradation(stats, healthy,
                                                r.expert_idx, token_axes)
+            if quant_err is not None:
+                stats = st.with_quant_error(stats, quant_err,
+                                            token_axes)
         return MoEOutput(out.astype(cfg.dtype), aux, z, counts, stats)
 
     pspecs = {k: P("ep") if k != "gate_w" and not k.startswith("shared")

@@ -373,6 +373,19 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
     nlx = e // d
     n_assign = s_loc * cfg.expert_top_k
     recv_bound = d * n_assign  # worst case: everyone routes to me
+    # quantized expert storage (flashmoe_tpu/quant/): resolve the FFN
+    # weight shard to its dequant-in-compute form up front — the
+    # chunked pipeline's per-chunk weight slices then slice plain
+    # compute arrays (no scale keys left downstream).  Called
+    # UNCONDITIONALLY: off returns the dict untouched (bit-identical
+    # graph) but a quantized state under a quant-off config is refused
+    # instead of matmuling raw payloads (code-review finding).
+    from flashmoe_tpu import quant as qt
+
+    quant_err = (qt.weight_quant_error(params, cfg)
+                 if cfg.expert_quant is not None and cfg.collect_stats
+                 else None)
+    params = qt.ffn_compute_params(params, cfg)
     wire_disp = wr.resolve(cfg.wire_dtype)
     wire_comb = wr.resolve(cfg.wire_dtype_combine)
     n_chunks = cfg.a2a_chunks or 1
@@ -537,6 +550,8 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
                                            reduce_axes)
         if wire_err is not None:
             stats = st.with_wire_error(stats, wire_err, reduce_axes)
+        if quant_err is not None:
+            stats = st.with_quant_error(stats, quant_err, reduce_axes)
     return MoEOutput(out.astype(cfg.dtype), aux, z, cnts, stats)
 
 

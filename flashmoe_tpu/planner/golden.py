@@ -54,6 +54,15 @@ GOLDEN_DECODE_TOKENS = 64
 # (tests/test_planner.py::test_golden_slices_dimension_gates_dcn_wire)
 GOLDEN_SLICES = (1, 2, 4, 8)
 GOLDEN_WIRE_DCN = "e4m3"
+# the quantized-expert-storage dimension (ISSUE 15,
+# MoEConfig.expert_quant): full-precision weights vs the int8
+# per-output-channel store.  Each point freezes the chunk-swept plan
+# plus the fused[rowwin]-vs-collective race terms — the headline gate
+# (tests/test_quant.py) is that int8 cuts the modeled fused[rowwin]
+# weight-stream time to <= 0.55x its full-precision value on the
+# mixtral point and thereby closes (or flips) the recorded
+# rowwin-vs-collective margin.
+GOLDEN_QUANT = {"off": {}, "int8": {"expert_quant": "int8"}}
 
 _TERMS = ("compute_ms", "hbm_ms", "ici_ms", "dcn_ms", "total_ms")
 
@@ -119,11 +128,44 @@ def _slice_point(cfg, gen: str, s: int) -> dict:
     return point
 
 
+def _quant_point(cfg, gen: str) -> dict:
+    """One frozen quant point: the chunk-swept plan at this store plus
+    the fused[rowwin]-vs-collective race decomposition (the PR 11
+    mixtral verdict re-derived per store — weight-stream ms is the
+    term the int8 store halves/quarters)."""
+    from flashmoe_tpu.planner.model import _dtype_peak
+
+    preds = {p.path: p for p in predict_paths(cfg, GOLDEN_D, gen)}
+    _, hbm_bs = _dtype_peak(gen, cfg)
+    rw, coll = preds["fused[rowwin]"], preds["collective"]
+    rw_w_ms = rw.cost.weight_bytes / hbm_bs * 1e3
+    return {
+        "plan": _predicted_plan(cfg, gen, "training"),
+        "rowwin_feasible": rw.feasible,
+        "rowwin_weight_ms": round(rw_w_ms, 6),
+        "rowwin_total_ms": round(rw.total_ms, 6),
+        "collective_total_ms": round(coll.total_ms, 6),
+        # the recorded race: < 1 means the fused rowwin schedule beats
+        # the collective path on modeled latency at this store
+        "rowwin_vs_collective": round(rw.total_ms / coll.total_ms, 6),
+        "rowwin_beats_collective": bool(rw.feasible
+                                        and rw.total_ms < coll.total_ms),
+    }
+
+
 def golden_snapshot() -> dict:
     """Recompute the full golden structure from the live model."""
     from flashmoe_tpu.config import BENCH_CONFIGS
 
-    out = {"d": GOLDEN_D, "configs": {}, "decode": {}, "slices": {}}
+    out = {"d": GOLDEN_D, "configs": {}, "decode": {}, "slices": {},
+           "quant": {}}
+    for name in GOLDEN_CONFIGS:
+        cfg = BENCH_CONFIGS[name]
+        gens = {}
+        for gen in GOLDEN_GENS:
+            gens[gen] = {qtag: _quant_point(cfg.replace(**qknobs), gen)
+                         for qtag, qknobs in GOLDEN_QUANT.items()}
+        out["quant"][name] = gens
     for name in GOLDEN_CONFIGS:
         cfg = BENCH_CONFIGS[name]
         gens = {}

@@ -125,6 +125,9 @@ class PathPrediction:
                                # serial_ms/total_ms (0 unless the
                                # caller priced a dp axis; same value on
                                # every row of one prediction set)
+    quant: str = "off"         # expert-weight store priced
+                               # (MoEConfig.expert_quant canonical
+                               # name; "off" = full-precision weights)
 
     @property
     def family(self) -> str:
@@ -323,6 +326,9 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
     if wire_dcn_tag != "off":
         wire_tag += f"/dcn:{wire_dcn_tag}"
     wire_on = wire_tag != "off/off"
+    from flashmoe_tpu.quant import core as qcore
+
+    quant_tag = qcore.canonical_name(cfg.expert_quant)
     ar_ms = dp_allreduce_ms(cfg, dp, gen, over_dcn=dp_over_dcn,
                             links=links)
     n_chunks = cfg.a2a_chunks or 1
@@ -346,7 +352,8 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
             dcn_ms=dcn_ms, serial_ms=serial_ms,
             total_ms=serial_ms if total_ms is None else total_ms + ar_ms,
             feasible=feasible, note=note, cost=cost, wire=wire,
-            a2a_chunks=chunks, dp_allreduce_ms=ar_ms))
+            a2a_chunks=chunks, dp_allreduce_ms=ar_ms,
+            quant=quant_tag))
         return rows[-1]
 
     if d == 1:
@@ -486,11 +493,23 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
     # --- fused + in-kernel combine at the resolved schedule -----------
     sched = meta["schedule"]
     cost = path_costs(cfg, "fused_combine", d_world=d)
-    ok = meta["feasible"][sched] and slices == 1 and not wire_on
+    # the sorted-return combine has no quant arm: the layer forces the
+    # XLA combine whenever expert_quant is on (parallel/fused.py), so
+    # this row must be infeasible there — a selected plan the engine
+    # silently downgrades is the modeled-vs-run divergence this PR
+    # refuses everywhere else (code-review finding)
+    base_ok = meta["feasible"][sched] and slices == 1 and not wire_on
+    ok = base_ok and quant_tag == "off"
+    if ok:
+        fc_note = "sorted per-row returns; combine off the critical path"
+    elif base_ok:
+        fc_note = ("in-kernel combine has no quant arm; the layer runs "
+                   "fused + XLA combine under expert_quant")
+    else:
+        fc_note = fused_why_out(sched)
     mk("fused_combine", cost, 2 * t_x, 0.0,
        total_ms=fused_total(cost, sched), schedule=sched, feasible=ok,
-       note=("sorted per-row returns; combine off the critical path"
-             if ok else fused_why_out(sched)))
+       note=fc_note)
 
     rows.sort(key=lambda r: (not r.feasible, r.total_ms))
     return rows

@@ -106,12 +106,13 @@ class Selection:
 
 
 def _shape_key(cfg: MoEConfig, d: int) -> dict:
-    # wire/wire_combine/chunks ride the key so a latency measured with
-    # payload compression (or a chunked pipeline) on is never applied
-    # to a run without it (and vice versa) —
-    # tuning.measured_path_latencies matches them STRICTLY, with
-    # "off" / 1 as the implicit defaults for legacy entries
+    # wire/wire_combine/chunks/quant ride the key so a latency measured
+    # with payload compression, a chunked pipeline, or a quantized
+    # expert store on is never applied to a run without it (and vice
+    # versa) — tuning.measured_path_latencies matches them STRICTLY,
+    # with "off" / 1 as the implicit defaults for legacy entries
     from flashmoe_tpu.ops import wire as wr
+    from flashmoe_tpu.quant import core as qcore
 
     return dict(h=cfg.hidden_size, i=cfg.intermediate_size,
                 e=cfg.num_experts, k=cfg.expert_top_k, s=cfg.tokens,
@@ -119,7 +120,8 @@ def _shape_key(cfg: MoEConfig, d: int) -> dict:
                 wire=wr.canonical_name(cfg.wire_dtype),
                 wire_combine=wr.canonical_name(cfg.wire_dtype_combine),
                 wire_dcn=wr.canonical_name(cfg.wire_dtype_dcn),
-                chunks=cfg.a2a_chunks or 1)
+                chunks=cfg.a2a_chunks or 1,
+                quant=qcore.canonical_name(cfg.expert_quant))
 
 
 def _bench_record_latencies(cfg: MoEConfig, d: int) -> dict:
@@ -135,12 +137,15 @@ def _bench_record_latencies(cfg: MoEConfig, d: int) -> dict:
     path = os.environ.get("FLASHMOE_BENCH_RECORDS")
     if not path or not os.path.exists(path):
         return {}
+    from flashmoe_tpu.quant import core as qcore
+
     sig = (f"E={cfg.num_experts},k={cfg.expert_top_k},"
            f"H={cfg.hidden_size},I={cfg.intermediate_size},"
            f"S={cfg.tokens},{jnp.dtype(cfg.dtype).name}")
     wire_sig = (wr.canonical_name(cfg.wire_dtype),
                 wr.canonical_name(cfg.wire_dtype_combine),
                 wr.canonical_name(cfg.wire_dtype_dcn))
+    quant_sig = qcore.canonical_name(cfg.expert_quant)
     out: dict[str, float] = {}
 
     def keep(p, v):
@@ -169,6 +174,11 @@ def _bench_record_latencies(cfg: MoEConfig, d: int) -> dict:
                     continue
                 if int(rec.get("a2a_chunks", 1) or 1) != (
                         cfg.a2a_chunks or 1):
+                    continue
+                # quantized-store identity: a timing of int8 weights
+                # never overrides a full-precision selection (records
+                # without the field are legacy = off)
+                if str(rec.get("expert_quant", "off")) != quant_sig:
                     continue
                 keep(rec.get("path"), rec.get("value"))
                 keep("xla", rec.get("xla_path_ms"))

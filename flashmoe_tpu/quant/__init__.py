@@ -1,0 +1,55 @@
+"""Quantized expert storage & compute (ISSUE 15).
+
+The paper's thesis is that distributed MoE is bytes-bound; PR 5/12
+compressed the *wire* (fp8 all-to-all payloads, per-hop DCN dtypes) but
+every expert weight still streams from HBM — and lives in memory — at
+full compute precision.  This package is the storage-axis counterpart
+of :mod:`flashmoe_tpu.ops.wire`: post-training quantization of the MoE
+FFN expert weights to int8 or fp8 (e4m3) with per-output-channel (and
+optional per-K-group) f32 scales, dequantized *in compute* so every
+matmul still accumulates in f32.
+
+Three layers:
+
+* :mod:`flashmoe_tpu.quant.core` — the codec: symmetric absmax
+  per-channel quantize/dequantize, byte accounting
+  (:func:`weight_itemsize` is what the analysis/planner models price).
+* :mod:`flashmoe_tpu.quant.state` — storage: :class:`QuantizedExpertState`
+  (``quantize_state`` / ``dequantize_state`` round trip over flat MoE
+  param dicts AND nested transformer trees), the CRC'd ``quant``
+  manifest block (:mod:`flashmoe_tpu.runtime.checkpoint`), and
+  :func:`ffn_compute_params` — the ONE layer-boundary hook every MoE
+  layer calls (``None`` = off = the untouched dict, bit-identical by
+  construction; proven by the staticcheck invariant engine).
+* :mod:`flashmoe_tpu.quant.calibrate` — absmax / percentile-clipping
+  calibration over a seeded activation sample, with a measured
+  output-error report per percentile candidate.
+
+Execution semantics (docs/PERF.md "Quantized expert storage"):
+
+* ``MoEConfig.expert_quant`` set + params pre-quantized
+  (:func:`quantize_state`): the layers stream int8/fp8 payloads from
+  HBM and dequantize in compute — the storage and HBM savings the
+  planner prices.
+* ``expert_quant`` set + ordinary full-precision params: the layers
+  fake-quant in-graph (quantize -> dequantize round trip) — identical
+  numerics to offline absmax quantization, no storage savings; this is
+  what the invariant engine traces and what a numerics A/B costs.
+* ``expert_quant=None`` (default): no quant code runs at all.
+"""
+
+from flashmoe_tpu.quant.calibrate import (  # noqa: F401
+    CalibrationResult, activation_sample, calibrate,
+)
+from flashmoe_tpu.quant.core import (  # noqa: F401
+    QUANT_NAMES, canonical_name, dequantize_channelwise,
+    quantize_channelwise, resolve, roundtrip, roundtrip_error,
+    scale_overhead_bytes, weight_itemsize,
+)
+from flashmoe_tpu.quant.state import (  # noqa: F401
+    QUANT_WEIGHT_KEYS, QuantizedExpertState, SCALE_SUFFIX,
+    dequantize_state, ensure_unquantized, ffn_compute_params,
+    is_quantized, quant_bytes_saved, quant_metadata,
+    quantize_ffn_params, quantize_state, verify_quant_metadata,
+    weight_quant_error,
+)

@@ -1,0 +1,289 @@
+"""Quantized expert storage: the state pytree, the checkpoint metadata
+block, and the ONE layer-boundary compute hook.
+
+Storage layout: a quantized param dict is the ordinary MoE param dict
+with each expert FFN weight key (``w_up`` / ``w_gate`` / ``w_down``)
+holding the int8/e4m3 *payload* (same shape) and a sibling
+``<key>_qscale`` f32 array holding the per-output-channel (or
+per-K-group) scales.  Biases, ``gate_w`` and shared-expert weights stay
+at full precision (they are a rounding error of the byte budget and
+carry the layer's additive numerics).  Keeping the dict shape means the
+whole existing plumbing — shard_map pspecs (scale arrays lead with the
+expert axis, so ``P('ep')`` shards them like their payloads), orbax
+checkpoints, the controller's ``permute_expert_state`` — moves payload
+and scales coherently with zero special cases beyond key lists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import zlib
+
+import jax.numpy as jnp
+
+from flashmoe_tpu.quant import core
+
+#: the expert FFN weight keys the quantizer owns ([E, K, N] layout)
+QUANT_WEIGHT_KEYS = ("w_up", "w_gate", "w_down")
+#: sibling key carrying a payload's f32 scales
+SCALE_SUFFIX = "_qscale"
+
+
+def _is_expert_dict(d) -> bool:
+    """An expert FFN param group: a dict whose ``w_up`` is the stacked
+    [E, H, I] expert tensor (``shared_w_up`` is 2-D and stays out)."""
+    return (isinstance(d, dict) and "w_up" in d
+            and getattr(d["w_up"], "ndim", 0) == 3)
+
+
+def _walk_expert_dicts(tree, fn):
+    """Rebuild ``tree`` with ``fn(expert_dict) -> new_dict`` applied to
+    every expert FFN param group (nested transformer trees included)."""
+    if _is_expert_dict(tree):
+        return fn(tree)
+    if isinstance(tree, dict):
+        return {k: _walk_expert_dicts(v, fn) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        seq = [_walk_expert_dicts(v, fn) for v in tree]
+        return type(tree)(seq)
+    return tree
+
+
+def _iter_expert_dicts(tree):
+    if _is_expert_dict(tree):
+        yield tree
+        return
+    if isinstance(tree, dict):
+        for v in tree.values():
+            yield from _iter_expert_dicts(v)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from _iter_expert_dicts(v)
+
+
+def is_quantized(params) -> bool:
+    """Whether any expert FFN group in ``params`` carries quantized
+    payload + scale pairs."""
+    for d in _iter_expert_dicts(params):
+        if any(k + SCALE_SUFFIX in d for k in QUANT_WEIGHT_KEYS):
+            return True
+    return False
+
+
+@dataclasses.dataclass
+class QuantizedExpertState:
+    """A quantized parameter tree plus its storage metadata.
+
+    ``params`` is layer-ready (pass it anywhere a param dict goes —
+    the MoE layers, the serving engine, ``checkpoint.save`` via a
+    TrainState); ``meta`` is the JSON-able ``quant`` block the
+    checkpoint manifest carries (:func:`quant_metadata` regenerates it
+    from the params alone, so the block can always be re-derived and
+    verified)."""
+
+    params: dict
+    meta: dict
+
+    def dequantize(self, out_dtype=None) -> dict:
+        return dequantize_state(self.params, out_dtype)
+
+
+def quantize_ffn_params(params: dict, qname: str, *,
+                        group_size: int | None = None,
+                        clip: dict | None = None) -> dict:
+    """Quantize ONE flat expert FFN param dict: each
+    :data:`QUANT_WEIGHT_KEYS` present is replaced by its payload with a
+    ``<key>_qscale`` sibling.  ``clip``: optional per-key absmax caps
+    (:class:`~flashmoe_tpu.quant.calibrate.CalibrationResult.clip`)."""
+    out = dict(params)
+    for k in QUANT_WEIGHT_KEYS:
+        if k not in params:
+            continue
+        payload, scales = core.quantize_channelwise(
+            params[k], qname, group_size=group_size,
+            clip=None if clip is None else clip.get(k))
+        out[k] = payload
+        out[k + SCALE_SUFFIX] = scales
+    return out
+
+
+def _dequant_ffn_params(params: dict, out_dtype=None) -> dict:
+    """Invert :func:`quantize_ffn_params` on one flat dict (pass-through
+    for unquantized dicts)."""
+    out = dict(params)
+    for k in QUANT_WEIGHT_KEYS:
+        sk = k + SCALE_SUFFIX
+        if sk not in out:
+            continue
+        out[k] = core.dequantize_channelwise(
+            out[k], out.pop(sk),
+            out_dtype if out_dtype is not None else jnp.float32)
+    return out
+
+
+def quantize_state(params, qname: str, *, group_size: int | None = None,
+                   calibration=None) -> QuantizedExpertState:
+    """Post-training quantization of every expert FFN group in a param
+    tree (a flat MoE dict or a nested transformer tree).  Returns a
+    :class:`QuantizedExpertState` whose ``meta`` records the store
+    dtype, grouping, per-key worst-case round-trip error, and the
+    metadata CRC the checkpoint manifest verifies."""
+    clip = getattr(calibration, "clip", calibration)
+    qparams = _walk_expert_dicts(
+        params, lambda d: quantize_ffn_params(
+            d, qname, group_size=group_size, clip=clip))
+    return QuantizedExpertState(params=qparams,
+                                meta=quant_metadata(qparams))
+
+
+def dequantize_state(params, out_dtype=None) -> dict:
+    """Round-trip API: a quantized param tree (or
+    :class:`QuantizedExpertState`) back to full-precision weights
+    (f32 unless ``out_dtype``), scale keys dropped.  Unquantized trees
+    pass through untouched."""
+    if isinstance(params, QuantizedExpertState):
+        params = params.params
+    return _walk_expert_dicts(
+        params, lambda d: _dequant_ffn_params(d, out_dtype))
+
+
+def quant_metadata(params) -> dict | None:
+    """The JSON-able ``quant`` manifest block derived from a param tree:
+    store dtype, group size, quantized key census, and a CRC32 over the
+    canonical block content so a manifest reader can detect a tampered/
+    torn block (:func:`verify_quant_metadata`).  ``None`` for
+    unquantized trees — legacy manifests stay byte-identical."""
+    if isinstance(params, QuantizedExpertState):
+        params = params.params
+    dtypes = set()
+    groups = set()
+    keys: dict[str, int] = {}
+    for d in _iter_expert_dicts(params):
+        for k in QUANT_WEIGHT_KEYS:
+            sk = k + SCALE_SUFFIX
+            if sk not in d:
+                continue
+            keys[k] = keys.get(k, 0) + 1
+            dtypes.add(jnp.dtype(d[k].dtype).name)
+            kdim = d[k].shape[-2]
+            # 0 = per-output-channel (one scale group spanning K);
+            # otherwise the K-group size the scales were stored at
+            ngroups = d[sk].shape[-2]
+            groups.add(0 if ngroups == 1 else kdim // ngroups)
+    if not keys:
+        return None
+    name = {"int8": "int8", "float8_e4m3fn": "e4m3"}.get(
+        next(iter(dtypes)) if len(dtypes) == 1 else "", "mixed")
+    block = {
+        "version": 1,
+        "dtype": name,
+        "payload_dtypes": sorted(dtypes),
+        "group_sizes": sorted(int(g) for g in groups),
+        "keys": {k: keys[k] for k in sorted(keys)},
+        "scale_suffix": SCALE_SUFFIX,
+    }
+    block["crc32"] = _meta_crc(block)
+    return block
+
+
+def _meta_crc(block: dict) -> int:
+    body = {k: v for k, v in block.items() if k != "crc32"}
+    return zlib.crc32(
+        json.dumps(body, sort_keys=True).encode("utf-8"))
+
+
+def verify_quant_metadata(block: dict | None) -> bool:
+    """CRC-check a manifest ``quant`` block (True for None — no block
+    is a legacy manifest, not a corrupt one)."""
+    if block is None:
+        return True
+    if not isinstance(block, dict) or "crc32" not in block:
+        return False
+    return _meta_crc(block) == block["crc32"]
+
+
+def quant_bytes_saved(params, full_dtype=jnp.float32) -> int:
+    """HBM/storage bytes a quantized tree frees vs holding the same
+    weights at ``full_dtype`` (scale sidecars charged against the
+    saving).  0 for unquantized trees.  The serving engine reports
+    this as additional KV-cache page headroom (``observe --serving``)."""
+    full = jnp.dtype(full_dtype).itemsize
+    saved = 0
+    for d in _iter_expert_dicts(params):
+        for k in QUANT_WEIGHT_KEYS:
+            sk = k + SCALE_SUFFIX
+            if sk not in d:
+                continue
+            payload, scales = d[k], d[sk]
+            saved += payload.size * (full - jnp.dtype(payload.dtype)
+                                     .itemsize)
+            saved -= scales.size * 4
+    return int(max(saved, 0))
+
+
+def ffn_compute_params(params: dict, cfg) -> dict:
+    """THE layer-boundary hook: resolve a flat MoE param dict to the
+    weights the expert FFN should compute with, per
+    ``cfg.expert_quant``.
+
+    * ``None`` (default): the dict is returned UNTOUCHED — no quant
+      code runs, the traced graph is byte-identical to a pre-quant
+      build (invariant-engine-proven).
+    * set + pre-quantized dict: payloads dequantize to f32
+      (dequant-in-compute; the matmul casts to the compute dtype and
+      accumulates f32 exactly like the full-precision kernel).
+    * set + full-precision dict: in-graph fake-quant round trip —
+      identical numerics to offline absmax quantization, so a numerics
+      A/B needs no stored artifacts.
+    """
+    qname = getattr(cfg, "expert_quant", None)
+    quantized = any(k + SCALE_SUFFIX in params
+                    for k in QUANT_WEIGHT_KEYS)
+    if qname is None:
+        ensure_unquantized(params)
+        return params
+    if quantized:
+        return _dequant_ffn_params(params)
+    out = dict(params)
+    for k in QUANT_WEIGHT_KEYS:
+        if k in out:
+            out[k] = core.roundtrip(out[k], qname)
+    return out
+
+
+def weight_quant_error(params: dict, cfg) -> jnp.ndarray | None:
+    """In-graph round-trip error proxy of the store on this layer's
+    weights (``MoEStats.quant_error``): the max over weight keys of
+    :func:`~flashmoe_tpu.quant.core.roundtrip_error` — the real
+    quantization loss on fake-quant runs.  Pre-quantized states
+    short-circuit to ``None`` (the stat stays 0): re-measuring the
+    already-lossy compute weights would spend three full weight passes
+    per layer per step to report ~0 (code-review finding) — their
+    baked loss lives in the state's ``meta`` / checkpoint quant block.
+    ``None`` when quant is off."""
+    qname = getattr(cfg, "expert_quant", None)
+    if qname is None:
+        return None
+    if any(k + SCALE_SUFFIX in params for k in QUANT_WEIGHT_KEYS):
+        return None
+    err = None
+    for k in QUANT_WEIGHT_KEYS:
+        if k not in params:
+            continue
+        e = core.roundtrip_error(params[k], qname)
+        err = e if err is None else jnp.maximum(err, e)
+    return err
+
+
+def ensure_unquantized(params: dict) -> None:
+    """THE quant-off guard, shared by every layer path: refuse a
+    quantized state whose scales a quant-off config would silently
+    ignore — matmuling raw ±127 payloads is finite garbage, not an
+    error (code-review finding)."""
+    if any(k + SCALE_SUFFIX in params for k in QUANT_WEIGHT_KEYS):
+        raise ValueError(
+            "params carry quantized expert weights (+_qscale scales) "
+            "but cfg.expert_quant is None; set expert_quant to the "
+            "state's store dtype or dequantize_state() the params "
+            "first")

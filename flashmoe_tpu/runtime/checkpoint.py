@@ -144,7 +144,8 @@ def _walk_payload(root: str) -> dict[str, dict]:
 
 def write_manifest(directory: str, step: int,
                    loader_state: dict | None = None,
-                   controller_state: dict | None = None) -> str:
+                   controller_state: dict | None = None,
+                   quant_meta: dict | None = None) -> str:
     """Checksum every file under the step dir into manifest-<step>.json.
     Called by :func:`save` after the write lands; returns the path.
 
@@ -166,6 +167,13 @@ def write_manifest(directory: str, step: int,
         manifest["loader"] = dict(loader_state)
     if controller_state is not None:
         manifest["controller"] = dict(controller_state)
+    if quant_meta is not None:
+        # quantized expert storage (flashmoe_tpu/quant/): the state's
+        # quant block — store dtype, grouping, key census — with its
+        # own content CRC (quant.verify_quant_metadata), so a restore
+        # can prove the dequantization recipe matches the payload it
+        # is about to decode.  Pre-quant manifests simply lack the key.
+        manifest["quant"] = dict(quant_meta)
     path = _manifest_path(directory, step)
     # per-process tmp name + atomic replace: even if two writers race
     # (they should not — save() gates on process 0), no reader ever sees
@@ -213,6 +221,46 @@ def load_loader_state(directory: str, step: int) -> dict | None:
         return None
     loader = manifest.get("loader")
     return dict(loader) if isinstance(loader, dict) else None
+
+
+def load_quant_metadata(directory: str, step: int) -> dict | None:
+    """The quantized-expert-storage block stored with the step's
+    manifest (:func:`flashmoe_tpu.quant.quant_metadata`), CRC-verified,
+    or None (full-precision state, legacy pre-quant manifest,
+    unreadable manifest).  Raises :class:`CheckpointCorruptionError`
+    when a block is present but fails its content CRC — a torn/tampered
+    quant recipe must never silently decode payloads with the wrong
+    scales."""
+    try:
+        with open(_manifest_path(directory, step)) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return None
+    block = manifest.get("quant")
+    if block is None:
+        return None
+    from flashmoe_tpu.quant import verify_quant_metadata
+
+    if not isinstance(block, dict) or not verify_quant_metadata(block):
+        raise CheckpointCorruptionError(
+            f"checkpoint step {step} in {directory} carries a quant "
+            f"metadata block that fails its content CRC")
+    return dict(block)
+
+
+def _state_quant_meta(state) -> dict | None:
+    """Derive the manifest quant block from a state's params (None for
+    full-precision states — save() calls this automatically, so
+    quantized TrainStates get their block without caller plumbing)."""
+    params = getattr(state, "params", None)
+    if params is None:
+        return None
+    try:
+        from flashmoe_tpu.quant import quant_metadata
+
+        return quant_metadata(params)
+    except Exception:  # noqa: BLE001 — metadata must never fail a save
+        return None
 
 
 def load_controller_state(directory: str, step: int) -> dict | None:
@@ -397,7 +445,8 @@ def _write_sync(directory: str, state: TrainState, step: int,
     # shared directory — every process writing it would race
     if jax.process_index() == 0:
         write_manifest(directory, step, loader_state=loader_state,
-                       controller_state=controller_state)
+                       controller_state=controller_state,
+                       quant_meta=_state_quant_meta(state))
         _prune_stale_manifests(directory)
 
 
@@ -427,7 +476,8 @@ def save(directory: str, state: TrainState, step: int | None = None,
         mgr.wait_until_finished()
         if jax.process_index() == 0:
             write_manifest(directory, step, loader_state=loader_state,
-                           controller_state=controller_state)
+                           controller_state=controller_state,
+                           quant_meta=_state_quant_meta(state))
             _prune_stale_manifests(directory)
     return step
 

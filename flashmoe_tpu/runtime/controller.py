@@ -186,8 +186,15 @@ def detected_slices() -> int:
 
 #: MoE param leaves stacked on a leading expert axis (permuted by
 #: ``perm`` along axis 0); ``gate_w`` is the router table, permuted
-#: along its expert COLUMNS instead
-_EXPERT_AXIS0 = frozenset({"w_up", "b_up", "w_down", "b_down", "w_gate"})
+#: along its expert COLUMNS instead.  The ``_qscale`` siblings are the
+#: f32 scale sidecars of a quantized expert store
+#: (flashmoe_tpu/quant/) — they MUST move with their payloads, or a
+#: re-placement would decode every moved expert with another expert's
+#: scales.
+_EXPERT_AXIS0 = frozenset({
+    "w_up", "b_up", "w_down", "b_down", "w_gate",
+    "w_up_qscale", "w_down_qscale", "w_gate_qscale",
+})
 
 
 def _key_str(k) -> str:

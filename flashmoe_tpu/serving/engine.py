@@ -325,9 +325,52 @@ class ServingEngine:
         self._rates = {"tokens": WindowedRate(), "admits": WindowedRate(),
                        "evictions": WindowedRate()}
 
+        # ---- quantized expert storage (flashmoe_tpu/quant/) ----------
+        # the engine accepts a QuantizedExpertState (or a raw quantized
+        # tree) whenever cfg.expert_quant is set; the HBM the narrow
+        # store frees is reported as additional KV-cache page headroom
+        # (`observe --serving`), since on a serving host weight bytes
+        # and KV pages compete for the same memory.
+        from flashmoe_tpu import quant as qt
+
+        if isinstance(params, qt.QuantizedExpertState):
+            self.params = params = params.params
+        self.quant_info = None
+        if cfg.expert_quant is not None:
+            if not qt.is_quantized(params):
+                # a full-precision checkpoint under the quant knob
+                # would fake-quant ALL expert weights inside every
+                # jitted step — strictly slower with zero memory
+                # savings.  Quantize ONCE at load instead, so serving
+                # always runs the dequant-in-compute store (code-review
+                # finding).
+                self.params = params = qt.quantize_state(
+                    params, cfg.expert_quant).params
+            self.quant_info = {
+                "expert_quant": qt.canonical_name(cfg.expert_quant),
+                "freed_bytes": qt.quant_bytes_saved(params,
+                                                    cfg.param_dtype),
+            }
+
         self.cache = init_paged_cache(cfg, self.serve.num_pages,
                                       self.serve.page_size)
         self.pool = PagePool(self.serve.num_pages)
+        if self.quant_info is not None:
+            page_bytes = (self.cache.k_pages.nbytes
+                          + self.cache.v_pages.nbytes
+                          ) / self.serve.num_pages
+            extra = int(self.quant_info["freed_bytes"] // page_bytes)
+            self.quant_info.update(
+                page_bytes=int(page_bytes), extra_kv_pages=extra)
+            self.metrics.decision(
+                "serve.quant",
+                expert_quant=self.quant_info["expert_quant"],
+                freed_mb=round(self.quant_info["freed_bytes"] / 2**20,
+                               3),
+                extra_kv_pages=extra,
+                num_pages=self.serve.num_pages)
+            self.metrics.gauge("serve.quant_freed_mb",
+                               self.quant_info["freed_bytes"] / 2**20)
         self.queue: deque = deque()       # (arrival_step, _Slot-seed)
         self.slots: list[_Slot | None] = [None] * self.serve.max_batch
         self._logits = jnp.zeros(
@@ -401,8 +444,10 @@ class ServingEngine:
                 "serving_mode": cfg.serving_mode,
                 "wire_dtype": cfg.wire_dtype,
                 "a2a_chunks": cfg.a2a_chunks,
+                "expert_quant": cfg.expert_quant,
                 "ep": cfg.ep,
             },
+            "quant": self.quant_info,
             "tracing": self.tracer is not None,
         }
 
@@ -773,4 +818,9 @@ class ServingEngine:
             s["tpot_ms_mean"] = round(tp.mean, 3)
         s["decode_plan"] = list(self.decode_plan)
         s["prefill_plan"] = list(self.prefill_plan)
+        if self.quant_info is not None:
+            s["expert_quant"] = self.quant_info["expert_quant"]
+            s["quant_freed_mb"] = round(
+                self.quant_info["freed_bytes"] / 2**20, 3)
+            s["quant_extra_kv_pages"] = self.quant_info["extra_kv_pages"]
         return s

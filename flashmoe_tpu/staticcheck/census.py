@@ -67,6 +67,15 @@ CENSUS_DCN_INNER = 4      # hierarchical blocking: 2 slices of 4 ranks
 #: slices=1, where the variant would just duplicate the base rows.
 CENSUS_EXTRA_WIRES = {"dcn-e4m3": {"wire_dtype_dcn": "e4m3"}}
 
+#: the quantized-expert-storage dimension (MoEConfig.expert_quant,
+#: ISSUE 15): weights are rank-LOCAL, so the int8 store must leave
+#: every collective — count and bytes — exactly where the
+#: full-precision build put it.  One serial-chunk, wire-off variant
+#: per (config, path) reconciles that claim against the traced graph;
+#: a quant implementation that smuggled a gather/a2a (or re-sized an
+#: exchange) fails these rows before any silicon runs it.
+CENSUS_QUANT = {"int8": {"expert_quant": "int8"}}
+
 
 @dataclasses.dataclass(frozen=True)
 class CensusRow:
@@ -109,6 +118,17 @@ def census_matrix():
                                 "(config.py); collective covers this "
                                 "config")
                     yield name, cfg, wtag, ctag, path, skip
+        # quantized-store rows (serial, wire off): the comm model must
+        # be UNMOVED by expert_quant — weights never ride a collective
+        for qtag, qknobs in CENSUS_QUANT.items():
+            cfg = base.replace(ep=CENSUS_D, **qknobs)
+            for path in CENSUS_PATHS:
+                skip = ""
+                if path == "ragged" and cfg.num_shared_experts:
+                    skip = ("ragged layer rejects shared experts "
+                            "(config.py); collective covers this "
+                            "config")
+                yield name, cfg, f"off+q:{qtag}", "serial", path, skip
 
 
 def _trace(cfg, path, devices):

@@ -163,6 +163,20 @@ def _pred_health_ops_added(base, on, ctx):
     return None
 
 
+def _pred_quant_ops_present(base, on, ctx):
+    # on-rule teeth check for expert_quant: the dequant-in-compute (or
+    # in-graph fake-quant) arithmetic must put int8 weight dtypes into
+    # the traced graph — a quant knob that changes nothing is dead
+    if "int8" not in g.dtype_names(on):
+        return ("expert_quant='int8' enabled but no int8 dtype in the "
+                "graph — the store is not reaching the expert FFN")
+    if "int8" in g.dtype_names(base):
+        return ("baseline (quant off) graph already carries int8 "
+                "dtypes — quantization is leaking outside the "
+                "expert_quant gate")
+    return None
+
+
 def _pred_chunked_a2a_count(base, on, ctx):
     from flashmoe_tpu.ops import wire as wr
 
@@ -186,6 +200,7 @@ _PREDICATES = {
     "no_extra_exchange": _pred_no_extra_exchange,
     "health_ops_added": _pred_health_ops_added,
     "chunked_a2a_count": _pred_chunked_a2a_count,
+    "quant_ops_present": _pred_quant_ops_present,
 }
 
 

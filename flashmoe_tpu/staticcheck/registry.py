@@ -161,6 +161,18 @@ KNOBS: tuple[KnobSpec, ...] = (
             "(ops/gate.py apply_replicas) — jnp.where-only, no "
             "collectives; off = bit-identical, replica-free graph"),
     KnobSpec(
+        "expert_quant", off_values=(None,), on={"expert_quant": "int8"},
+        on_rules=("quant_ops_present", "no_extra_exchange"),
+        doc="quantized expert weight storage & compute "
+            "(flashmoe_tpu/quant/): int8/e4m3 FFN weights with "
+            "per-output-channel f32 scales, dequantized in compute "
+            "(f32 accumulation untouched).  Off = no quant code runs "
+            "= bit-identical graph on every backend; on adds the "
+            "quantize/dequantize arithmetic (int8 dtypes appear in "
+            "the graph — the teeth check) but NEVER an exchange: "
+            "weights are rank-local, so compression of their storage "
+            "cannot touch a collective"),
+    KnobSpec(
         "gather_fused", off_values=(None, False), on={"gather_fused": True},
         backends=("local",), changes_graph=False,
         doc="inference kernel-entry selector; on the XLA oracle path "

@@ -102,6 +102,30 @@ def reference_points(gen: str = "v5e") -> dict[str, dict]:
         points[f"planner_predicted_ms[{name},d={GOLDEN_D},{gen}]"] = {
             "value": round(win.total_ms, 4), "unit": "ms",
         }
+        # quantized-store model points (ISSUE 15): the int8 winner's
+        # total and the fused[rowwin] weight-stream time — the terms
+        # the quant byte model owns, guarded by the sentry from day
+        # one so a pricing regression trips `observe --regression
+        # --ci` before any silicon measures it
+        qcfg = cfg.replace(expert_quant="int8")
+        qpreds = predict_paths(qcfg, GOLDEN_D, gen)
+        qwin = next((p for p in qpreds if p.feasible), None)
+        if qwin is not None:
+            points[f"planner_predicted_ms[{name},d={GOLDEN_D},{gen},"
+                   f"quant=int8]"] = {
+                "value": round(qwin.total_ms, 4), "unit": "ms",
+            }
+        rw = next((p for p in qpreds if p.path == "fused[rowwin]"),
+                  None)
+        if rw is not None:
+            from flashmoe_tpu.planner.model import _dtype_peak
+
+            _, hbm_bs = _dtype_peak(gen, qcfg)
+            points[f"quant_rowwin_weight_ms[{name},d={GOLDEN_D},{gen},"
+                   f"quant=int8]"] = {
+                "value": round(rw.cost.weight_bytes / hbm_bs * 1e3, 4),
+                "unit": "ms",
+            }
     return points
 
 

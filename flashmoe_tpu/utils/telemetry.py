@@ -106,6 +106,10 @@ DECISION_NAMES: dict[str, str] = {
     "serve.pools":
         "prefill/decode pool split over the inference-mode Decider "
         "(heterogeneous groups, no allreduce term)",
+    "serve.quant":
+        "the serving engine loaded a quantized expert state: store "
+        "dtype, freed HBM, and the extra KV-cache pages that headroom "
+        "buys (flashmoe_tpu/quant/)",
     "serve.retire":
         "a request completed (stop token or max length) with its "
         "TTFT/TPOT",

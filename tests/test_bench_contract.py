@@ -238,6 +238,76 @@ def test_cli_tiles_flag_exclusivity(monkeypatch, capsys):
         capsys.readouterr()
 
 
+def test_cli_quant_flag_exclusivity(monkeypatch, capsys):
+    """--quant fail-fasts on knobs/modes the store sweep would silently
+    ignore (ISSUE 15 satellite: refused with --ckpt/--overlap like the
+    other shape-changing flags)."""
+    import sys as _sys
+
+    import bench
+
+    cases = [
+        ["bench.py", "--quant", "--ckpt"],
+        ["bench.py", "--quant", "--overlap", "4"],
+        ["bench.py", "--quant", "--wire-dtype", "e4m3"],
+        ["bench.py", "--quant", "--a2a-chunks", "2"],
+        ["bench.py", "--quant", "--sweep", "ep"],
+        ["bench.py", "--quant", "--serve"],
+        ["bench.py", "--quant", "--profile"],
+        ["bench.py", "--quant", "--tiles"],
+        ["bench.py", "--quant", "--scaling"],
+        ["bench.py", "--quant", "--regression"],
+    ]
+    for argv in cases:
+        monkeypatch.setattr(_sys, "argv", argv)
+        with pytest.raises(SystemExit) as e:
+            bench.main()
+        assert e.value.code == 2, argv
+        capsys.readouterr()
+
+
+def test_cli_quant_emits_skipped_record_when_probe_hangs(monkeypatch,
+                                                         capsys):
+    """The --quant stage inherits the bench probe fail-fast contract:
+    a wedged tunnel yields ONE well-formed skipped:true record under
+    the QUANT metric and rc 0."""
+    import sys as _sys
+
+    import bench
+
+    monkeypatch.setattr(
+        bench, "_probe_backend_retry",
+        lambda budget_s, each_s=90, max_attempts=0:
+        (False, "backend probe hung >10s after 2 attempts / 20s", True))
+    monkeypatch.setattr(_sys, "argv",
+                        ["bench.py", "--quant", "--config", "mixtral",
+                         "--probe-attempts", "2"])
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "quant_ms[mixtral]"
+    assert rec["skipped"] is True and rec["value"] is None
+    assert "hung" in rec["reason"]
+
+
+def test_quant_fields_in_records():
+    """Every emitted record carries the quantized-store identity (the
+    wire-knob convention), and the modeled weight-bytes-saved fields
+    appear when the store is on."""
+    import bench
+    from flashmoe_tpu.config import BENCH_CONFIGS
+
+    off = bench._quant_fields(BENCH_CONFIGS["mixtral"])
+    assert off == {"expert_quant": "off"}
+    on = bench._quant_fields(
+        BENCH_CONFIGS["mixtral"].replace(expert_quant="int8"))
+    assert on["expert_quant"] == "int8"
+    assert on["quant_modeled_weight_saved_mb"] > 0
+    assert (on["quant_modeled_weight_mb"]
+            < on["quant_modeled_weight_saved_mb"] * 1.05)  # ~half
+
+
 def test_cli_tiles_emits_skipped_record_when_probe_hangs(monkeypatch,
                                                          capsys):
     """ISSUE 12 satellite: the --tiles stage inherits the bench probe

@@ -278,6 +278,34 @@ def test_degrade_masks_nan_expert_through_fp8_dcn_hop(devices):
 
 
 @pytest.mark.slow
+def test_degrade_masks_nan_expert_through_quantized_fp8_pipeline(
+        devices):
+    """Tier-0 masking through the quantized-expert + fp8-wire stack
+    (ISSUE 15 satellite, extending the PR 5/6 through-the-wire drill):
+    the serving build's full compression story — int8 expert weights
+    (pre-quantized state, dequant-in-compute) under e4m3 wires on both
+    legs.  The nan_expert injection poisons the quantized expert's
+    output at its owner, crosses the fp8 combine wire, and must still
+    trip the health mask; masking accounting stays exact (every rank
+    masks exactly its own exposure to the one armed expert)."""
+    from flashmoe_tpu import quant as qt
+    from flashmoe_tpu.parallel.ep import ep_moe_layer
+
+    cfg, mesh, params, x = _ep_setup(devices)
+    qs = qt.quantize_state(params, "int8")
+    wired = cfg.replace(expert_quant="int8", wire_dtype="e4m3",
+                        wire_dtype_combine="e4m3", collect_stats=True)
+    inject.arm("nan_expert", expert=1)
+    sick_off = ep_moe_layer(qs.params, x, wired, mesh)
+    assert not bool(np.isfinite(np.asarray(sick_off.out)).all())
+    on = wired.replace(degrade_unhealthy_experts=True)
+    sick_on = ep_moe_layer(qs.params, x, on, mesh)
+    assert bool(np.isfinite(np.asarray(sick_on.out)).all())
+    assert float(sick_on.stats.masked_experts) == 8.0
+    assert float(sick_on.stats.masked_fraction) > 0.0
+
+
+@pytest.mark.slow
 def test_degrade_ragged_ep_layer(devices):
     from flashmoe_tpu.parallel.ragged_ep import ragged_ep_moe_layer
 

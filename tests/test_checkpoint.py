@@ -200,6 +200,69 @@ def test_manifest_loader_state_roundtrip_and_legacy(tmp_path):
     assert ckpt.verify(d, 2)  # the extra manifest field breaks nothing
 
 
+def _quant_state(step: int):
+    from flashmoe_tpu import quant as qt
+    from flashmoe_tpu.models.reference import init_moe_params
+    from flashmoe_tpu.runtime.trainer import TrainState
+
+    cfg = MoEConfig(num_experts=4, expert_top_k=2, hidden_size=64,
+                    intermediate_size=128, dtype=jnp.float32,
+                    param_dtype=jnp.float32)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    qs = qt.quantize_state(params, "int8")
+    return TrainState(params={"moe": dict(qs.params)},
+                      opt_state={}, step=jnp.asarray(step, jnp.int32))
+
+
+def test_quant_manifest_block_and_backcompat(tmp_path):
+    """ISSUE 15 satellite: a pre-quant manifest (no `quant` block)
+    restores unchanged; a quantized save -> restore -> dequantize round
+    trip is bit-stable across the ASYNC save path; a tampered quant
+    block trips the CRC instead of silently mis-decoding payloads."""
+    import os
+
+    from flashmoe_tpu import quant as qt
+
+    d = str(tmp_path / "ck")
+    # pre-quant checkpoint: no quant block, restore untouched
+    ckpt.save(d, _tiny_state(1))
+    assert ckpt.load_quant_metadata(d, 1) is None
+    assert ckpt.verify(d, 1)
+
+    # quantized save through the ASYNC path: the manifest gains the
+    # CRC'd quant block automatically (derived from state.params)
+    state = _quant_state(2)
+    ckpt.save(d, state, step=2, blocking=False)
+    assert ckpt.wait_for_saves() == []
+    meta = ckpt.load_quant_metadata(d, 2)
+    assert meta is not None and meta["dtype"] == "int8"
+    assert qt.verify_quant_metadata(meta)
+    assert ckpt.verify(d, 2)
+
+    # restore -> dequantize bit-stable (int8 payloads + f32 scales ride
+    # orbax unchanged, so decode(restore(x)) == decode(x) exactly)
+    restored = ckpt.restore(d, _quant_state(9), step=2)
+    want = qt.dequantize_state(state.params["moe"])
+    got = qt.dequantize_state(restored.params["moe"])
+    for k in ("w_up", "w_down"):
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.asarray(want[k]))
+    assert np.asarray(restored.params["moe"]["w_up"]).dtype == np.int8
+
+    # tamper the quant block: the content CRC must trip
+    import json as _json
+
+    mpath = os.path.join(d, "manifest-2.json")
+    with open(mpath) as f:
+        manifest = _json.load(f)
+    manifest["quant"]["dtype"] = "e4m3"
+    with open(mpath, "w") as f:
+        _json.dump(manifest, f)
+    with pytest.raises(ckpt.CheckpointCorruptionError,
+                       match="quant metadata"):
+        ckpt.load_quant_metadata(d, 2)
+
+
 def test_has_guard_probe(tmp_path):
     from flashmoe_tpu.runtime.trainer import init_guard_state
 

@@ -96,8 +96,11 @@ def test_cli_all_json(capsys, devices):
     assert doc["ok"] and doc["violations"] == []
     assert set(doc["engines"]) == {"lint", "invariants", "census"}
     # 3 configs x (2 golden + 1 census-only dcn) wires x chunk variants
-    # x 3 paths (declared skips included)
-    assert len(doc["engines"]["census"]["rows"]) == 45
+    # x 3 paths (declared skips included) = 45, plus the
+    # quantized-store rows (ISSUE 15: 3 configs x 3 paths at
+    # wire-off/serial — expert weights are rank-local, so int8 storage
+    # must leave every collective untouched) = 54
+    assert len(doc["engines"]["census"]["rows"]) == 54
 
 
 def test_cli_exits_nonzero_on_violation(tmp_path):
