@@ -468,6 +468,24 @@ def _bench_serve(loads, *, requests: int, max_batch: int,
         _flush_observability(rec)
 
 
+def _bench_fabric(loads, *, requests: int, max_batch: int,
+                  telemetry_port: int | None = None):
+    """Disaggregated-fabric offered-load sweep (``--fabric``): the
+    :class:`~flashmoe_tpu.fabric.engine.ServingFabric` driven over
+    mocked 1/2/4-replica worlds (``FLASHMOE_MOCK_FABRIC``, set per
+    point and restored), one JSON record per (replica count, load
+    point) with throughput, TTFT/TPOT percentiles, KV-handoff count and
+    modeled DCN cost, and the router's placement histogram.  Host+CPU
+    like ``--serve``; identical procedure on real multi-host serving."""
+    from flashmoe_tpu.serving.loadgen import fabric_load_sweep
+
+    for rec in fabric_load_sweep(loads, n_requests=requests,
+                                 max_batch=max_batch,
+                                 telemetry_port=telemetry_port):
+        print(json.dumps(rec), flush=True)
+        _flush_observability(rec)
+
+
 def _bench_overlap(ep: int, trials: int, *, path: str | None = None,
                    wire_dtype: str | None = None,
                    wire_combine: str | None = None,
@@ -1189,6 +1207,13 @@ def main():
                          "continuous-batching engine (one record per "
                          "load point with tokens/sec + TTFT/TPOT "
                          "percentiles; see docs/SERVING.md)")
+    ap.add_argument("--fabric", action="store_true",
+                    help="offered-load sweep over mocked 1/2/4-replica "
+                         "disaggregated fabrics (FLASHMOE_MOCK_FABRIC "
+                         "+ the replica router + DCN-priced KV "
+                         "handoff): one record per (replicas, load) "
+                         "point (see docs/SERVING.md 'Disaggregated "
+                         "fabric')")
     ap.add_argument("--serve-loads", default="4,2,1",
                     help="comma-separated arrival gaps in engine "
                          "steps, lightest first (smaller = higher "
@@ -1256,9 +1281,10 @@ def main():
 
     # live-plane flag contracts (the --profile/--ckpt fail-fast rule:
     # refuse flags a mode would silently ignore)
-    if args.telemetry_port is not None and not args.serve:
-        ap.error("--telemetry-port applies with --serve only (the "
-                 "live scrape plane rides the serving sweep; the "
+    if args.telemetry_port is not None and not (args.serve
+                                                or args.fabric):
+        ap.error("--telemetry-port applies with --serve/--fabric only "
+                 "(the live scrape plane rides the serving sweeps; the "
                  "train CLIs take their own --telemetry-port)")
     if args.regression and (args.ckpt or args.overlap or args.sweep
                             or args.tiles or args.quant):
@@ -1275,6 +1301,8 @@ def main():
     headline_metric = (f"fused_tiles_ms[{args.config}]" if args.tiles
                        else f"quant_ms[{args.config}]" if args.quant
                        else "scaling_ms[slices]" if args.scaling
+                       else "fabric_tokens_per_sec[replicas]"
+                       if args.fabric
                        else f"moe_layer_fwd_ms[{args.config}]")
 
     def emit_error(msg, code=2):
@@ -1326,6 +1354,21 @@ def main():
         # other mode would silently ignore it
         ap.error("--wire-dcn applies to --scaling only (the other "
                  "modes run no cross-slice hop)")
+    if args.fabric:
+        # the --profile/--ckpt fail-fast contract: the fabric sweep
+        # drives its own CPU-sized drill model over its own mocked
+        # replica matrix — refuse every mode/knob it would silently
+        # ignore
+        if args.ckpt or args.overlap or args.profile \
+                or args.profile_quick or args.quant or args.serve \
+                or args.sweep or args.tiles or args.scaling:
+            ap.error("--fabric is its own mode; drop "
+                     "--ckpt/--overlap/--profile/--quant/--serve/"
+                     "--sweep/--tiles/--scaling")
+        if args.wire_dtype or args.wire_combine or args.a2a_chunks:
+            ap.error("--fabric drives the CPU-sized serving drill "
+                     "model; --wire-dtype/--wire-combine/--a2a-chunks "
+                     "do not apply")
     if args.quant:
         # the --profile/--ckpt fail-fast contract: the quant sweep pins
         # its own (store x path) matrix at ep=1 — refuse knobs/modes it
@@ -1371,6 +1414,30 @@ def main():
                        wire_combine=args.wire_combine,
                        wire_dcn=args.wire_dcn,
                        a2a_chunks=args.a2a_chunks)
+        _finish_regression()
+        return
+    if args.fabric:
+        if os.environ.get("FLASHMOE_OVERLAP_TPU") == "1":
+            # real-hardware runs inherit the probe fail-fast contract
+            # (same as --scaling): a wedged tunnel yields ONE
+            # well-formed skipped:true record and rc 0
+            ok, info, hung = _probe_backend_retry(
+                args.probe_budget, each_s=max(args.probe_timeout, 10),
+                max_attempts=args.probe_attempts)
+            if not ok:
+                if hung:
+                    print(json.dumps({
+                        "metric": headline_metric,
+                        "value": None, "unit": "tokens_per_sec",
+                        "vs_baseline": None,
+                        "skipped": True, "reason": info,
+                    }), flush=True)
+                    sys.exit(0)
+                emit_error(info)
+        if args.deadline > 0:
+            signal.alarm(args.deadline)  # host+CPU path: no probe leg
+        _bench_fabric([4, 2, 1], requests=8, max_batch=4,
+                      telemetry_port=args.telemetry_port)
         _finish_regression()
         return
     if args.tiles:

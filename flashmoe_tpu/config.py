@@ -158,6 +158,20 @@ class MoEConfig:
     # wire knobs (the fused RDMA kernel moves raw slabs).
     wire_dtype_dcn: str | None = None
 
+    # Wire dtype for the serving fabric's KV-page handoff
+    # (flashmoe_tpu/fabric/handoff.py): when prefill and decode run in
+    # separate pools, a finished prompt's KV run crosses DCN as whole
+    # pages — this knob compresses that payload with the same per-row
+    # codec as the a2a wires, one scale per (layer, page) block riding
+    # a `_qscale` sidecar.  HOST-SIDE only: the codec runs between the
+    # prefill jit and the decode-side page store, so no traced graph
+    # changes and no collective moves (census-proven; registered in
+    # staticcheck/registry.py with changes_graph=False).  Default None:
+    # OFF, handed-off pages are the prefill jit's own arrays untouched
+    # — a fabric drill is bit-equal to the single-pool engine
+    # (tests/test_fabric.py's acceptance drill).
+    kv_wire_dtype: str | None = None
+
     # Chunked double-buffered EP dispatch (Comet-style compute–
     # communication overlap, arXiv 2502.19811): split the [E, C, H]
     # exchange slab along the local-expert axis into this many chunks
@@ -330,7 +344,8 @@ class MoEConfig:
 
         for knob, val in (("wire_dtype", self.wire_dtype),
                           ("wire_dtype_combine", self.wire_dtype_combine),
-                          ("wire_dtype_dcn", self.wire_dtype_dcn)):
+                          ("wire_dtype_dcn", self.wire_dtype_dcn),
+                          ("kv_wire_dtype", self.kv_wire_dtype)):
             if val is None:
                 continue
             wd = _wire.resolve(val)  # ValueError on unknown/unsupported

@@ -1,0 +1,37 @@
+"""Disaggregated serving fabric (ISSUE 16).
+
+The single-engine serving stack (:mod:`flashmoe_tpu.serving`) decodes
+on one device pool and computes prefill inline between decode steps.
+This package is its production-scale composition: prefill and decode
+run on SEPARATE Decider-priced pools (:mod:`flashmoe_tpu.serving.
+pools`), finished prefill pages stream to the decode side through a
+DCN-priced KV handoff codec (:mod:`flashmoe_tpu.fabric.handoff`), a
+join-shortest-queue router with session affinity spreads requests over
+N engine replicas (:mod:`flashmoe_tpu.fabric.router`), and the whole
+thing is CI-able on a mocked topology (:mod:`flashmoe_tpu.fabric.topo`,
+``FLASHMOE_MOCK_FABRIC`` — the serving twin of PR 12's
+``FLASHMOE_MOCK_SLICES``).
+
+The composition rule that keeps the fabric bit-replayable: every
+replica is a full :class:`~flashmoe_tpu.serving.engine.ServingEngine`
+sharing the MODULE-LEVEL jitted step functions, and the handoff wire
+codec is exact when off — so a fabric drill with the handoff wire off
+produces token streams bit-equal to the single-pool engine on the same
+seeded trace (tests/test_fabric.py's acceptance drill).
+"""
+
+from flashmoe_tpu.fabric.engine import ServingFabric
+from flashmoe_tpu.fabric.handoff import (
+    KVHandoff, decode_kv_run, encode_kv_run,
+)
+from flashmoe_tpu.fabric.router import ReplicaRouter
+from flashmoe_tpu.fabric.topo import fabric_world
+
+__all__ = [
+    "KVHandoff",
+    "ReplicaRouter",
+    "ServingFabric",
+    "decode_kv_run",
+    "encode_kv_run",
+    "fabric_world",
+]

@@ -1,0 +1,258 @@
+"""The disaggregated serving fabric: N decode replicas behind a router,
+fed by a prefill pool across the KV handoff wire.
+
+One :class:`ServingFabric` composes the pieces the rest of the package
+provides:
+
+* **pools** — :func:`flashmoe_tpu.serving.pools.plan_serving_pools`
+  splits the device world into Decider-formed prefill and decode groups
+  (each with its own planner path and its own quant/wire config) when
+  the world has >= 2 devices; a single-device world runs co-located,
+  pool plan ``None``;
+* **replicas** — ``replicas`` full :class:`~flashmoe_tpu.serving.
+  engine.ServingEngine` instances (count from
+  :func:`~flashmoe_tpu.fabric.topo.fabric_world`, i.e. the
+  ``FLASHMOE_MOCK_FABRIC`` blocking on a mocked drill), sharing ONE
+  metrics object so ``/metrics`` aggregates the fabric and the
+  per-replica ``serve.rK.ttft_ms`` / ``.tpot_ms`` sketches split it;
+* **handoff** — every replica's prefill runs through one
+  :class:`~flashmoe_tpu.fabric.handoff.KVHandoff` (the engine's
+  ``prefill_fn`` seam): the prompt is computed with the prefill pool's
+  config and crosses to the replica as DCN-priced pages.  With
+  ``kv_wire_dtype=None`` the crossing is exact, which is what makes the
+  acceptance drill token-bit-equal to a single-pool engine;
+* **router** — :class:`~flashmoe_tpu.fabric.router.ReplicaRouter`
+  places each submitted request (JSQ + session affinity over the live
+  ``/healthz`` snapshots); the runtime controller's replica-morph
+  verdicts (:meth:`~flashmoe_tpu.runtime.controller.RuntimeController.
+  maybe_morph_replicas`) drain/undrain the rotation with the PR 9
+  debounce/cooldown/budget discipline.
+
+Determinism: replicas share the module-level jits, the router breaks
+ties on the lowest id, page pools are LIFO, and sampling keys on
+``fold_in(PRNGKey(req.seed), delivered)`` — so a fabric drill replays
+bit-identically and (wire off) matches the single-pool engine token for
+token regardless of how requests land on replicas.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from flashmoe_tpu.config import MoEConfig
+from flashmoe_tpu.fabric.handoff import KVHandoff
+from flashmoe_tpu.fabric.router import ReplicaRouter
+from flashmoe_tpu.fabric.topo import fabric_world
+from flashmoe_tpu.serving.engine import ServeConfig, ServingEngine
+from flashmoe_tpu.utils.telemetry import metrics as _global_metrics
+
+
+class ServingFabric:
+    """N-replica disaggregated serving driver.
+
+    ``replicas=None`` resolves the count from :func:`fabric_world`
+    (``FLASHMOE_MOCK_FABRIC`` on mocked drills, else 1).  ``serve``
+    applies to every replica.  ``prefill_overrides`` /
+    ``decode_overrides`` are per-pool ``MoEConfig.replace`` fields
+    forwarded to :func:`plan_serving_pools` — the decode replicas run
+    the decode pool's config (e.g. ``{"expert_quant": "int8"}`` loads
+    the PR 14 int8 store per replica), the handoff prefills with the
+    prefill pool's.  ``controller``: a
+    :class:`~flashmoe_tpu.runtime.controller.RuntimeController` whose
+    replica-morph trigger is armed (``enable_replica_morph=True``)
+    observes every fabric step and drains/undrains the rotation."""
+
+    def __init__(self, params, cfg: MoEConfig,
+                 serve: ServeConfig | None = None, *,
+                 replicas: int | None = None, decode_share: float = 0.5,
+                 prefill_overrides: dict | None = None,
+                 decode_overrides: dict | None = None,
+                 metrics_obj=None, controller=None, recorder=None,
+                 telemetry_port=None, affinity: bool = True):
+        self.cfg = cfg
+        self.serve = serve if serve is not None else ServeConfig()
+        self.metrics = (metrics_obj if metrics_obj is not None
+                        else _global_metrics)
+        self.controller = controller
+
+        devices = jax.devices()
+        if replicas is None:
+            replicas, _ = fabric_world(len(devices))
+        self.n_replicas = int(replicas)
+        if self.n_replicas < 1:
+            raise ValueError(f"fabric needs >= 1 replica, got "
+                             f"{self.n_replicas}")
+
+        # ---- pool formation (>= 2 devices; else co-located) ----------
+        self.pool_plan = None
+        prefill_cfg = decode_cfg = cfg
+        if len(devices) >= 2:
+            from flashmoe_tpu.parallel.topology import (
+                ici_adjacency, measured_worker_attrs,
+            )
+            from flashmoe_tpu.serving.pools import plan_serving_pools
+
+            self.pool_plan = plan_serving_pools(
+                ici_adjacency(devices),
+                measured_worker_attrs(devices, cfg, probe=False), cfg,
+                decode_share=decode_share,
+                decode_tokens=self.serve.max_batch, devices=devices,
+                prefill_overrides=prefill_overrides,
+                decode_overrides=decode_overrides)
+            prefill_cfg = self.pool_plan.prefill_cfg or cfg
+            decode_cfg = self.pool_plan.decode_cfg or cfg
+        elif prefill_overrides or decode_overrides:
+            prefill_cfg = (cfg.replace(**prefill_overrides)
+                           if prefill_overrides else cfg)
+            decode_cfg = (cfg.replace(**decode_overrides)
+                          if decode_overrides else cfg)
+        self.prefill_cfg = prefill_cfg
+        self.decode_cfg = decode_cfg
+
+        # ---- the handoff link (prefill pool side) --------------------
+        # prefill always computes full-precision math on the handed
+        # params (the engine-side quant store is a DECODE-pool
+        # property), so the handoff sees the same prefill the
+        # single-pool engine would run
+        self.handoff = KVHandoff(
+            params, prefill_cfg, self.serve.page_size,
+            metrics_obj=self.metrics,
+            decode_step_ms=(self.pool_plan.decode_ms
+                            if self.pool_plan is not None else None))
+
+        # ---- decode replicas -----------------------------------------
+        pools_info = (self.pool_plan.snapshot()
+                      if self.pool_plan is not None else None)
+        self.engines = [
+            ServingEngine(
+                params, decode_cfg, self.serve,
+                metrics_obj=self.metrics, recorder=recorder,
+                replica_tag=f"r{i}", prefill_fn=self.handoff.prefill_fn(i),
+                pools_info=pools_info)
+            for i in range(self.n_replicas)
+        ]
+        self.router = ReplicaRouter(
+            [e._health_snapshot for e in self.engines],
+            metrics_obj=self.metrics, affinity=affinity)
+        self._placement: dict = {}      # rid -> replica
+        self.step_idx = 0
+
+        self.telemetry = None
+        if telemetry_port is not None:
+            from flashmoe_tpu.telemetry_plane.server import maybe_server
+
+            self.telemetry = maybe_server(
+                telemetry_port, metrics_fn=lambda: self.metrics,
+                health_fn=self._health_snapshot,
+                vars_fn=self._vars_snapshot)
+
+    # ---- live-plane snapshots ----------------------------------------
+
+    def _health_snapshot(self) -> dict:
+        """Fabric ``/healthz``: the aggregate load story plus each
+        replica's own document."""
+        reps = [e._health_snapshot() for e in self.engines]
+        return {
+            "steps": self.step_idx,
+            "queue_depth": sum(r["queue_depth"] for r in reps),
+            "active_requests": sum(r["active_requests"] for r in reps),
+            "completed": sum(r["completed"] for r in reps),
+            "evictions": sum(r["evictions"] for r in reps),
+            "router": self.router.snapshot(),
+            "replicas": reps,
+        }
+
+    def _vars_snapshot(self) -> dict:
+        """Fabric ``/vars``: pool plan, handoff link, router rotation,
+        and every replica's resolved plans."""
+        return {
+            "replicas": self.n_replicas,
+            "pools": (self.pool_plan.snapshot()
+                      if self.pool_plan is not None else None),
+            "handoff": self.handoff.snapshot(),
+            "router": self.router.snapshot(),
+            "engines": [e._vars_snapshot() for e in self.engines],
+        }
+
+    def close(self) -> None:
+        if self.telemetry is not None:
+            self.telemetry.stop()
+            self.telemetry = None
+        for e in self.engines:
+            e.close()
+
+    # ---- submission / drive ------------------------------------------
+
+    def submit(self, req, arrival_step: int = 0, *,
+               session=None) -> int:
+        """Route ``req`` to a replica (JSQ + affinity) and enqueue it
+        there.  Returns the chosen replica id."""
+        choice = self.router.route(req.rid, session=session)
+        self.engines[choice].submit(req, arrival_step)
+        self._placement[req.rid] = choice
+        return choice
+
+    def pending(self) -> bool:
+        return any(e.pending() for e in self.engines)
+
+    def step(self) -> dict:
+        """One fabric iteration: every replica with pending work steps
+        once (decode steps overlap the handoff prefills its admissions
+        triggered), then the controller observes queue pressure and may
+        morph the rotation."""
+        recs = []
+        for e in self.engines:
+            if e.pending():
+                recs.append(e.step())
+        self.step_idx += 1
+        if self.controller is not None:
+            depths = [e._health_snapshot() for e in self.engines]
+            self.controller.observe_fabric(
+                self.step_idx,
+                [d["queue_depth"] + d["active_requests"]
+                 for d in depths])
+            act = self.controller.maybe_morph_replicas(
+                self.step_idx, draining=self.router.draining())
+            if act is not None:
+                if act.kind == "drain":
+                    self.router.drain(act.replica)
+                else:
+                    self.router.undrain(act.replica)
+        return {"kind": "fabric_step", "step": self.step_idx,
+                "replica_steps": len(recs),
+                "queue_depth": sum(len(e.queue) for e in self.engines),
+                "active": sum(len(e._active()) for e in self.engines)}
+
+    def run(self, requests=None, arrivals=None, *, sessions=None,
+            until=None) -> dict:
+        """Drive to completion; the fabric twin of
+        :meth:`ServingEngine.run`.  ``sessions``: optional per-request
+        affinity keys (parallel to ``requests``).  Returns the merged
+        ``{rid: tokens}`` across replicas."""
+        for idx, req in enumerate(requests or ()):
+            self.submit(req,
+                        int(arrivals[idx]) if arrivals else 0,
+                        session=sessions[idx] if sessions else None)
+        while self.pending() and not (until is not None and until()):
+            if self.step_idx >= self.serve.max_steps:
+                raise RuntimeError(
+                    f"fabric exceeded max_steps={self.serve.max_steps} "
+                    f"with work pending")
+            self.step()
+        out: dict = {}
+        for e in self.engines:
+            out.update(e.outputs)
+        return out
+
+    def summary(self) -> dict:
+        """Merged drill summary: per-replica engine summaries plus the
+        fabric's own counters."""
+        return {
+            "replicas": self.n_replicas,
+            "steps": self.step_idx,
+            "handoffs": self.handoff.count,
+            "handoff_bytes": self.handoff.bytes_moved,
+            "routed": list(self.router.routed),
+            "placement": dict(self._placement),
+            "engines": [e.summary() for e in self.engines],
+        }

@@ -467,6 +467,15 @@ def serving_report(records: list[dict]) -> dict:
     seen_req_recs = False
     plan = None
     quant = None
+    pools = None
+    route_counts: dict = {}
+    route_policies: dict = {}
+    route_draining: list = []
+    ho_n = 0
+    ho_kb = 0.0
+    ho_ms = 0.0
+    ho_overlapped = ho_verdicts = 0
+    ho_wire = None
     admissions = evictions = slo_ttft = slo_tpot = 0
     for r in records:
         kind, dec = r.get("kind"), r.get("decision")
@@ -497,6 +506,22 @@ def serving_report(records: list[dict]) -> dict:
             plan = r
         elif dec == "serve.quant":
             quant = r
+        elif dec == "serve.pools":
+            pools = r
+        elif dec == "fabric.route":
+            rep_id = r.get("replica")
+            route_counts[rep_id] = route_counts.get(rep_id, 0) + 1
+            pol = r.get("policy")
+            route_policies[pol] = route_policies.get(pol, 0) + 1
+            route_draining = r.get("draining") or []
+        elif dec == "fabric.handoff":
+            ho_n += 1
+            ho_kb += float(r.get("payload_kb", 0.0))
+            ho_ms += float(r.get("modeled_dcn_ms", 0.0))
+            if r.get("overlapped") is not None:
+                ho_verdicts += 1
+                ho_overlapped += int(bool(r.get("overlapped")))
+            ho_wire = r.get("wire", ho_wire)
         elif dec == "serve.admit":
             admissions += 1
         elif dec == "serve.evict":
@@ -547,6 +572,33 @@ def serving_report(records: list[dict]) -> dict:
                    "extra_kv_pages": quant.get("extra_kv_pages"),
                    "num_pages": quant.get("num_pages")}
                   if quant else None),
+        # disaggregated fabric: the Decider's prefill/decode pool split
+        # (serve.pools), where the router placed requests
+        # (fabric.route) and what the KV handoff link moved
+        # (fabric.handoff)
+        "pools": ({"prefill_devices": pools.get("prefill_devices"),
+                   "decode_devices": pools.get("decode_devices"),
+                   "prefill_ms": pools.get("prefill_ms"),
+                   "decode_ms": pools.get("decode_ms"),
+                   "prefill_mapping": pools.get("prefill_mapping"),
+                   "decode_mapping": pools.get("decode_mapping"),
+                   "decode_quant": pools.get("decode_quant"),
+                   "kv_wire": pools.get("kv_wire")}
+                  if pools else None),
+        "fabric_route": ({
+            "placements": {str(k): v for k, v
+                           in sorted(route_counts.items())},
+            "policies": dict(sorted(route_policies.items())),
+            "draining": route_draining,
+        } if route_counts else None),
+        "fabric_handoff": ({
+            "count": ho_n,
+            "payload_kb": round(ho_kb, 3),
+            "modeled_dcn_ms": round(ho_ms, 6),
+            "overlapped_frac": (round(ho_overlapped / ho_verdicts, 3)
+                                if ho_verdicts else None),
+            "wire": ho_wire,
+        } if ho_n else None),
     }
 
 
@@ -592,6 +644,37 @@ def render_serving_text(rep: dict) -> str:
             f"  quantized experts: {q['expert_quant']} freed "
             f"{q['freed_mb']} MB of weight HBM = +{q['extra_kv_pages']} "
             f"KV pages of headroom (pool {q['num_pages']})")
+    if rep.get("pools"):
+        p = rep["pools"]
+        det = ""
+        if p.get("prefill_mapping"):
+            det = (f"  [{p['prefill_mapping']} vs {p['decode_mapping']}"
+                   + (f", decode quant {p['decode_quant']}"
+                      if p.get("decode_quant") else "")
+                   + (f", kv wire {p['kv_wire']}"
+                      if p.get("kv_wire") else "") + "]")
+        lines.append(
+            f"  pools: prefill {len(p['prefill_devices'] or [])} dev "
+            f"({p['prefill_ms']} ms) / decode "
+            f"{len(p['decode_devices'] or [])} dev ({p['decode_ms']} ms)"
+            + det)
+    if rep.get("fabric_route"):
+        fr = rep["fabric_route"]
+        plc = " ".join(f"r{k}:{v}" for k, v in fr["placements"].items())
+        pol = " ".join(f"{k}={v}" for k, v in fr["policies"].items())
+        lines.append(f"  fabric router: {plc}  ({pol})"
+                     + (f"  draining={fr['draining']}"
+                        if fr.get("draining") else ""))
+    if rep.get("fabric_handoff"):
+        h = rep["fabric_handoff"]
+        lines.append(
+            f"  kv handoff: {h['count']} transfers, "
+            f"{h['payload_kb']} KB, modeled DCN {h['modeled_dcn_ms']} ms"
+            + (f", {h['overlapped_frac'] * 100:.0f}% hidden under "
+               f"decode" if h.get("overlapped_frac") is not None
+               else "")
+            + (f"  [wire {h['wire']}]"
+               if h.get("wire") not in (None, "off") else ""))
     if rep.get("slo_breaches"):
         b = rep["slo_breaches"]
         lines.append(f"  SLO breaches: ttft={b['ttft']} "

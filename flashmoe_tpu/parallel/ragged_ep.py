@@ -555,6 +555,27 @@ def _ragged_ep_shard(params, x, cfg: MoEConfig, *, axis: str,
     return MoEOutput(out.astype(cfg.dtype), aux, z, cnts, stats)
 
 
+def decode_moe_rows(params, x, cfg: MoEConfig, *, axis: str = "ep",
+                    exchange: str | None = None,
+                    block_m: int = BLOCK_M) -> MoEOutput:
+    """Run the ragged EP MoE on LOCAL batch rows from inside an
+    ENCLOSING ``shard_map`` — the serving engine's EP-sharded decode
+    step, where the caller already owns the mesh and this layer is one
+    stage of a larger sharded body (attention + paged KV around it).
+
+    ``params`` are the local expert shard (``gate_w`` replicated);
+    ``x``: ``[b_local, H]`` decode rows.  Decode batches are
+    token-count-tiny, so the XLA grouped path (no Pallas) is always the
+    right arm here, exactly as in the unsharded decode step."""
+    if cfg.num_shared_experts:
+        raise NotImplementedError("shared experts stay outside this layer")
+    if exchange is None:
+        exchange = "ragged" if jax.default_backend() == "tpu" else "dense"
+    return _ragged_ep_shard(
+        params, x, cfg, axis=axis, use_pallas=False, interpret=False,
+        exchange=exchange, block_m=block_m, reduce_axes=(axis,))
+
+
 def ragged_ep_moe_layer(params, x, cfg: MoEConfig, mesh: Mesh, *,
                         use_pallas: bool = False, interpret: bool = False,
                         exchange: str | None = None,

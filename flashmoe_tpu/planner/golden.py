@@ -63,6 +63,16 @@ GOLDEN_WIRE_DCN = "e4m3"
 # mixtral point and thereby closes (or flips) the recorded
 # rowwin-vs-collective margin.
 GOLDEN_QUANT = {"off": {}, "int8": {"expert_quant": "int8"}}
+# the disaggregated-fabric dimension (ISSUE 16,
+# MoEConfig.kv_wire_dtype): the modeled DCN cost of handing one
+# prefilled prompt's KV pages from the prefill pool to a decode
+# replica (planner.model.kv_handoff_ms over _DCN_SPEC), wire off vs
+# the fp8 page wire, next to the decode-priced per-step plan — frozen
+# so the overlap verdict (does a handoff hide under one decode step?)
+# is itself golden-gated (tests/test_fabric.py)
+GOLDEN_KV_WIRES = {"off": None, "e4m3": "e4m3"}
+GOLDEN_KV_PAGE = 16       # page_size the fabric dimension prices at
+GOLDEN_KV_PAGES = 8       # pages per handed-off prompt (128 tokens)
 
 _TERMS = ("compute_ms", "hbm_ms", "ici_ms", "dcn_ms", "total_ms")
 
@@ -153,12 +163,42 @@ def _quant_point(cfg, gen: str) -> dict:
     }
 
 
+def _fabric_point(cfg, gen: str) -> dict:
+    """One frozen fabric point: the decode-priced per-step plan plus
+    the modeled KV-handoff cost per wire (page MB at the wire row
+    size, DCN ms over ``_DCN_SPEC``) and the overlap verdict — whether
+    a whole prompt's page stream hides under one modeled decode step
+    (the Comet-style transfer/compute overlap the fabric records on
+    every ``fabric.handoff`` decision)."""
+    from flashmoe_tpu.planner.model import kv_handoff_ms, kv_page_mb
+
+    de = _predicted_plan(cfg, gen, "decode")
+    point = {"decode_plan": de, "wires": {}}
+    for tag, wire in GOLDEN_KV_WIRES.items():
+        mb = kv_page_mb(cfg, GOLDEN_KV_PAGE, wire=wire)
+        ms = kv_handoff_ms(cfg, GOLDEN_KV_PAGES, GOLDEN_KV_PAGE,
+                           wire=wire)
+        point["wires"][tag] = {
+            "page_mb": round(mb, 6),
+            "handoff_ms": round(ms, 6),
+            "overlapped": bool(ms <= de["total_ms"]),
+        }
+    point["fp8_saves"] = bool(
+        point["wires"]["e4m3"]["handoff_ms"]
+        < point["wires"]["off"]["handoff_ms"])
+    return point
+
+
 def golden_snapshot() -> dict:
     """Recompute the full golden structure from the live model."""
     from flashmoe_tpu.config import BENCH_CONFIGS
 
     out = {"d": GOLDEN_D, "configs": {}, "decode": {}, "slices": {},
-           "quant": {}}
+           "quant": {}, "fabric": {}}
+    for name in GOLDEN_CONFIGS:
+        cfg = BENCH_CONFIGS[name]
+        out["fabric"][name] = {gen: _fabric_point(cfg, gen)
+                               for gen in GOLDEN_GENS}
     for name in GOLDEN_CONFIGS:
         cfg = BENCH_CONFIGS[name]
         gens = {}

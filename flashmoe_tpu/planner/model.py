@@ -244,6 +244,39 @@ def dp_allreduce_ms(cfg: MoEConfig, dp: int, gen: str, *,
     return ring_allreduce_ms(grad_mb, dp, beta, lat_us / 1e3)
 
 
+def kv_page_mb(cfg: MoEConfig, page_size: int, *, wire=None) -> float:
+    """MB one KV page pair (K + V, all layers) weighs on the handoff
+    wire: ``2 x L x N_kv x page x D`` elements at the wire's row
+    itemsize, plus the per-(layer, page) f32 ``_qscale`` sidecars the
+    fp8 wires add (one per K row and one per V row — the fabric codec
+    quantizes each (layer, page) block as ONE wire row)."""
+    from flashmoe_tpu.ops import wire as wr
+
+    wire_dt = wr.resolve(wire) if isinstance(wire, str) else wire
+    nkv, dh = cfg.resolved_num_kv_heads, cfg.resolved_head_dim
+    row = nkv * int(page_size) * dh
+    per_layer = 2 * (wr.payload_row_bytes(wire_dt, row, cfg.dtype)
+                     + wr.scale_bytes(wire_dt))
+    return cfg.num_layers * per_layer / 1e6
+
+
+def kv_handoff_ms(cfg: MoEConfig, pages: int, page_size: int, *,
+                  wire=None) -> float:
+    """Modeled DCN time to stream one finished prefill's ``pages`` KV
+    pages from the prefill pool to a decode replica: one message (the
+    run ships as a unit) over the host NIC —
+    ``_DCN_SPEC`` alpha + bytes / DCN bandwidth, the same spec that
+    prices ``dp_allreduce_ms``'s DCN arm and the cross-slice a2a hop.
+    The fabric records this per handoff (``fabric.handoff``) and the
+    golden ``fabric`` dimension gates it against the decode-step
+    objective it must hide under."""
+    from flashmoe_tpu.parallel.topology import _DCN_SPEC
+
+    lat_us, gbps = _DCN_SPEC
+    mb = max(int(pages), 0) * kv_page_mb(cfg, page_size, wire=wire)
+    return lat_us / 1e3 + (mb / 1e3) / gbps * 1e3
+
+
 #: Default per-step decode token count priced when ``mode='decode'``
 #: and no explicit decode batch is given.  Decode steps move the decode
 #: BATCH through the layer (each token then fans out ``top_k`` exchange

@@ -100,6 +100,17 @@ class ControllerConfig:
     #                                slowest hop) dominates the step
     wire_morph_dtype: str = "e4m3"  # the DCN-hop wire the morph enables
     wire_morph_budget: int = 1
+    # --- replica-morph trigger (ISSUE 16: the fabric's rotation) ---
+    # armed only when a ServingFabric feeds observe_fabric(); the
+    # controller drains a replica when the fabric runs sustained-idle
+    # (mean per-replica queue+active below replica_queue_low) and
+    # returns a drained one when pressure is back
+    # (above replica_queue_high) — same debounce / cooldown / budget
+    # discipline as every other morph
+    enable_replica_morph: bool = False
+    replica_queue_high: float = 4.0   # mean per-replica depth above
+    replica_queue_low: float = 0.5    # ... and below => drain one
+    replica_morph_budget: int = 2
     # --- dynamics ---
     debounce_steps: int = 3        # consecutive triggering observations
     cooldown_steps: int = 8        # no action for N steps after one
@@ -126,6 +137,10 @@ class ControllerConfig:
             raise ValueError("slow_factor must be > 1")
         if not 0 < self.a2a_share_high < 1:
             raise ValueError("a2a_share_high must be in (0, 1)")
+        if self.replica_queue_low >= self.replica_queue_high:
+            raise ValueError(
+                "replica_queue_low must be < replica_queue_high (the "
+                "hysteresis band keeps drain/undrain from oscillating)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -159,6 +174,20 @@ class ReplaceAction:
     @property
     def needs_rebuild(self) -> bool:
         return bool(self.overrides)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaMorphAction:
+    """Fabric rotation morph: ``drain`` takes ``replica`` out of the
+    router's rotation (in-flight work keeps decoding), ``undrain``
+    returns it.  The fabric executes the verdict through
+    :meth:`~flashmoe_tpu.fabric.router.ReplicaRouter.drain` /
+    ``undrain``; the controller only decides."""
+
+    kind: str                      # 'drain' | 'undrain'
+    replica: int
+    trigger: str
+    reason: str
 
 
 def detected_slices() -> int:
@@ -315,11 +344,19 @@ class RuntimeController:
         self._skew_run = 0
         self._slow_run = 0
         self._a2a_run = 0
+        # fabric replica-morph signal (ISSUE 16): fed by
+        # observe_fabric(), never by the training loops
+        self.fab_queue_ema: float | None = None
+        self._last_fab_depth: float | None = None
+        self._fab_n = 0
+        self._fab_hi_run = 0
+        self._fab_lo_run = 0
         # --- persistent (manifest-riding) state ---
         self.overrides: dict = {}
         self.morphs_used = 0
         self.replaces_used = 0
         self.wire_morphs_used = 0
+        self.replica_morphs_used = 0
         self.cooldown_until = -1
         self.timeline: list[dict] = []
         self._cooldown_logged: set = set()
@@ -426,6 +463,87 @@ class RuntimeController:
                 and self._last_a2a_share > self.ccfg.a2a_share_high
                 and self._current_cfg().wire_dtype_dcn is None)
 
+    def observe_fabric(self, step: int, depths) -> None:
+        """Fold one fabric step's per-replica load (``queue_depth +
+        active_requests``, the router's own JSQ signal) into the
+        replica-morph trigger state.  Called by
+        :meth:`~flashmoe_tpu.fabric.engine.ServingFabric.step`; the
+        debounce counts CONSECUTIVE pressured (or idle) observations,
+        like every other trigger."""
+        depths = [float(d) for d in depths]
+        self._fab_n = len(depths)
+        mean = sum(depths) / len(depths) if depths else 0.0
+        self.fab_queue_ema = self._ema(self.fab_queue_ema, mean)
+        self._last_fab_depth = mean
+        c = self.ccfg
+        if mean > c.replica_queue_high:
+            self._fab_hi_run += 1
+        else:
+            self._fab_hi_run = 0
+        if mean < c.replica_queue_low:
+            self._fab_lo_run += 1
+        else:
+            self._fab_lo_run = 0
+
+    def maybe_morph_replicas(self, step: int, draining=()):
+        """The fabric's step-boundary decision: returns a
+        :class:`ReplicaMorphAction` or None.  Sustained pressure
+        returns the lowest-id DRAINED replica to the rotation
+        (capacity back first); sustained idleness drains the highest-id
+        replica still rotating (consolidate, never below one).  Same
+        cooldown window / budget / decision-record discipline as
+        :meth:`maybe_act` — and the same bit: a healthy fabric sees a
+        boringly inert controller."""
+        step = int(step)
+        c = self.ccfg
+        if not c.enable_replica_morph:
+            return None
+        hi = self._fab_hi_run >= c.debounce_steps
+        lo = self._fab_lo_run >= c.debounce_steps
+        if not (hi or lo):
+            return None
+        if step < self.cooldown_until:
+            key = ("replica", self.cooldown_until)
+            if key not in self._cooldown_logged:
+                self._cooldown_logged.add(key)
+                self._decide("controller.cooldown", step=step,
+                             trigger="replica",
+                             until=self.cooldown_until)
+            return None
+        if self.replica_morphs_used >= c.replica_morph_budget:
+            return None
+        draining = {int(d) for d in draining}
+        if hi:
+            if not draining:
+                return None        # full rotation already
+            target, kind, trig = min(draining), "undrain", "queue_high"
+            reason = (f"sustained queue pressure (mean depth "
+                      f"{self._last_fab_depth:.2f} > "
+                      f"{c.replica_queue_high}): return replica "
+                      f"{target} to the rotation")
+        else:
+            rotating = [i for i in range(self._fab_n)
+                        if i not in draining]
+            if len(rotating) <= 1:
+                return None        # never drain the last replica
+            target, kind, trig = max(rotating), "drain", "queue_low"
+            reason = (f"sustained idle fabric (mean depth "
+                      f"{self._last_fab_depth:.2f} < "
+                      f"{c.replica_queue_low}): drain replica "
+                      f"{target}")
+        self.replica_morphs_used += 1
+        self._cooldown(step)
+        self._decide(
+            "controller.replica_morph", step=step, trigger=trig,
+            kind=kind, replica=int(target),
+            queue_ema=(round(self.fab_queue_ema, 4)
+                       if self.fab_queue_ema is not None else None),
+            draining=sorted(draining), replicas=self._fab_n,
+            budget_left=(c.replica_morph_budget
+                         - self.replica_morphs_used),
+            reason=reason)
+        return ReplicaMorphAction(kind, int(target), trig, reason)
+
     def device_load_share(self, device: int) -> float:
         """Observed load share of one device's slot block under the
         CURRENT physical layout (slot s lives on device s // nLx) —
@@ -504,6 +622,8 @@ class RuntimeController:
         self._skew_run = 0
         self._slow_run = 0
         self._a2a_run = 0
+        self._fab_hi_run = 0
+        self._fab_lo_run = 0
         # a fresh baseline: the action changed what "normal" looks like
         self._baseline_seen = []
         self.baseline_ms = None
@@ -693,11 +813,15 @@ class RuntimeController:
                 "morph": c.morph_budget - self.morphs_used,
                 "replace": c.replace_budget - self.replaces_used,
                 "wire_morph": c.wire_morph_budget - self.wire_morphs_used,
+                "replica_morph": (c.replica_morph_budget
+                                  - self.replica_morphs_used),
             },
             "cooldown_until": self.cooldown_until,
             "trigger_runs": {"skew": self._skew_run,
                              "slow": self._slow_run,
-                             "a2a": self._a2a_run},
+                             "a2a": self._a2a_run,
+                             "replica_hi": self._fab_hi_run,
+                             "replica_lo": self._fab_lo_run},
             "overrides": {k: (list(map(list, v))
                               if k == "expert_replicas" else v)
                           for k, v in self.overrides.items()},
@@ -718,6 +842,7 @@ class RuntimeController:
                 "morphs_used": self.morphs_used,
                 "replaces_used": self.replaces_used,
                 "wire_morphs_used": self.wire_morphs_used,
+                "replica_morphs_used": self.replica_morphs_used,
                 "timeline": list(self.timeline)}
 
     def load_state_dict(self, sd: dict) -> None:
@@ -736,6 +861,9 @@ class RuntimeController:
                                  int(sd.get("replaces_used", 0)))
         self.wire_morphs_used = max(self.wire_morphs_used,
                                     int(sd.get("wire_morphs_used", 0)))
+        self.replica_morphs_used = max(
+            self.replica_morphs_used,
+            int(sd.get("replica_morphs_used", 0)))
         stored = list(sd.get("timeline") or [])
         if len(stored) > len(self.timeline):
             self.timeline = stored

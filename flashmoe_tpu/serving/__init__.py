@@ -17,8 +17,9 @@ subsystem on the INFERENCE half of the north star (ROADMAP item 1):
   the PR 8 watchdog.
 * :mod:`flashmoe_tpu.serving.pools` — prefill/decode pool formation as
   heterogeneous inference-mode Decider groups (the reference's
-  ``decider.cuh:177-268`` specialization; the stepping stone to
-  disaggregated serving, ROADMAP item 5).
+  ``decider.cuh:177-268`` specialization); :mod:`flashmoe_tpu.fabric`
+  composes these pools, a DCN-priced KV handoff, and a replica router
+  into the disaggregated serving fabric (ROADMAP item 5).
 
 CLI: ``python -m flashmoe_tpu.serving`` drives a seeded multi-request
 drill and prints a JSON summary; ``python -m flashmoe_tpu.observe
@@ -30,5 +31,9 @@ from flashmoe_tpu.serving.engine import (  # noqa: F401
     Request, ServeConfig, ServingEngine,
 )
 from flashmoe_tpu.serving.kvcache import (  # noqa: F401
-    PagedKVCache, PagePool, SCRATCH_PAGE, init_paged_cache,
+    PagedKVCache, PagePool, SCRATCH_PAGE, ShardedPagePool,
+    init_paged_cache,
+)
+from flashmoe_tpu.serving.pools import (  # noqa: F401
+    PoolPlan, plan_serving_pools,
 )

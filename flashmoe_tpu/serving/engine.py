@@ -60,8 +60,9 @@ from flashmoe_tpu.models.generate import (
 from flashmoe_tpu.models.transformer import rms_norm, _rope
 from flashmoe_tpu.ops.moe import moe_layer
 from flashmoe_tpu.serving.kvcache import (
-    SCRATCH_PAGE, PagePool, ctx_pages_bucket, gather_ctx,
-    init_paged_cache, prompt_pad, store_prefill, store_token,
+    SCRATCH_PAGE, PagePool, ShardedPagePool, ctx_pages_bucket,
+    gather_ctx, init_paged_cache, prompt_pad, store_prefill,
+    store_token,
 )
 from flashmoe_tpu.utils.telemetry import metrics as _global_metrics
 from flashmoe_tpu.utils.telemetry import trace_span
@@ -101,7 +102,15 @@ class ServeConfig:
     ``num_pages`` includes the reserved scratch page; ``prompt_bucket``
     must be a multiple of ``page_size`` (prefilled pages are written
     whole); ``ctx_bucket_pages`` is the decode-gather granularity —
-    the bucketed-length jit policy's bucket."""
+    the bucketed-length jit policy's bucket.
+
+    ``prefill_chunk`` (tokens, a multiple of ``page_size``) bounds the
+    per-step prefill budget: a prompt longer than one chunk is admitted
+    in fixed-size slices, one slice per engine step, so a 32k-token
+    prompt cannot hole a decode step.  ``ep_shards`` > 1 runs the
+    decode step EP-sharded under ``shard_map`` on an ``("ep",)`` mesh
+    with the paged KV slab partitioned alongside the experts (the
+    fabric's decode-pool execution path)."""
 
     max_batch: int = 8
     page_size: int = 8
@@ -111,6 +120,8 @@ class ServeConfig:
     prompt_bucket: int = 8
     pad_token: int = 0
     max_steps: int = 10_000
+    prefill_chunk: int | None = None
+    ep_shards: int = 1
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -129,6 +140,31 @@ class ServeConfig:
                 f"prompt_bucket={self.prompt_bucket} must be a "
                 f"positive multiple of page_size={self.page_size} "
                 f"(prefill writes whole pages)")
+        if self.prefill_chunk is not None and (
+                self.prefill_chunk < self.page_size
+                or self.prefill_chunk % self.page_size):
+            raise ValueError(
+                f"prefill_chunk={self.prefill_chunk} must be a "
+                f"positive multiple of page_size={self.page_size} "
+                f"(chunks write whole pages)")
+        if self.ep_shards < 1:
+            raise ValueError("ep_shards must be >= 1")
+        if self.ep_shards > 1:
+            if self.max_batch % self.ep_shards:
+                raise ValueError(
+                    f"ep_shards={self.ep_shards} must divide "
+                    f"max_batch={self.max_batch} (the slot grid is "
+                    f"row-partitioned across shards)")
+            if self.num_pages % self.ep_shards:
+                raise ValueError(
+                    f"ep_shards={self.ep_shards} must divide "
+                    f"num_pages={self.num_pages} (the page slab is "
+                    f"partitioned across shards)")
+            if self.num_pages // self.ep_shards < 2:
+                raise ValueError(
+                    f"num_pages={self.num_pages} leaves fewer than 2 "
+                    f"pages per shard at ep_shards={self.ep_shards} "
+                    f"(each shard reserves its own scratch page)")
 
     @property
     def max_context(self) -> int:
@@ -163,6 +199,9 @@ class _Slot:
     admit_step: int
     arrival_s: float               # wall clock at trace arrival
     first_token_s: float | None
+    prefill_pos: int | None = None  # next chunk start (chunked prefill
+                                    # in flight); None = decoding
+    prefill_toks: object = None     # padded np prompt for the chunks
 
 
 # ----------------------------------------------------------------------
@@ -182,6 +221,78 @@ def _prefill_padded(params, cfg: MoEConfig, prompt_padded, true_len):
         x, (0, true_len - 1, 0), (1, 1, x.shape[-1]))
     logits = lm_logits(params, cfg, h)[0]                    # [V]
     return logits, cache.k[:, 0], cache.v[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _prefill_chunk(params, cfg: MoEConfig, k_pages, v_pages, chunk_toks,
+                   block_table, chunk_page_ids, start_pos, rel_last):
+    """Prefill ONE fixed-size chunk of a long prompt directly into the
+    paged cache.
+
+    chunk_toks: [1, C] int32 (C = ``ServeConfig.prefill_chunk``);
+    block_table: [n] page ids covering positions [0, start_pos + C)
+    (bucketed, scratch-padded); chunk_page_ids: [C / page] the pages
+    THIS chunk writes; start_pos: absolute position of the chunk's
+    first token; rel_last: in-chunk index of the prompt's true last
+    token (clipped — only the chunk containing it keeps the logits).
+    Returns (logits [V], k_pages, v_pages).
+
+    Per-layer math mirrors :func:`_prefill_padded`'s single-shot path
+    at chunk granularity: the chunk's K/V land in their pages BEFORE
+    the gather, so in-chunk causal attention sees them through the
+    same paged read decode uses.  Positions past the true prompt end
+    write garbage rows that decode overwrites before any causal query
+    exposes them — the whole-prefill invariant, per chunk."""
+    c = chunk_toks.shape[1]
+    nh, nkv, dh = (cfg.num_heads, cfg.resolved_num_kv_heads,
+                   cfg.resolved_head_dim)
+    page = k_pages.shape[3]
+    n_ctx = block_table.shape[0] * page
+    n_c = c // page
+    positions = start_pos + jnp.arange(c, dtype=jnp.int32)   # [C]
+    x = params["embed"].astype(cfg.dtype)[chunk_toks]        # [1, C, H]
+    for li, layer in enumerate(params["layers"]):
+        h_in = rms_norm(x, layer["attn_norm"])
+        q = (h_in @ layer["wq"].astype(x.dtype)).reshape(1, c, nh, dh)
+        k = (h_in @ layer["wk"].astype(x.dtype)).reshape(1, c, nkv, dh)
+        v = (h_in @ layer["wv"].astype(x.dtype)).reshape(1, c, nkv, dh)
+        q, k = _rope(q, k, positions[None, :], cfg.rope_theta)
+
+        kc = k[0].reshape(n_c, page, nkv, dh).transpose(0, 2, 1, 3)
+        vc = v[0].reshape(n_c, page, nkv, dh).transpose(0, 2, 1, 3)
+        k_pages = k_pages.at[li, chunk_page_ids].set(
+            kc.astype(k_pages.dtype))
+        v_pages = v_pages.at[li, chunk_page_ids].set(
+            vc.astype(v_pages.dtype))
+
+        kk = gather_ctx(k_pages[li], block_table[None, :])
+        vv = gather_ctx(v_pages[li], block_table[None, :])
+        if nkv != nh:
+            rep = nh // nkv
+            kk = jnp.repeat(kk, rep, axis=1)
+            vv = jnp.repeat(vv, rep, axis=1)
+        qh = q.transpose(0, 2, 1, 3)                # [1, N, C, D]
+        logits = jnp.einsum(
+            "bntd,bnsd->bnts", qh, kk, preferred_element_type=jnp.float32
+        ) * (dh ** -0.5)
+        mask = (jnp.arange(n_ctx, dtype=jnp.int32)[None, :]
+                <= positions[:, None])[None, None, :, :]
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        ctx = jnp.einsum(
+            "bnts,bnsd->bntd", probs, vv, preferred_element_type=jnp.float32
+        ).transpose(0, 2, 1, 3).reshape(1, c, nh * dh).astype(x.dtype)
+        x = x + ctx @ layer["wo"].astype(x.dtype)
+
+        f_in = rms_norm(x, layer["ffn_norm"])
+        layer_cfg = cfg if li in cfg.moe_layer_indices else cfg.replace(
+            num_experts=1, expert_top_k=1, num_shared_experts=0)
+        o = moe_layer(layer["moe"], f_in.reshape(c, -1), layer_cfg,
+                      use_pallas=False)
+        x = x + o.out.reshape(1, c, -1).astype(x.dtype)
+
+    h = jax.lax.dynamic_slice(x, (0, rel_last, 0), (1, 1, x.shape[-1]))
+    return lm_logits(params, cfg, h)[0], k_pages, v_pages
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -245,6 +356,131 @@ def _paged_decode_step(params, cfg: MoEConfig, k_pages, v_pages, toks,
     return lm_logits(params, cfg, x), k_pages, v_pages
 
 
+# ----------------------------------------------------------------------
+# EP-sharded decode (the fabric's decode-pool execution path)
+# ----------------------------------------------------------------------
+
+_EP_DECODE_CACHE: dict = {}
+
+
+def _ep_param_specs(params, cfg: MoEConfig):
+    """Partition specs for the EP decode step: expert-axis leaves of
+    every MoE layer shard along ``"ep"`` (the ``_qscale`` sidecars
+    included — their leading axis is the expert axis too); everything
+    else (attention, norms, embed/head, the replicated router
+    ``gate_w``, dense layers' single-expert FFNs) replicates."""
+    from jax.sharding import PartitionSpec as P
+    from jax.tree_util import DictKey, tree_map_with_path
+
+    def spec(path, leaf):
+        names = [p.key for p in path if isinstance(p, DictKey)]
+        if ("moe" in names and (not names or names[-1] != "gate_w")
+                and getattr(leaf, "ndim", 0) >= 1
+                and leaf.shape[0] == cfg.num_experts):
+            return P("ep")
+        return P()
+
+    return tree_map_with_path(spec, params)
+
+
+def _ep_decode_fn(mesh, cfg: MoEConfig, params):
+    """Build (and cache per (mesh, cfg, param-structure)) the
+    EP-sharded twin of :func:`_paged_decode_step`: one jitted
+    ``shard_map`` whose body runs the same per-layer arithmetic on the
+    LOCAL slot rows and the LOCAL slab of the paged KV cache, with MoE
+    layers dispatched through the decode-priced ragged EP path
+    (:func:`flashmoe_tpu.parallel.ragged_ep.decode_moe_rows`) — the
+    plan ``serve.plan`` resolves in decode mode is what actually
+    executes here.  Block tables carry per-SHARD-local page ids."""
+    import jax.tree_util as jtu
+    from jax.sharding import PartitionSpec as P
+
+    from flashmoe_tpu.utils.compat import shard_map
+
+    key = (mesh, cfg, jtu.tree_structure(params))
+    cached = _EP_DECODE_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    from flashmoe_tpu.parallel import ragged_ep
+
+    pspecs = _ep_param_specs(params, cfg)
+    exchange = "ragged" if jax.default_backend() == "tpu" else "dense"
+
+    def body(params, k_pages, v_pages, toks, block_tables, positions):
+        # LOCAL view: max_batch/d slot rows, num_pages/d slab pages.
+        # Attention mirrors _paged_decode_step (kept duplicated so the
+        # unsharded path stays byte-identical to its pre-fabric form);
+        # only the MoE FFN differs.
+        b = toks.shape[0]
+        nh, nkv, dh = (cfg.num_heads, cfg.resolved_num_kv_heads,
+                       cfg.resolved_head_dim)
+        page = k_pages.shape[3]
+        n_ctx = block_tables.shape[1] * page
+        x = params["embed"].astype(cfg.dtype)[toks][:, None, :]
+        page_ids = jnp.take_along_axis(
+            block_tables, (positions // page)[:, None], axis=1)[:, 0]
+        rows = positions % page
+        for li, layer in enumerate(params["layers"]):
+            h_in = rms_norm(x, layer["attn_norm"])
+            q = (h_in @ layer["wq"].astype(x.dtype)).reshape(b, 1, nh,
+                                                             dh)
+            k = (h_in @ layer["wk"].astype(x.dtype)).reshape(b, 1, nkv,
+                                                             dh)
+            v = (h_in @ layer["wv"].astype(x.dtype)).reshape(b, 1, nkv,
+                                                             dh)
+            q, k = _rope(q, k, positions[:, None], cfg.rope_theta)
+
+            k_pages = k_pages.at[li].set(
+                store_token(k_pages[li], k[:, 0], page_ids, rows))
+            v_pages = v_pages.at[li].set(
+                store_token(v_pages[li], v[:, 0], page_ids, rows))
+
+            kk = gather_ctx(k_pages[li], block_tables)
+            vv = gather_ctx(v_pages[li], block_tables)
+            if nkv != nh:
+                rep = nh // nkv
+                kk = jnp.repeat(kk, rep, axis=1)
+                vv = jnp.repeat(vv, rep, axis=1)
+            qh = q.transpose(0, 2, 1, 3)
+            logits = jnp.einsum(
+                "bntd,bnsd->bnts", qh, kk,
+                preferred_element_type=jnp.float32) * (dh ** -0.5)
+            mask = (jnp.arange(n_ctx)[None, :]
+                    <= positions[:, None])[:, None, None, :]
+            logits = jnp.where(mask, logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+            ctx = jnp.einsum(
+                "bnts,bnsd->bntd", probs, vv,
+                preferred_element_type=jnp.float32
+            ).transpose(0, 2, 1, 3).reshape(b, 1, nh * dh).astype(
+                x.dtype)
+            x = x + ctx @ layer["wo"].astype(x.dtype)
+
+            f_in = rms_norm(x, layer["ffn_norm"])
+            if li in cfg.moe_layer_indices:
+                o_out = ragged_ep.decode_moe_rows(
+                    layer["moe"], f_in.reshape(b, -1), cfg,
+                    axis="ep", exchange=exchange).out
+            else:
+                dense_cfg = cfg.replace(num_experts=1, expert_top_k=1,
+                                        num_shared_experts=0)
+                o_out = moe_layer(layer["moe"], f_in.reshape(b, -1),
+                                  dense_cfg, use_pallas=False).out
+            x = x + o_out.reshape(b, 1, -1).astype(x.dtype)
+
+        return lm_logits(params, cfg, x), k_pages, v_pages
+
+    fn = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(pspecs, P(None, "ep"), P(None, "ep"), P("ep"),
+                  P("ep", None), P("ep")),
+        out_specs=(P("ep"), P(None, "ep"), P(None, "ep")),
+        check_vma=False))
+    _EP_DECODE_CACHE[key] = fn
+    return fn
+
+
 @jax.jit
 def _sample_dynamic(logits, keys, temps, top_ks, top_ps):
     """Per-slot sampling with DYNAMIC per-request knobs (the engine's
@@ -288,7 +524,17 @@ class ServingEngine:
     def __init__(self, params, cfg: MoEConfig,
                  serve: ServeConfig | None = None, *,
                  recorder=None, slo=None, mesh=None, metrics_obj=None,
-                 tracer=None, telemetry_port=None):
+                 tracer=None, telemetry_port=None, prefill_fn=None,
+                 replica_tag=None, pools_info=None):
+        """``prefill_fn(prompt_padded, true_len, *, rid)`` replaces the
+        local prefill when set — the fabric's KV-handoff seam: the
+        callable must honor :func:`_prefill_padded`'s contract
+        (logits [V], k_seq/v_seq [L, N_kv, T_pad, D]).  A handed-off
+        prefill is always whole (``prefill_chunk`` applies to the LOCAL
+        path only — in a disaggregated fabric long prompts cannot hole
+        decode by construction).  ``replica_tag`` (e.g. ``"r0"``)
+        additionally keys this engine's TTFT/TPOT sketches per replica;
+        ``pools_info`` is surfaced verbatim in ``/vars``."""
         if cfg.drop_tokens:
             raise ValueError(
                 "the serving engine requires a dropless config "
@@ -299,6 +545,9 @@ class ServingEngine:
         self.cfg = cfg
         self.serve = serve if serve is not None else ServeConfig()
         self.mesh = mesh
+        self._prefill_fn = prefill_fn
+        self.replica_tag = replica_tag
+        self.pools_info = pools_info
         self.recorder = recorder
         self.metrics = metrics_obj if metrics_obj is not None \
             else _global_metrics
@@ -352,9 +601,38 @@ class ServingEngine:
                                                     cfg.param_dtype),
             }
 
+        # ---- EP-sharded decode (fabric decode-pool path) -------------
+        self._ep_fn = None
+        d = self.serve.ep_shards
+        if d > 1:
+            if cfg.num_experts % d:
+                raise ValueError(
+                    f"ep_shards={d} must divide num_experts="
+                    f"{cfg.num_experts} (every shard holds the same "
+                    f"local expert count)")
+            if cfg.num_shared_experts:
+                raise ValueError(
+                    "EP-sharded decode requires num_shared_experts=0 "
+                    "(the ragged EP path has no shared-expert arm)")
+            if self.mesh is None:
+                devs = jax.devices()
+                if len(devs) < d:
+                    raise ValueError(
+                        f"ep_shards={d} needs {d} devices, have "
+                        f"{len(devs)}")
+                self.mesh = jax.sharding.Mesh(
+                    np.asarray(devs[:d]), ("ep",))
+            elif ("ep" not in self.mesh.axis_names
+                  or self.mesh.shape["ep"] != d):
+                raise ValueError(
+                    f"ep_shards={d} needs an 'ep' mesh axis of size "
+                    f"{d}, got mesh axes {dict(self.mesh.shape)}")
+            self._ep_fn = _ep_decode_fn(self.mesh, cfg, params)
+
         self.cache = init_paged_cache(cfg, self.serve.num_pages,
                                       self.serve.page_size)
-        self.pool = PagePool(self.serve.num_pages)
+        self.pool = (ShardedPagePool(self.serve.num_pages, d) if d > 1
+                     else PagePool(self.serve.num_pages))
         if self.quant_info is not None:
             page_bytes = (self.cache.k_pages.nbytes
                           + self.cache.v_pages.nbytes
@@ -422,6 +700,8 @@ class ServingEngine:
             "completed": self.stats["completed"],
             "evictions": self.stats["evictions"],
         }
+        if self.replica_tag is not None:
+            doc["replica"] = self.replica_tag
         if self.watchdog is not None:
             doc["slo"] = self.watchdog.snapshot()
         return doc
@@ -445,10 +725,13 @@ class ServingEngine:
                 "wire_dtype": cfg.wire_dtype,
                 "a2a_chunks": cfg.a2a_chunks,
                 "expert_quant": cfg.expert_quant,
+                "kv_wire_dtype": cfg.kv_wire_dtype,
                 "ep": cfg.ep,
             },
             "quant": self.quant_info,
             "tracing": self.tracer is not None,
+            "replica": self.replica_tag,
+            "pools": self.pools_info,
         }
 
     def close(self) -> None:
@@ -478,11 +761,14 @@ class ServingEngine:
         # serve would otherwise park at the queue head and spin the
         # engine through max_steps empty iterations
         need_pages = need // self.serve.page_size
-        if need_pages > self.serve.num_pages - 1:
+        allocatable = (self.serve.num_pages // self.serve.ep_shards) - 1
+        if need_pages > allocatable:
             raise ValueError(
                 f"request {req.rid}: lifetime needs {need_pages} pages "
-                f"but the pool only holds {self.serve.num_pages - 1} "
-                f"allocatable pages")
+                f"but the pool only holds {allocatable} "
+                f"allocatable pages"
+                + (f" per shard (ep_shards={self.serve.ep_shards})"
+                   if self.serve.ep_shards > 1 else ""))
         self.queue.append(_QueueEntry(int(arrival_step), req, req,
                                       None, None))
         self.stats["submitted"] += 1
@@ -491,6 +777,36 @@ class ServingEngine:
 
     def _active(self) -> list:
         return [i for i, s in enumerate(self.slots) if s is not None]
+
+    def _decoding(self) -> list:
+        """Occupied slots whose prefill has completed (the rows the
+        sampler and the decode step actually advance)."""
+        return [i for i, s in enumerate(self.slots)
+                if s is not None and s.prefill_pos is None]
+
+    # ---- shard-aware page accounting (ep_shards == 1: pass-through,
+    # slots hold GLOBAL page ids; sharded: each slot belongs to the
+    # shard owning its row block and holds shard-LOCAL ids, converted
+    # to global only at the eager whole-page write sites) -------------
+
+    def _shard_of(self, slot: int) -> int:
+        return slot // (self.serve.max_batch // self.serve.ep_shards)
+
+    def _alloc_pages(self, slot: int, n: int):
+        if self.serve.ep_shards > 1:
+            return self.pool.alloc(n, self._shard_of(slot))
+        return self.pool.alloc(n)
+
+    def _free_slot_pages(self, slot: int, pages) -> None:
+        if self.serve.ep_shards > 1:
+            self.pool.free(pages, self._shard_of(slot))
+        else:
+            self.pool.free(pages)
+
+    def _global_pages(self, slot: int, pages):
+        if self.serve.ep_shards > 1:
+            return self.pool.to_global(pages, self._shard_of(slot))
+        return pages
 
     def _arrived_head(self) -> bool:
         return bool(self.queue) \
@@ -508,61 +824,173 @@ class ServingEngine:
                 if self.tracer is not None:
                     self.tracer.on_arrival(entry.orig.rid)
 
+    def _shard_free_pages(self, slot: int) -> int:
+        if self.serve.ep_shards > 1:
+            return self.pool.shard_free_pages(self._shard_of(slot))
+        return self.pool.free_pages
+
     def _admit(self) -> None:
+        sv = self.serve
         while self._arrived_head() and None in self.slots:
             entry = self.queue[0]
             req, orig = entry.req, entry.orig
             t0 = len(req.prompt)
-            t_pad = prompt_pad(t0, self.serve.prompt_bucket)
-            n_pages = t_pad // self.serve.page_size
-            pages = self.pool.alloc(n_pages)
-            if pages is None:
+            t_pad = prompt_pad(t0, sv.prompt_bucket)
+            chunk = sv.prefill_chunk
+            # a handed-off prefill is always whole: the fabric's
+            # prefill pool absorbs the long prompt, so chunking (the
+            # single-engine mitigation) only applies to the local path
+            chunked = (chunk is not None and t_pad > chunk
+                       and self._prefill_fn is None)
+            n_pages = (chunk if chunked else t_pad) // sv.page_size
+            # first free slot whose shard can hold the pages (LIFO
+            # alloc never partially succeeds, so free_pages >= n is
+            # exactly alloc-would-succeed — the unsharded order is the
+            # pre-fabric alloc-then-first-free-slot order)
+            slot = None
+            for i, s in enumerate(self.slots):
+                if s is None and self._shard_free_pages(i) >= n_pages:
+                    slot = i
+                    break
+            if slot is None:
                 break                      # head-of-line: deterministic
+            pages = self._alloc_pages(slot, n_pages)
             self.queue.popleft()
-            slot = self.slots.index(None)
-            prompt = jnp.asarray(req.prompt, jnp.int32)[None, :]
-            if t_pad > t0:
-                prompt = jnp.pad(prompt, ((0, 0), (0, t_pad - t0)),
-                                 constant_values=self.serve.pad_token)
             if self.tracer is not None:
                 # closes the queued span and arms prefill attribution
                 # for the trace_span below
                 self.tracer.on_admit(orig.rid, self.step_idx,
                                      resumed=req is not orig)
-            with trace_span("serve.prefill"):
-                logits, k_seq, v_seq = _prefill_padded(
-                    self.params, self.cfg, prompt, jnp.int32(t0))
-                page_ids = jnp.asarray(pages, jnp.int32)
-                self.cache = self.cache._replace(
-                    k_pages=store_prefill(self.cache.k_pages, k_seq,
-                                          page_ids),
-                    v_pages=store_prefill(self.cache.v_pages, v_seq,
-                                          page_ids))
-            self._logits = self._logits.at[slot].set(logits)
-            self.slots[slot] = _Slot(
-                req=req, orig=orig, pages=list(pages), length=t0,
-                emitted=[], admit_step=self.step_idx,
-                arrival_s=entry.arrival_s,
-                first_token_s=entry.first_token_s)
-            self.stats["prefill_buckets"].add(t_pad)
+            if chunked:
+                # pad out to whole chunks; trailing all-pad chunks past
+                # the true end are never run (_advance_prefill stops at
+                # the chunk holding the prompt's last token)
+                t_pad_c = ((t_pad + chunk - 1) // chunk) * chunk
+                toks = np.full((t_pad_c,), sv.pad_token, np.int32)
+                toks[:t0] = req.prompt
+                self.slots[slot] = _Slot(
+                    req=req, orig=orig, pages=list(pages), length=0,
+                    emitted=[], admit_step=self.step_idx,
+                    arrival_s=entry.arrival_s,
+                    first_token_s=entry.first_token_s,
+                    prefill_pos=0, prefill_toks=toks)
+                self.stats["prefill_buckets"].add(chunk)
+            else:
+                prompt = jnp.asarray(req.prompt, jnp.int32)[None, :]
+                if t_pad > t0:
+                    prompt = jnp.pad(
+                        prompt, ((0, 0), (0, t_pad - t0)),
+                        constant_values=sv.pad_token)
+                with trace_span("serve.prefill"):
+                    if self._prefill_fn is not None:
+                        logits, k_seq, v_seq = self._prefill_fn(
+                            prompt, t0, rid=orig.rid)
+                    else:
+                        logits, k_seq, v_seq = _prefill_padded(
+                            self.params, self.cfg, prompt,
+                            jnp.int32(t0))
+                    page_ids = jnp.asarray(
+                        self._global_pages(slot, pages), jnp.int32)
+                    self.cache = self.cache._replace(
+                        k_pages=store_prefill(self.cache.k_pages,
+                                              k_seq, page_ids),
+                        v_pages=store_prefill(self.cache.v_pages,
+                                              v_seq, page_ids))
+                self._logits = self._logits.at[slot].set(logits)
+                self.slots[slot] = _Slot(
+                    req=req, orig=orig, pages=list(pages), length=t0,
+                    emitted=[], admit_step=self.step_idx,
+                    arrival_s=entry.arrival_s,
+                    first_token_s=entry.first_token_s)
+                self.stats["prefill_buckets"].add(t_pad)
             self._rates["admits"].add()
             self.metrics.decision(
                 "serve.admit", rid=orig.rid, step=self.step_idx,
                 slot=slot, prompt_tokens=t0, pages=n_pages,
-                resumed=req is not orig,
+                resumed=req is not orig, chunked=chunked,
                 queue_depth=len(self.queue))
 
-    def _evict_youngest(self) -> bool:
+    def _advance_prefill(self) -> None:
+        """Advance every mid-prefill slot by exactly ONE fixed-size
+        chunk (slot order — deterministic): the per-step prefill budget
+        is bounded by ``prefill_chunk`` tokens per prefilling slot, so
+        a long prompt is amortized across steps instead of holing one
+        decode step with a monolithic prefill.  The chunk containing
+        the prompt's true last token finishes the prefill: its logits
+        arm the sampler and the slot joins the decode grid next
+        sampling pass (this same step)."""
+        sv = self.serve
+        chunk = sv.prefill_chunk
+        for i, s in enumerate(self.slots):
+            if s is None or s.prefill_pos is None:
+                continue
+            pos = s.prefill_pos
+            t0 = len(s.req.prompt)
+            # this chunk's pages (first chunk's were allocated at
+            # admission); eviction fallback mirrors _grow_pages
+            need_pages = (pos + chunk) // sv.page_size
+            while len(s.pages) < need_pages:
+                got = self._alloc_pages(i, need_pages - len(s.pages))
+                if got is not None:
+                    s.pages.extend(got)
+                    continue
+                shard = (self._shard_of(i) if sv.ep_shards > 1
+                         else None)
+                if not self._evict_youngest(shard):
+                    raise RuntimeError("page pool exhausted with no "
+                                       "evictable request")
+                if self.slots[i] is None:   # we evicted ourselves
+                    break
+            if self.slots[i] is None:
+                continue
+            n_ctx_pages = ctx_pages_bucket(
+                pos + chunk, sv.page_size, sv.ctx_bucket_pages,
+                sv.max_pages_per_slot)
+            # the chunk jit addresses the GLOBAL page slab (it runs
+            # outside the EP shard_map); scratch fill rows are masked,
+            # any valid page id serves
+            gpages = self._global_pages(i, s.pages)
+            table = np.full((n_ctx_pages,), SCRATCH_PAGE, np.int32)
+            table[:len(gpages)] = gpages
+            first_pg = pos // sv.page_size
+            chunk_ids = gpages[first_pg:need_pages]
+            rel_last = min(max(t0 - 1 - pos, 0), chunk - 1)
+            toks = s.prefill_toks[pos:pos + chunk]
+            with trace_span("serve.prefill_chunk"):
+                logits, kp, vp = _prefill_chunk(
+                    self.params, self.cfg,
+                    self.cache.k_pages, self.cache.v_pages,
+                    jnp.asarray(toks)[None, :],
+                    jnp.asarray(table),
+                    jnp.asarray(chunk_ids, jnp.int32),
+                    jnp.int32(pos), jnp.int32(rel_last))
+            self.cache = self.cache._replace(k_pages=kp, v_pages=vp)
+            s.prefill_pos = pos + chunk
+            if pos <= t0 - 1 < pos + chunk:
+                # prefill complete — arm the sampler, join decode
+                self._logits = self._logits.at[i].set(logits)
+                s.prefill_pos = None
+                s.prefill_toks = None
+                s.length = t0
+
+    def _evict_youngest(self, shard: int | None = None) -> bool:
         """Preempt the most recently admitted request back to the
         queue head; its pages free immediately.  Returns False when no
-        active slot remains to evict."""
+        active slot remains to evict.  ``shard`` restricts the victim
+        set to one page shard (EP-sharded decode: only a same-shard
+        eviction can free the pages the caller needs).  A request
+        evicted mid-chunked-prefill resumes from scratch — delivered
+        tokens are carried in the resumed prompt either way, so the
+        resume is bit-equal regardless of how far prefill got."""
         active = self._active()
+        if shard is not None:
+            active = [i for i in active if self._shard_of(i) == shard]
         if not active:
             return False
         victim = max(active, key=lambda i: (self.slots[i].admit_step,
                                             self.slots[i].req.rid))
         s = self.slots[victim]
-        self.pool.free(s.pages)
+        self._free_slot_pages(victim, s.pages)
         delivered = self._delivered(s)
         remaining = s.orig.max_new_tokens - delivered
         # the resumed prompt carries EVERY delivered token (across any
@@ -599,17 +1027,19 @@ class ServingEngine:
         """Allocate the next page for every active slot whose write
         position crosses its allocated frontier, evicting the youngest
         request when the pool runs dry."""
-        for i in list(self._active()):
+        shard = (self._shard_of if self.serve.ep_shards > 1
+                 else lambda i: None)
+        for i in list(self._decoding()):
             s = self.slots[i]
             if s is None:
                 continue
             need_idx = s.length // self.serve.page_size
             while need_idx >= len(s.pages):
-                got = self.pool.alloc(1)
+                got = self._alloc_pages(i, 1)
                 if got is not None:
                     s.pages.extend(got)
                     continue
-                if not self._evict_youngest():
+                if not self._evict_youngest(shard(i)):
                     raise RuntimeError("page pool exhausted with no "
                                        "evictable request")
                 if self.slots[i] is None:   # we evicted ourselves
@@ -617,7 +1047,7 @@ class ServingEngine:
 
     def _retire(self, slot: int, s: _Slot) -> None:
         now = time.monotonic()
-        self.pool.free(s.pages)
+        self._free_slot_pages(slot, s.pages)
         self.slots[slot] = None
         out = (list(s.orig.prompt)
                + list(s.req.prompt[len(s.orig.prompt):])
@@ -636,6 +1066,15 @@ class ServingEngine:
             self.metrics.sketch("serve.ttft_ms", ttft_ms)
         if tpot_ms is not None:
             self.metrics.sketch("serve.tpot_ms", tpot_ms)
+        # replica-keyed twins: the fabric's mid-drill scrape reads
+        # per-replica latency sketches off the SHARED metrics object
+        if self.replica_tag is not None:
+            if ttft_ms is not None:
+                self.metrics.sketch(
+                    f"serve.{self.replica_tag}.ttft_ms", ttft_ms)
+            if tpot_ms is not None:
+                self.metrics.sketch(
+                    f"serve.{self.replica_tag}.tpot_ms", tpot_ms)
         if self.tracer is not None:
             self.tracer.on_retire(s.orig.rid, self.step_idx,
                                   tokens=n_tok, ttft_ms=ttft_ms,
@@ -672,10 +1111,12 @@ class ServingEngine:
                 [self.slots[i].orig.rid for i in self._active()])
         self._mark_arrivals()
         self._admit()
+        self._advance_prefill()
 
-        # sample each active slot's next token from its pending logits
+        # sample each decoding slot's next token from its pending
+        # logits (slots mid-chunked-prefill have none yet)
         emitted_now = 0
-        active = self._active()
+        active = self._decoding()
         if active:
             temps = np.zeros((sv.max_batch,), np.float32)
             tks = np.zeros((sv.max_batch,), np.int32)
@@ -707,10 +1148,10 @@ class ServingEngine:
         self.stats["tokens"] += emitted_now
 
         # feed the survivors one decode step
-        active = self._active()
+        active = self._decoding()
         if active:
             self._grow_pages()
-            active = self._active()
+            active = self._decoding()
         if active:
             feed = np.full((sv.max_batch,), sv.pad_token, np.int32)
             positions = np.zeros((sv.max_batch,), np.int32)
@@ -728,11 +1169,18 @@ class ServingEngine:
                                      sv.max_pages_per_slot)
             self.stats["decode_buckets"].add(n_ctx)
             with trace_span("serve.decode"):
-                logits, kp, vp = _paged_decode_step(
-                    self.params, self.cfg, self.cache.k_pages,
-                    self.cache.v_pages, jnp.asarray(feed),
-                    jnp.asarray(tables[:, :n_ctx]),
-                    jnp.asarray(positions))
+                if self._ep_fn is not None:
+                    logits, kp, vp = self._ep_fn(
+                        self.params, self.cache.k_pages,
+                        self.cache.v_pages, jnp.asarray(feed),
+                        jnp.asarray(tables[:, :n_ctx]),
+                        jnp.asarray(positions))
+                else:
+                    logits, kp, vp = _paged_decode_step(
+                        self.params, self.cfg, self.cache.k_pages,
+                        self.cache.v_pages, jnp.asarray(feed),
+                        jnp.asarray(tables[:, :n_ctx]),
+                        jnp.asarray(positions))
             self._logits = logits
             self.cache = self.cache._replace(k_pages=kp, v_pages=vp)
             for i in active:

@@ -200,3 +200,65 @@ class PagePool:
             if p in self._free:
                 raise ValueError(f"double free of page {p}")
             self._free.append(p)
+
+
+class ShardedPagePool:
+    """The EP-sharded twin of :class:`PagePool`: the page slab is
+    partitioned into ``shards`` equal contiguous blocks (matching the
+    ``P(None, "ep")`` device partitioning of the cache arrays), each
+    with its OWN deterministic LIFO free list over shard-LOCAL ids.
+
+    Ids handed out are local — exactly what the EP decode step's
+    per-shard block tables index; each shard's local page 0 is its own
+    scratch (so every device's slab has a scratch at the same local
+    offset).  :meth:`to_global` maps to slab-global ids for the eager
+    whole-page writes (prefill store) that address the unpartitioned
+    array view."""
+
+    def __init__(self, num_pages: int, shards: int):
+        if shards < 1:
+            raise ValueError(f"shards={shards} must be >= 1")
+        if num_pages % shards:
+            raise ValueError(f"num_pages={num_pages} must divide "
+                             f"evenly across {shards} shards")
+        self.num_pages = num_pages
+        self.shards = shards
+        self.pages_per_shard = num_pages // shards
+        if self.pages_per_shard < 2:
+            raise ValueError(
+                f"num_pages={num_pages} leaves fewer than 2 pages per "
+                f"shard across {shards} shards (each shard reserves "
+                f"its own scratch page)")
+        self._pools = [PagePool(self.pages_per_shard)
+                       for _ in range(shards)]
+
+    @property
+    def free_pages(self) -> int:
+        return sum(p.free_pages for p in self._pools)
+
+    @property
+    def used_pages(self) -> int:
+        return sum(p.used_pages for p in self._pools)
+
+    @property
+    def occupancy(self) -> float:
+        total = self.num_pages - self.shards   # one scratch per shard
+        return self.used_pages / total if total else 0.0
+
+    def shard_free_pages(self, shard: int) -> int:
+        return self._pools[shard].free_pages
+
+    def alloc(self, n: int, shard: int) -> list[int] | None:
+        """Pop ``n`` shard-LOCAL page ids from ``shard``'s free list
+        (``None`` on shortfall — no partial allocation, no cross-shard
+        spill: a slot's pages must live on its shard's device)."""
+        return self._pools[shard].alloc(n)
+
+    def free(self, pages, shard: int) -> None:
+        self._pools[shard].free(pages)
+
+    def to_global(self, pages, shard: int) -> list[int]:
+        """Shard-local -> slab-global ids (the eager whole-page write
+        sites address the global array view)."""
+        base = shard * self.pages_per_shard
+        return [base + int(p) for p in pages]

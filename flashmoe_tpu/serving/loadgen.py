@@ -215,3 +215,184 @@ def _sweep_points(loads, params, cfg, serve, holder, server, *,
             records[-1]["telemetry_scrape"] = scrape_rec
             records[-1]["telemetry_port"] = server.port
     return records
+
+
+def split_requests(n: int, *, replicas: int, vocab: int,
+                   prompt_len: int, max_new: int, seed: int,
+                   arrival_every: int, temperature: float = 0.0):
+    """Deterministic per-replica trace split: replica ``r``'s trace is
+    seeded with ``fold_in(PRNGKey(seed), r)``, so N independent drill
+    processes (one per replica) generate disjoint, reproducible loads
+    with no coordination — and their obs artifacts merge cleanly
+    (``observe --merge``) because rids are globally unique
+    (``rid * replicas + r``).  Returns ``[(requests, arrivals), ...]``,
+    one pair per replica; requests total ``n`` (the remainder spreads
+    over the lowest replica ids)."""
+    import dataclasses
+
+    import jax
+
+    if replicas < 1:
+        raise ValueError(f"replicas={replicas} must be >= 1")
+    out = []
+    for r in range(replicas):
+        count = n // replicas + (1 if r < n % replicas else 0)
+        sub = int(jax.random.fold_in(
+            jax.random.PRNGKey(seed), r)[0]) % (2**31 - 1)
+        reqs, arrivals = build_requests(
+            count, vocab=vocab, prompt_len=prompt_len, max_new=max_new,
+            seed=sub, arrival_every=arrival_every,
+            temperature=temperature)
+        reqs = [dataclasses.replace(q, rid=q.rid * replicas + r)
+                for q in reqs]
+        out.append((reqs, arrivals))
+    return out
+
+
+def merge_traces(splits):
+    """Merge per-replica traces back into one arrival-ordered stream
+    (ties break on rid — deterministic): what a single fabric front
+    door submits when the split generated the load."""
+    merged = []
+    for reqs, arrivals in splits:
+        merged.extend(zip(arrivals, reqs))
+    merged.sort(key=lambda p: (p[0], p[1].rid))
+    return [q for _, q in merged], [a for a, _ in merged]
+
+
+def fabric_load_sweep(loads, *, replica_counts=(1, 2, 4),
+                      n_requests: int = 8, max_batch: int = 4,
+                      prompt_len: int = 8, max_new: int = 6,
+                      seed: int = 0, page_size: int = 8,
+                      num_pages: int = 64,
+                      telemetry_port: int | None = None) -> list[dict]:
+    """The ``bench.py --fabric`` sweep: one record per (replica count,
+    offered-load point), each driving a fresh
+    :class:`~flashmoe_tpu.fabric.engine.ServingFabric` on the mocked
+    ``FLASHMOE_MOCK_FABRIC`` blocking (set per point, restored on
+    exit) with the :func:`split_requests` trace for that width.  Each
+    record carries throughput, TTFT/TPOT percentiles, handoff count
+    and modeled DCN cost, and the router's placement histogram;
+    ``vs_baseline`` is relative to the same replica count's lightest
+    load (the per-width saturation curve) and ``vs_single`` to the
+    1-replica fabric at the same load (the scale-out curve).
+
+    ``telemetry_port`` arms one scrape server for the whole sweep and
+    self-scrapes ``/metrics`` mid-drill into each record — the fabric
+    acceptance's live-plane leg."""
+    import os
+    import time
+
+    import jax
+
+    from flashmoe_tpu.fabric.engine import ServingFabric
+    from flashmoe_tpu.fabric.topo import ENV_MOCK_FABRIC
+    from flashmoe_tpu.models.transformer import init_params
+    from flashmoe_tpu.serving.engine import ServeConfig
+    from flashmoe_tpu.utils.telemetry import Metrics
+
+    cfg = tiny_config()
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    serve = ServeConfig(
+        max_batch=max_batch, page_size=page_size, num_pages=num_pages,
+        max_pages_per_slot=max(
+            2, -(-(prompt_len + max_new) // page_size) + 1),
+        ctx_bucket_pages=1, prompt_bucket=page_size)
+    holder = [Metrics()]
+    server = None
+    if telemetry_port is not None:
+        from flashmoe_tpu.telemetry_plane.server import maybe_server
+
+        server = maybe_server(telemetry_port,
+                              metrics_fn=lambda: holder[0])
+    records = []
+    single_tps: dict = {}       # every -> 1-replica tokens/sec
+    saved = os.environ.get(ENV_MOCK_FABRIC)
+    try:
+        for k in replica_counts:
+            if k < 1:
+                raise ValueError(f"replica count {k} must be >= 1")
+            os.environ[ENV_MOCK_FABRIC] = str(int(k))
+            base_tps = None
+            for every in loads:
+                if every < 1:
+                    raise ValueError(f"offered-load gap {every} must "
+                                     f"be >= 1 engine step")
+                reqs, arrivals = merge_traces(split_requests(
+                    n_requests, replicas=int(k), vocab=cfg.vocab_size,
+                    prompt_len=prompt_len, max_new=max_new, seed=seed,
+                    arrival_every=int(every)))
+                mx = Metrics()
+                holder[0] = mx
+                fab = ServingFabric(params, cfg, serve, metrics_obj=mx)
+                t0 = time.monotonic()
+                scrape_rec = None
+                scrape_pause_s = 0.0
+                if server is not None:
+                    fab.run(reqs, arrivals,
+                            until=lambda: "serve.ttft_ms" in mx.sketches)
+                    t_pause = time.monotonic()
+                    scrape_rec = _scrape_metrics(server)
+                    scrape_pause_s = time.monotonic() - t_pause
+                    fab.run()
+                else:
+                    fab.run(reqs, arrivals)
+                wall_s = max(time.monotonic() - t0 - scrape_pause_s,
+                             1e-9)
+                s = fab.summary()
+                tokens = sum(e["tokens"] for e in s["engines"])
+                tps = tokens / wall_s
+                base_tps = base_tps if base_tps is not None else tps
+                if int(k) == 1:
+                    single_tps[int(every)] = tps
+                retires = [d for d in mx.decisions
+                           if d.get("decision") == "serve.retire"]
+                ttfts = [d["ttft_ms"] for d in retires
+                         if d.get("ttft_ms") is not None]
+                tpots = [d["tpot_ms"] for d in retires
+                         if d.get("tpot_ms") is not None]
+                tag = ",telemetry" if server is not None else ""
+                rec = {
+                    "metric": f"fabric_load[replicas={int(k)},"
+                              f"every={int(every)},"
+                              f"req={n_requests}{tag}]",
+                    "value": round(tps, 1),
+                    "unit": "tokens_per_sec",
+                    "vs_baseline": (round(tps / base_tps, 3)
+                                    if base_tps else None),
+                    "vs_single": (round(
+                        tps / single_tps[int(every)], 3)
+                        if single_tps.get(int(every)) else None),
+                    "replicas": int(k),
+                    "offered_every_steps": int(every),
+                    "completed": sum(e["completed"]
+                                     for e in s["engines"]),
+                    "tokens": tokens,
+                    "steps": s["steps"],
+                    "handoffs": s["handoffs"],
+                    "handoff_kb": round(s["handoff_bytes"] / 1024, 3),
+                    "handoff_ms_modeled": round(
+                        fab.handoff.modeled_ms_total, 6),
+                    "routed": s["routed"],
+                    "evictions": sum(e["evictions"]
+                                     for e in s["engines"]),
+                    "ttft_ms_p50": pctl(ttfts, 0.5),
+                    "ttft_ms_p99": pctl(ttfts, 0.99),
+                    "tpot_ms_p50": pctl(tpots, 0.5),
+                    "tpot_ms_p99": pctl(tpots, 0.99),
+                    "pools_formed": fab.pool_plan is not None,
+                    "backend": jax.default_backend(),
+                }
+                if scrape_rec is not None:
+                    rec["telemetry_scrape"] = scrape_rec
+                    rec["telemetry_port"] = server.port
+                records.append(rec)
+                fab.close()
+    finally:
+        if saved is None:
+            os.environ.pop(ENV_MOCK_FABRIC, None)
+        else:
+            os.environ[ENV_MOCK_FABRIC] = saved
+        if server is not None:
+            server.stop()
+    return records

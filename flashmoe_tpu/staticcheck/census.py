@@ -76,6 +76,15 @@ CENSUS_EXTRA_WIRES = {"dcn-e4m3": {"wire_dtype_dcn": "e4m3"}}
 #: exchange) fails these rows before any silicon runs it.
 CENSUS_QUANT = {"int8": {"expert_quant": "int8"}}
 
+#: the KV-handoff-wire dimension (MoEConfig.kv_wire_dtype, ISSUE 16):
+#: the fabric's prefill->decode page stream is coded HOST-SIDE, so the
+#: knob must move NO collective — count and bytes exactly where the
+#: wire-off build put them, on every path.  One serial, leg-wire-off
+#: variant per (config, path) reconciles that claim against the traced
+#: graph: a handoff codec that leaked into the traced layer (an astype
+#: on the exchange, a smuggled gather) fails these rows statically.
+CENSUS_KV_WIRE = {"e4m3": {"kv_wire_dtype": "e4m3"}}
+
 
 @dataclasses.dataclass(frozen=True)
 class CensusRow:
@@ -129,6 +138,18 @@ def census_matrix():
                             "(config.py); collective covers this "
                             "config")
                 yield name, cfg, f"off+q:{qtag}", "serial", path, skip
+        # kv-handoff-wire rows (serial, leg wire off): the comm model
+        # must be UNMOVED by kv_wire_dtype — the page codec is a host
+        # boundary, never an exchange
+        for ktag, kknobs in CENSUS_KV_WIRE.items():
+            cfg = base.replace(ep=CENSUS_D, **kknobs)
+            for path in CENSUS_PATHS:
+                skip = ""
+                if path == "ragged" and cfg.num_shared_experts:
+                    skip = ("ragged layer rejects shared experts "
+                            "(config.py); collective covers this "
+                            "config")
+                yield name, cfg, f"off+kv:{ktag}", "serial", path, skip
 
 
 def _trace(cfg, path, devices):

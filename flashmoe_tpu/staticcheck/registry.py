@@ -173,6 +173,18 @@ KNOBS: tuple[KnobSpec, ...] = (
             "weights are rank-local, so compression of their storage "
             "cannot touch a collective"),
     KnobSpec(
+        "kv_wire_dtype", off_values=(None,),
+        on={"kv_wire_dtype": "e4m3"}, changes_graph=False,
+        doc="KV-page handoff wire for the disaggregated fabric "
+            "(fabric/handoff.py): the prefill->decode page stream is "
+            "encoded/decoded HOST-SIDE between the prefill jit and the "
+            "cache store, so BOTH values trace the byte-identical "
+            "graph on every backend — off is bit-identical by "
+            "construction (the 'off' codec arm returns the arrays "
+            "untouched, no astype), and on never adds a collective "
+            "(the handoff is a host boundary, not an exchange; the "
+            "census's kv-wire rows double-check)"),
+    KnobSpec(
         "gather_fused", off_values=(None, False), on={"gather_fused": True},
         backends=("local",), changes_graph=False,
         doc="inference kernel-entry selector; on the XLA oracle path "

@@ -68,9 +68,20 @@ DECISION_NAMES: dict[str, str] = {
     "controller.replace":
         "the self-healing controller re-placed/replicated experts "
         "mid-job",
+    "controller.replica_morph":
+        "the controller drained (sustained-idle fabric) or returned "
+        "(sustained queue pressure) a decode replica in the fabric "
+        "router's rotation",
     "controller.wire_morph":
         "the controller flipped the DCN-hop wire dtype after sustained "
         "a2a-leg dominance on a multi-slice job",
+    "fabric.handoff":
+        "a prefill KV run crossed to a decode replica as wire-coded "
+        "pages: payload size, modeled DCN cost, and whether it hides "
+        "under the decode pool's per-step objective",
+    "fabric.route":
+        "the replica router placed a request (session affinity or "
+        "join-shortest-queue over live /healthz depths)",
     "planner.backend_constraint":
         "auto pick demoted to a backend the config can actually run",
     "planner.drift":
@@ -151,6 +162,12 @@ SPAN_NAMES: dict[str, str] = {
     "moe.fused_kernel": "fused RDMA kernel (dispatch+FFN in one launch)",
     "serve.prefill":
         "serving engine: single-pass prompt prefill into cache pages",
+    "serve.prefill_chunk":
+        "serving engine: one fixed-budget chunk of an admitted "
+        "prompt's incremental prefill (chunked admission)",
+    "serve.handoff":
+        "fabric: a prefill KV run's page codec round-trip on its way "
+        "to the decode replica",
     "serve.decode":
         "serving engine: one continuous-batching decode step",
     "serve.queued":

@@ -633,3 +633,88 @@ def test_cli_regression_wedged_probe_skip_stays_rc0(monkeypatch,
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["skipped"] is True and "hung" in rec["reason"]
     assert not (obs / "history.jsonl").exists()
+
+
+def test_cli_fabric_plumbs_load_sweep(monkeypatch):
+    """`bench.py --fabric` hands the fabric sweep its offered loads,
+    request/batch sizes, and the optional live-scrape port."""
+    import sys as _sys
+
+    import bench
+
+    seen = {}
+
+    def fake_fabric(loads, *, requests, max_batch, telemetry_port=None):
+        seen.update(loads=loads, requests=requests,
+                    max_batch=max_batch, telemetry_port=telemetry_port)
+
+    monkeypatch.setattr(bench, "_bench_fabric", fake_fabric)
+    monkeypatch.setattr(_sys, "argv",
+                        ["bench.py", "--fabric", "--telemetry-port",
+                         "0", "--deadline", "0"])
+    bench.main()
+    assert seen == {"loads": [4, 2, 1], "requests": 8, "max_batch": 4,
+                    "telemetry_port": 0}
+
+
+def test_cli_fabric_flag_exclusivity(monkeypatch, capsys):
+    """--fabric fail-fasts on modes/knobs it would silently ignore
+    (its drill model pins its own config), and --telemetry-port is
+    rejected outside --serve/--fabric."""
+    import sys as _sys
+
+    import bench
+
+    cases = [
+        ["bench.py", "--fabric", "--ckpt"],
+        ["bench.py", "--fabric", "--quant"],
+        ["bench.py", "--fabric", "--serve"],
+        ["bench.py", "--fabric", "--scaling"],
+        ["bench.py", "--fabric", "--profile"],
+        ["bench.py", "--fabric", "--wire-dtype", "e4m3"],
+        ["bench.py", "--fabric", "--a2a-chunks", "2"],
+        ["bench.py", "--telemetry-port", "0"],
+    ]
+    for argv in cases:
+        monkeypatch.setattr(_sys, "argv", argv)
+        with pytest.raises(SystemExit) as e:
+            bench.main()
+        assert e.value.code == 2, argv
+        capsys.readouterr()
+
+
+def test_cli_fabric_emits_skipped_record_when_probe_hangs(monkeypatch,
+                                                          capsys):
+    """On real hardware (FLASHMOE_OVERLAP_TPU=1) --fabric inherits the
+    probe fail-fast contract: a wedged tunnel yields ONE well-formed
+    skipped:true record and rc 0; a dead backend errors rc 2."""
+    import sys as _sys
+
+    import bench
+
+    monkeypatch.setenv("FLASHMOE_OVERLAP_TPU", "1")
+    monkeypatch.setattr(
+        bench, "_probe_backend_retry",
+        lambda budget_s, each_s=90, max_attempts=0:
+        (False, "backend probe hung >10s after 2 attempts / 20s", True))
+    monkeypatch.setattr(
+        bench, "_bench_fabric",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("sweep must not run on a hung probe")))
+    monkeypatch.setattr(_sys, "argv", ["bench.py", "--fabric"])
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["skipped"] is True
+    assert rec["metric"] == "fabric_tokens_per_sec[replicas]"
+    assert rec["value"] is None and "hung" in rec["reason"]
+    monkeypatch.setattr(
+        bench, "_probe_backend_retry",
+        lambda budget_s, each_s=90, max_attempts=0:
+        (False, "backend probe rc=1: boom", False))
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 2
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["error"].startswith("backend probe rc=1")

@@ -1,0 +1,556 @@
+"""Disaggregated serving fabric (flashmoe_tpu/fabric/): EP-sharded
+decode replicas behind a JSQ+affinity router, Decider-split
+prefill/decode pools, and the DCN-priced KV-page handoff.
+
+The headline drill is the ISSUE acceptance: a mocked 2-pool x
+2-replica fabric (``FLASHMOE_MOCK_FABRIC=2`` on the virtual 8-device
+CPU mesh) sustaining 8 concurrent requests with at least one KV
+handoff and at least one eviction/re-prefill cycle, token-bit-equal
+to the single-pool :class:`ServingEngine` on the same trace, with a
+live mid-drill ``/metrics`` scrape carrying per-replica TTFT/TPOT
+sketches.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flashmoe_tpu.fabric import (
+    KVHandoff, ReplicaRouter, ServingFabric, decode_kv_run,
+    encode_kv_run, fabric_world,
+)
+from flashmoe_tpu.fabric.topo import ENV_MOCK_FABRIC, _mock_fabric
+from flashmoe_tpu.models.transformer import init_params
+from flashmoe_tpu.serving.engine import (
+    Request, ServeConfig, ServingEngine,
+)
+from flashmoe_tpu.serving.loadgen import (
+    build_requests, merge_traces, split_requests, tiny_config,
+)
+from flashmoe_tpu.utils.telemetry import Metrics
+
+CFG = tiny_config()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    return jax.random.randint(jax.random.PRNGKey(1), (8, 8), 0,
+                              CFG.vocab_size)
+
+
+def _requests(prompts, n, max_new=6, **kw):
+    return [Request(rid=i, prompt=tuple(int(t) for t in prompts[i]),
+                    max_new_tokens=max_new, **kw) for i in range(n)]
+
+
+# ----------------------------------------------------------------------
+# Mocked topology (FLASHMOE_MOCK_FABRIC)
+# ----------------------------------------------------------------------
+
+def test_mock_fabric_env_parse_hardened(monkeypatch):
+    """Malformed mocks are configuration errors naming the world size
+    (mirroring FLASHMOE_MOCK_SLICES) — never a silent single-replica
+    fallback."""
+    monkeypatch.delenv(ENV_MOCK_FABRIC, raising=False)
+    assert _mock_fabric(8) is None
+    monkeypatch.setenv(ENV_MOCK_FABRIC, "2")
+    assert _mock_fabric(8) == 2
+    assert fabric_world(8) == (2, 4)
+    monkeypatch.setenv(ENV_MOCK_FABRIC, "1")
+    assert _mock_fabric(8) is None          # 1 = no blocking
+    for bad in ("x", "2.5", ""):
+        monkeypatch.setenv(ENV_MOCK_FABRIC, bad)
+        if bad == "":
+            assert _mock_fabric(8) is None  # empty = unset
+            continue
+        with pytest.raises(ValueError, match="8 devices"):
+            _mock_fabric(8)
+    monkeypatch.setenv(ENV_MOCK_FABRIC, "0")
+    with pytest.raises(ValueError, match=">= 1"):
+        _mock_fabric(8)
+    monkeypatch.setenv(ENV_MOCK_FABRIC, "-2")
+    with pytest.raises(ValueError, match=">= 1"):
+        _mock_fabric(8)
+    monkeypatch.setenv(ENV_MOCK_FABRIC, "3")
+    with pytest.raises(ValueError, match="does not divide"):
+        _mock_fabric(8)
+
+
+def test_mock_fabric_single_device_colocates(monkeypatch):
+    """On a 1-device world any replica count co-locates (replicas are
+    full engines, not device partitions) — the bare-CPU bench sweep's
+    CI story."""
+    monkeypatch.setenv(ENV_MOCK_FABRIC, "4")
+    assert _mock_fabric(1) == 4
+    assert fabric_world(1) == (4, 1)
+    with pytest.raises(ValueError, match=">= 1 device"):
+        fabric_world(0)
+
+
+# ----------------------------------------------------------------------
+# KV-page handoff codec
+# ----------------------------------------------------------------------
+
+def _kv_run(seed, l=2, nkv=2, t=16, d=8):
+    k = jax.random.normal(jax.random.PRNGKey(seed), (l, nkv, t, d),
+                          jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(seed + 1),
+                          (l, nkv, t, d), jnp.float32)
+    return k, v
+
+
+def test_kv_codec_wire_off_is_exact_passthrough():
+    """wire=None returns the arrays untouched — no cast, no sidecar:
+    the property that makes the fabric drill bit-equal by
+    construction."""
+    k, v = _kv_run(0)
+    p = encode_kv_run(k, v, 8, None)
+    assert p.wire == "off" and p.pages == 2
+    assert p.k_qscale is None and p.v_qscale is None
+    ko, vo = decode_kv_run(p, jnp.float32)
+    assert ko is k and vo is v              # same objects, zero copies
+
+
+def test_kv_codec_fp8_roundtrip_zero_preserving():
+    """The e4m3 page wire round-trips within fp8 error, preserves
+    exact zeros (padded page tails stay zero), and carries one f32
+    scale per (layer, page) row."""
+    k, v = _kv_run(2)
+    k = k.at[:, :, 12:, :].set(0.0)         # padded tail
+    p = encode_kv_run(k, v, 8, jnp.float8_e4m3fn)
+    assert p.wire == "e4m3" and p.pages == 2
+    assert p.k_qscale is not None and p.v_qscale is not None
+    assert p.k_qscale.shape[0] == 2 * 2     # L * n_pages rows
+    assert p.payload_bytes < int(k.nbytes) + int(v.nbytes)
+    ko, vo = decode_kv_run(p, jnp.float32)
+    np.testing.assert_allclose(np.asarray(ko), np.asarray(k),
+                               rtol=0.08, atol=0.08)
+    np.testing.assert_array_equal(
+        np.asarray(ko[:, :, 12:, :]), 0.0)  # zeros exact
+    np.testing.assert_allclose(np.asarray(vo), np.asarray(v),
+                               rtol=0.08, atol=0.08)
+
+
+def test_kv_codec_rejects_partial_pages():
+    k, v = _kv_run(4, t=12)                 # 12 % 8 != 0
+    with pytest.raises(ValueError, match="whole pages"):
+        encode_kv_run(k, v, 8, jnp.float8_e4m3fn)
+
+
+def test_kv_handoff_prices_and_records(params):
+    """Every handoff is DCN-priced through planner.model.kv_handoff_ms
+    and recorded as a fabric.handoff decision with the overlap
+    verdict."""
+    mx = Metrics()
+    ho = KVHandoff(params, CFG, 8, metrics_obj=mx,
+                   decode_step_ms=1e9)      # everything overlaps
+    prompt = jnp.zeros((1, 8), jnp.int32)
+    logits, k, v = ho.prefill(prompt, 8, replica=1, rid=7)
+    assert ho.count == 1 and ho.bytes_moved > 0
+    d = [r for r in mx.decisions if r["decision"] == "fabric.handoff"]
+    assert len(d) == 1
+    assert d[0]["replica"] == 1 and d[0]["rid"] == 7
+    assert d[0]["wire"] == "off"
+    assert d[0]["modeled_dcn_ms"] > 0
+    assert d[0]["overlapped"] is True
+    snap = ho.snapshot()
+    assert snap["handoffs"] == 1 and snap["wire"] == "off"
+
+
+# ----------------------------------------------------------------------
+# Replica router
+# ----------------------------------------------------------------------
+
+def _health(depth, ok=True):
+    def fn():
+        if ok is None:
+            raise RuntimeError("replica down")
+        return {"queue_depth": depth, "active_requests": 0, "ok": ok}
+    return fn
+
+
+def test_router_jsq_and_tiebreak():
+    mx = Metrics()
+    r = ReplicaRouter([_health(3), _health(1), _health(1)],
+                      metrics_obj=mx, affinity=False)
+    assert r.route(rid=0) == 1              # shortest queue, lowest id
+    d = mx.decisions[-1]
+    assert d["decision"] == "fabric.route" and d["policy"] == "jsq"
+    assert d["queue_depths"] == [3, 1, 1]
+
+
+def test_router_session_affinity_and_spill():
+    import zlib
+
+    mx = Metrics()
+    r = ReplicaRouter([_health(9), _health(0)], metrics_obj=mx)
+    want = zlib.crc32(b"alice") % 2
+    # affinity wins even against a longer queue
+    assert r.route(rid=0, session="alice") == want
+    assert mx.decisions[-1]["policy"] == "affinity"
+    # a draining preferred replica spills to JSQ
+    r.drain(want)
+    got = r.route(rid=1, session="alice")
+    assert got == 1 - want
+    assert mx.decisions[-1]["policy"] == "jsq_spill"
+    r.undrain(want)
+    assert r.route(rid=2, session="alice") == want
+
+
+def test_router_unhealthy_and_all_draining_fallback():
+    mx = Metrics()
+    r = ReplicaRouter([_health(0, ok=None), _health(5)],
+                      metrics_obj=mx, affinity=False)
+    assert r.route(rid=0) == 1              # raising probe = unhealthy
+    # every replica draining: fall back to the full rotation rather
+    # than dropping the request
+    r2 = ReplicaRouter([_health(2), _health(1)], metrics_obj=mx,
+                       affinity=False)
+    r2.drain(0), r2.drain(1)
+    assert r2.route(rid=0) == 1
+    with pytest.raises(ValueError, match="out of range"):
+        r2.drain(5)
+    with pytest.raises(ValueError, match=">= 1 replica"):
+        ReplicaRouter([])
+
+
+# ----------------------------------------------------------------------
+# Controller replica morph (PR 9 discipline on the rotation)
+# ----------------------------------------------------------------------
+
+def _controller(**kw):
+    from flashmoe_tpu.runtime.controller import (
+        ControllerConfig, RuntimeController,
+    )
+
+    mx = Metrics()
+    ccfg = ControllerConfig(enable_replica_morph=True, debounce_steps=3,
+                            cooldown_steps=8, replica_morph_budget=2,
+                            **kw)
+    return RuntimeController(CFG, ccfg, metrics=mx), mx
+
+
+def test_replica_morph_hysteresis_band_validated():
+    from flashmoe_tpu.runtime.controller import ControllerConfig
+
+    with pytest.raises(ValueError, match="replica_queue_low"):
+        ControllerConfig(replica_queue_low=4.0, replica_queue_high=4.0)
+
+
+def test_replica_morph_debounce_drain_and_undrain():
+    """Sustained idleness drains the highest-id rotating replica (never
+    below one); sustained pressure returns the lowest-id drained one;
+    both debounce on consecutive observations and burn the shared
+    budget under a cooldown window."""
+    ctl, mx = _controller()
+    step = 0
+    # two idle observations then a busy one: debounce resets, no act
+    for d in ([0, 0], [0, 0], [9, 9]):
+        step += 1
+        ctl.observe_fabric(step, d)
+        assert ctl.maybe_morph_replicas(step) is None
+    # three consecutive idle steps -> drain replica 1 (max rotating)
+    for _ in range(3):
+        step += 1
+        ctl.observe_fabric(step, [0, 0])
+    act = ctl.maybe_morph_replicas(step, draining=())
+    assert act is not None and act.kind == "drain" and act.replica == 1
+    recs = [r for r in mx.decisions
+            if r["decision"] == "controller.replica_morph"]
+    assert recs and recs[-1]["trigger"] == "queue_low"
+    # cooldown window suppresses (one controller.cooldown record)
+    for _ in range(3):
+        step += 1
+        ctl.observe_fabric(step, [0, 0])
+    assert ctl.maybe_morph_replicas(step, draining=(1,)) is None
+    cools = [r for r in mx.decisions
+             if r["decision"] == "controller.cooldown"
+             and r["trigger"] == "replica"]
+    assert len(cools) == 1
+    # past cooldown, sustained pressure undrains the drained replica
+    step += 10
+    for _ in range(3):
+        step += 1
+        ctl.observe_fabric(step, [9, 9])
+    act = ctl.maybe_morph_replicas(step, draining=(1,))
+    assert act.kind == "undrain" and act.replica == 1
+    # budget (2) is spent: a third sustained trigger is inert
+    step += 10
+    for _ in range(3):
+        step += 1
+        ctl.observe_fabric(step, [0, 0])
+    assert ctl.maybe_morph_replicas(step, draining=()) is None
+    assert ctl.snapshot()["budgets"]["replica_morph"] == 0
+
+
+def test_replica_morph_never_drains_last_replica():
+    ctl, _ = _controller()
+    for s in range(1, 4):
+        ctl.observe_fabric(s, [0, 0])
+    assert ctl.maybe_morph_replicas(3, draining=(1,)) is None
+
+
+def test_replica_morph_budget_survives_restart():
+    ctl, _ = _controller()
+    ctl.replica_morphs_used = 2
+    state = ctl.state_dict()
+    ctl2, _ = _controller()
+    ctl2.replica_morphs_used = 1
+    ctl2.load_state_dict(state)
+    assert ctl2.replica_morphs_used == 2    # monotonic max
+
+
+# ----------------------------------------------------------------------
+# Per-replica trace split (loadgen)
+# ----------------------------------------------------------------------
+
+def test_split_requests_deterministic_disjoint():
+    kw = dict(vocab=CFG.vocab_size, prompt_len=8, max_new=4, seed=7,
+              arrival_every=2)
+    a = split_requests(8, replicas=3, **kw)
+    b = split_requests(8, replicas=3, **kw)
+    assert a == b                           # reproducible
+    assert [len(r) for r, _ in a] == [3, 3, 2]   # remainder to low ids
+    rids = [q.rid for reqs, _ in a for q in reqs]
+    assert len(set(rids)) == 8              # globally unique
+    assert all(q.rid % 3 == r for r, (reqs, _) in enumerate(a)
+               for q in reqs)
+    # per-replica seeds diverge: different prompts across replicas
+    assert a[0][0][0].prompt != a[1][0][0].prompt
+    with pytest.raises(ValueError, match="replicas"):
+        split_requests(4, replicas=0, **kw)
+
+
+def test_merge_traces_arrival_ordered():
+    kw = dict(vocab=CFG.vocab_size, prompt_len=8, max_new=4, seed=7,
+              arrival_every=2)
+    reqs, arrivals = merge_traces(split_requests(8, replicas=2, **kw))
+    assert len(reqs) == 8
+    assert arrivals == sorted(arrivals)
+    # ties break on rid: deterministic merge
+    for (a1, q1), (a2, q2) in zip(zip(arrivals, reqs),
+                                  list(zip(arrivals, reqs))[1:]):
+        assert (a1, q1.rid) < (a2, q2.rid)
+
+
+def test_per_replica_shards_merge_in_observe(tmp_path):
+    """Each replica's decision dump is one host shard; observe --merge
+    reads the union as ONE fabric (satellite: mergeable artifacts)."""
+    from flashmoe_tpu.observe import merge_report
+
+    for r in range(2):
+        p = tmp_path / f"telemetry.r{r}.jsonl"
+        with open(p, "w") as f:
+            for i in range(3):
+                f.write(json.dumps({
+                    "decision": "fabric.route", "rid": i * 2 + r,
+                    "replica": r, "policy": "affinity",
+                    "queue_depths": [0, 0], "draining": []}) + "\n")
+    rep = merge_report([str(tmp_path / "telemetry.r0.jsonl"),
+                        str(tmp_path / "telemetry.r1.jsonl")])
+    assert set(rep["hosts"]) == {"r0", "r1"}
+    assert rep["records"] == 6
+
+
+def test_serving_report_surfaces_fabric_decisions():
+    """observe --serving folds serve.pools / fabric.route /
+    fabric.handoff into the serving story."""
+    from flashmoe_tpu.observe import render_serving_text, serving_report
+
+    recs = [
+        {"decision": "serve.pools", "prefill_devices": [0, 1],
+         "decode_devices": [2, 3], "prefill_ms": 1.5, "decode_ms": 0.4,
+         "prefill_mapping": "single", "decode_mapping": "single",
+         "decode_quant": "int8", "kv_wire": "e4m3"},
+        {"decision": "fabric.route", "replica": 0, "policy": "affinity",
+         "queue_depths": [0, 0], "draining": []},
+        {"decision": "fabric.route", "replica": 1, "policy": "jsq",
+         "queue_depths": [2, 0], "draining": []},
+        {"decision": "fabric.handoff", "rid": 0, "replica": 0,
+         "pages": 2, "wire": "e4m3", "payload_kb": 4.0,
+         "modeled_dcn_ms": 0.02, "overlapped": True},
+        {"decision": "serve.retire", "rid": 0, "ttft_ms": 5.0,
+         "tpot_ms": 1.0},
+    ]
+    rep = serving_report(recs)
+    assert rep["pools"]["decode_quant"] == "int8"
+    assert rep["fabric_route"]["placements"] == {"0": 1, "1": 1}
+    assert rep["fabric_route"]["policies"] == {"affinity": 1, "jsq": 1}
+    assert rep["fabric_handoff"]["count"] == 1
+    assert rep["fabric_handoff"]["overlapped_frac"] == 1.0
+    text = render_serving_text(rep)
+    assert "pools:" in text and "fabric router:" in text
+    assert "kv handoff:" in text and "wire e4m3" in text
+
+
+# ----------------------------------------------------------------------
+# Chunked prefill x eviction (single-pool engine)
+# ----------------------------------------------------------------------
+
+def test_chunked_prefill_with_eviction_bit_equal(params):
+    """A 24-token prompt admitted in 8-token chunks under page
+    pressure: requests evict and re-prefill (again chunked) and the
+    token streams stay bit-equal to the unchunked engine."""
+    long_prompts = jax.random.randint(jax.random.PRNGKey(5), (4, 24),
+                                      0, CFG.vocab_size)
+    reqs = [Request(rid=i,
+                    prompt=tuple(int(t) for t in long_prompts[i]),
+                    max_new_tokens=10) for i in range(4)]
+    base = ServeConfig(max_batch=4, page_size=8, num_pages=14,
+                       max_pages_per_slot=5, ctx_bucket_pages=1,
+                       prompt_bucket=8)
+    import dataclasses
+
+    mx = Metrics()
+    eng = ServingEngine(params, CFG,
+                        dataclasses.replace(base, prefill_chunk=8),
+                        metrics_obj=mx)
+    out = eng.run(reqs)
+    s = eng.summary()
+    assert s["completed"] == 4
+    assert s["evictions"] > 0               # re-prefill cycle, chunked
+    plain = ServingEngine(params, CFG, base, metrics_obj=Metrics())
+    out_plain = plain.run([Request(
+        rid=i, prompt=tuple(int(t) for t in long_prompts[i]),
+        max_new_tokens=10) for i in range(4)])
+    for i in range(4):
+        np.testing.assert_array_equal(np.asarray(out[i]),
+                                      np.asarray(out_plain[i]))
+
+
+# ----------------------------------------------------------------------
+# The acceptance drill
+# ----------------------------------------------------------------------
+
+def test_fabric_drill_2x2_bit_equal_with_live_scrape(params, prompts,
+                                                     monkeypatch):
+    """ISSUE acceptance: mocked 2-pool x 2-replica fabric, 8 concurrent
+    requests, >=1 KV handoff and >=1 eviction/re-prefill, outputs
+    token-bit-equal to the single-pool engine, and a LIVE mid-drill
+    /metrics scrape with per-replica TTFT/TPOT sketches."""
+    import urllib.request
+
+    serve = ServeConfig(max_batch=4, page_size=8, num_pages=8,
+                        max_pages_per_slot=4, ctx_bucket_pages=1,
+                        prompt_bucket=8)
+    reqs = _requests(prompts, 8, max_new=10)
+    arrivals = [0, 0, 0, 0, 1, 1, 2, 3]
+
+    # single-pool baseline
+    base = ServingEngine(params, CFG, serve, metrics_obj=Metrics())
+    out_base = base.run(_requests(prompts, 8, max_new=10), arrivals)
+
+    monkeypatch.setenv(ENV_MOCK_FABRIC, "2")
+    mx = Metrics()
+    fab = ServingFabric(params, CFG, serve, metrics_obj=mx,
+                        telemetry_port=0)
+    try:
+        assert fab.n_replicas == 2
+        assert fab.pool_plan is not None    # 2 pools formed (8 devices)
+        for req, arr in zip(reqs, arrivals):
+            fab.submit(req, arr)
+        # drive until a retirement seeds a replica sketch, then scrape
+        # while work is still in flight
+        while fab.pending() and not any(
+                k.endswith(".ttft_ms") and ".r" in k
+                for k in mx.sketches):
+            fab.step()
+        assert fab.pending()
+        url = f"http://127.0.0.1:{fab.telemetry.port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            body = r.read().decode()
+        assert 'flashmoe_serve_r0_ttft_ms{quantile="' in body \
+            or 'flashmoe_serve_r1_ttft_ms{quantile="' in body
+        while fab.pending():
+            fab.step()
+        out = {rid: toks for rid, toks in
+               (pair for e in fab.engines
+                for pair in e.outputs.items())}
+        s = fab.summary()
+    finally:
+        fab.close()
+
+    assert s["handoffs"] >= 1               # every prefill crossed DCN
+    assert sum(e["evictions"] for e in s["engines"]) >= 1
+    assert sum(e["completed"] for e in s["engines"]) == 8
+    assert sorted(s["routed"]) and sum(s["routed"]) == 8
+    # token-bit-equal to the single-pool engine
+    for i in range(8):
+        np.testing.assert_array_equal(np.asarray(out[i]),
+                                      np.asarray(out_base[i]))
+    # the decision plane told the story
+    routes = [d for d in mx.decisions if d["decision"] == "fabric.route"]
+    handoffs = [d for d in mx.decisions
+                if d["decision"] == "fabric.handoff"]
+    assert len(routes) == 8
+    assert len(handoffs) == s["handoffs"]
+    assert all(d["modeled_dcn_ms"] > 0 for d in handoffs)
+    # /vars carries pools + handoff + router + per-replica plans
+    v = fab._vars_snapshot()
+    assert v["replicas"] == 2 and v["pools"] is not None
+    assert v["handoff"]["handoffs"] == s["handoffs"]
+    assert len(v["engines"]) == 2
+
+
+def test_fabric_controller_drains_idle_replica(params, prompts,
+                                               monkeypatch):
+    """An armed controller watching an idling fabric consolidates: the
+    queue_low trigger drains the highest-id replica through the
+    router (controller.replica_morph recorded, rotation shrinks)."""
+    from flashmoe_tpu.runtime.controller import (
+        ControllerConfig, RuntimeController,
+    )
+
+    monkeypatch.setenv(ENV_MOCK_FABRIC, "2")
+    mx = Metrics()
+    ctl = RuntimeController(
+        CFG,
+        ControllerConfig(enable_replica_morph=True, debounce_steps=2,
+                         cooldown_steps=4, replica_morph_budget=1,
+                         replica_queue_low=3.0, replica_queue_high=9.0),
+        metrics=mx)
+    serve = ServeConfig(max_batch=4, page_size=8, num_pages=32,
+                        max_pages_per_slot=4, ctx_bucket_pages=1,
+                        prompt_bucket=8)
+    fab = ServingFabric(params, CFG, serve, metrics_obj=mx,
+                        controller=ctl)
+    out = fab.run(_requests(prompts, 2, max_new=8), [0, 0])
+    assert len(out) == 2
+    morphs = [d for d in mx.decisions
+              if d["decision"] == "controller.replica_morph"]
+    assert morphs and morphs[0]["kind"] == "drain"
+    assert fab.router.draining() == (morphs[0]["replica"],)
+
+
+# ----------------------------------------------------------------------
+# Golden fabric dimension
+# ----------------------------------------------------------------------
+
+def test_fabric_golden_gated():
+    """The modeled KV-handoff cost is CI-gated next to the plans it
+    must hide under: recompute the golden fabric section and require
+    the fp8 page wire to flip at least one overlap verdict."""
+    from flashmoe_tpu.planner.golden import GOLDEN_PATH, golden_snapshot
+
+    with open(GOLDEN_PATH) as f:
+        frozen = json.load(f)
+    live = golden_snapshot()
+    assert live["fabric"] == frozen["fabric"], (
+        "fabric golden points moved; if intentional regenerate with "
+        "python -m flashmoe_tpu.planner --regen-golden")
+    pts = [g for gens in frozen["fabric"].values()
+           for g in gens.values()]
+    assert all(p["fp8_saves"] for p in pts)   # fp8 wire always cheaper
+    assert any(p["wires"]["e4m3"]["overlapped"]
+               and not p["wires"]["off"]["overlapped"]
+               for p in pts), (
+        "no golden config where the fp8 page wire flips the handoff "
+        "under the decode step — the fabric pricing lost its teeth")

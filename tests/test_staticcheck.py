@@ -99,8 +99,11 @@ def test_cli_all_json(capsys, devices):
     # x 3 paths (declared skips included) = 45, plus the
     # quantized-store rows (ISSUE 15: 3 configs x 3 paths at
     # wire-off/serial — expert weights are rank-local, so int8 storage
-    # must leave every collective untouched) = 54
-    assert len(doc["engines"]["census"]["rows"]) == 54
+    # must leave every collective untouched) = 54, plus the
+    # kv-handoff-wire rows (ISSUE 16: 3 configs x 3 paths — the page
+    # codec is a host boundary, so kv_wire_dtype must move NO
+    # collective) = 63
+    assert len(doc["engines"]["census"]["rows"]) == 63
 
 
 def test_cli_exits_nonzero_on_violation(tmp_path):
