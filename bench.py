@@ -469,19 +469,27 @@ def _bench_serve(loads, *, requests: int, max_batch: int,
 
 
 def _bench_fabric(loads, *, requests: int, max_batch: int,
-                  telemetry_port: int | None = None):
+                  telemetry_port: int | None = None,
+                  vclock: bool = False):
     """Disaggregated-fabric offered-load sweep (``--fabric``): the
     :class:`~flashmoe_tpu.fabric.engine.ServingFabric` driven over
     mocked 1/2/4-replica worlds (``FLASHMOE_MOCK_FABRIC``, set per
     point and restored), one JSON record per (replica count, load
     point) with throughput, TTFT/TPOT percentiles, KV-handoff count and
     modeled DCN cost, and the router's placement histogram.  Host+CPU
-    like ``--serve``; identical procedure on real multi-host serving."""
+    like ``--serve``; identical procedure on real multi-host serving.
+
+    ``vclock`` (``--vclock``): step each point on the fabric's virtual
+    clock behind the front door — TTFT/TPOT are measured UNDER the
+    modeled DCN delay and each record adds the measured-vs-priced
+    handoff fields plus the per-request attribution rollup
+    (docs/OBSERVABILITY.md 'Virtual clock')."""
     from flashmoe_tpu.serving.loadgen import fabric_load_sweep
 
     for rec in fabric_load_sweep(loads, n_requests=requests,
                                  max_batch=max_batch,
-                                 telemetry_port=telemetry_port):
+                                 telemetry_port=telemetry_port,
+                                 vclock=vclock):
         print(json.dumps(rec), flush=True)
         _flush_observability(rec)
 
@@ -1214,6 +1222,13 @@ def main():
                          "handoff): one record per (replicas, load) "
                          "point (see docs/SERVING.md 'Disaggregated "
                          "fabric')")
+    ap.add_argument("--vclock", action="store_true",
+                    help="with --fabric: step the sweep on the "
+                         "fabric's deterministic virtual clock behind "
+                         "the front door — TTFT/TPOT measured under "
+                         "the modeled DCN delay, plus measured-vs-"
+                         "priced handoff reconciliation and per-"
+                         "request latency attribution on every record")
     ap.add_argument("--serve-loads", default="4,2,1",
                     help="comma-separated arrival gaps in engine "
                          "steps, lightest first (smaller = higher "
@@ -1286,6 +1301,10 @@ def main():
         ap.error("--telemetry-port applies with --serve/--fabric only "
                  "(the live scrape plane rides the serving sweeps; the "
                  "train CLIs take their own --telemetry-port)")
+    if args.vclock and not args.fabric:
+        ap.error("--vclock applies with --fabric only (the virtual "
+                 "clock is the fabric's measured-latency plane; every "
+                 "other mode times real work on the wall clock)")
     if args.regression and (args.ckpt or args.overlap or args.sweep
                             or args.tiles or args.quant):
         ap.error("--regression appends measured runs from the "
@@ -1437,7 +1456,8 @@ def main():
         if args.deadline > 0:
             signal.alarm(args.deadline)  # host+CPU path: no probe leg
         _bench_fabric([4, 2, 1], requests=8, max_batch=4,
-                      telemetry_port=args.telemetry_port)
+                      telemetry_port=args.telemetry_port,
+                      vclock=args.vclock)
         _finish_regression()
         return
     if args.tiles:

@@ -31,7 +31,8 @@ from flashmoe_tpu.chaos import inject
 #: the drill matrix: every fault class the ladder claims to survive
 FAULTS = ("nan_expert", "nan_grad", "grad_spike", "slow_step",
           "corrupt_ckpt", "skewed_routing", "path_raise", "preempt",
-          "device_loss", "skew_sustained", "slow_device")
+          "device_loss", "skew_sustained", "slow_device",
+          "dcn_latency", "dcn_jitter")
 
 #: which recovery tier is expected to absorb each fault.  The
 #: ``controller:*`` tiers are the self-healing runtime controller
@@ -51,6 +52,13 @@ EXPECTED_TIER = {
     "device_loss": "tier3:elastic_refold",
     "skew_sustained": "controller:morph",
     "slow_device": "controller:replace",
+    # DCN faults are SERVING faults: they never crash anything — they
+    # stretch handoff transfers on the fabric's virtual clock, and the
+    # recovery claim is observability: the measured-vs-priced monitor
+    # (``fabric.handoff_drift``) must expose the degradation while the
+    # per-request attribution stays exact
+    "dcn_latency": "monitor:handoff_drift",
+    "dcn_jitter": "monitor:handoff_drift",
 }
 
 
@@ -79,8 +87,15 @@ class FaultPlan:
                every pre-existing single-shot drill byte-compatible.
                The self-healing controller's debounce window requires
                sustained faults: a one-step blip must never trigger a
-               morph or re-placement.
-    ``seed``:  reserved for randomized plans; recorded for provenance.
+               morph or re-placement.  For the DCN faults the window is
+               over TRANSFER index, not engine step.
+    ``latency_ms``: extra DCN delay added to every handoff transfer in
+               the window (dcn_latency — a degraded inter-slice link).
+    ``jitter_ms``: upper bound of the deterministic per-transfer jitter
+               (dcn_jitter — crc32 of ``(seed, transfer index)`` maps
+               each transfer to a fraction of this bound).
+    ``seed``:  reserved for randomized plans; recorded for provenance
+               (the dcn_jitter hash consumes it).
     """
 
     fault: str
@@ -91,6 +106,8 @@ class FaultPlan:
     sleep_s: float = 2.0
     once: bool = True
     duration: int = 1
+    latency_ms: float = 0.0
+    jitter_ms: float = 0.0
     seed: int = 0
 
     def __post_init__(self):

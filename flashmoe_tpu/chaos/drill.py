@@ -497,11 +497,137 @@ def _run_controller_drill(fault: str, *, num_steps: int,
         evidence=evidence, decisions=decisions)
 
 
+def _run_vclock_drill(fault: str, *, seed: int) -> DrillResult:
+    """Drill the DCN faults (``dcn_latency`` / ``dcn_jitter``) against
+    the serving fabric's measured-latency plane: a mocked 2-replica
+    fabric steps on a :class:`~flashmoe_tpu.fabric.vclock.VirtualClock`
+    with the plan armed, behind a
+    :class:`~flashmoe_tpu.fabric.frontdoor.FrontDoor`.
+
+    These faults never crash anything — no recovery tier fires.  The
+    claim under drill is OBSERVABILITY (``monitor:handoff_drift``):
+    every perturbed transfer must surface through the
+    ``fabric.handoff_drift`` decisions with ``measured > modeled``,
+    unperturbed transfers must keep reconciling with the priced
+    verdict, the shared tracer must stay contiguous, and every
+    request's critical-path attribution must still sum to its span
+    within the 1% gate — delay injection may stretch latencies, never
+    corrupt the accounting."""
+    import os
+
+    from flashmoe_tpu.fabric import FrontDoor, ServingFabric, VirtualClock
+    from flashmoe_tpu.fabric.topo import ENV_MOCK_FABRIC
+    from flashmoe_tpu.models.transformer import init_params
+    from flashmoe_tpu.serving.engine import ServeConfig
+    from flashmoe_tpu.serving.loadgen import build_requests, tiny_config
+
+    # window over TRANSFER index: skip the first two handoffs so the
+    # drill proves both arms (clean reconciliation AND visible drift)
+    plan = FaultPlan(fault, step=2, duration=6, latency_ms=50.0,
+                     jitter_ms=50.0, seed=seed)
+    clear()
+    cfg = tiny_config()
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    serve = ServeConfig(max_batch=2, page_size=8, num_pages=64,
+                        max_pages_per_slot=4, ctx_bucket_pages=1,
+                        prompt_bucket=8)
+    reqs, arrivals = build_requests(
+        6, vocab=cfg.vocab_size, prompt_len=8, max_new=4, seed=seed,
+        arrival_every=1)
+    metrics = Metrics()
+    saved = os.environ.get(ENV_MOCK_FABRIC)
+    os.environ[ENV_MOCK_FABRIC] = "2"
+    t0 = time.perf_counter()
+    error, door, fab = None, None, None
+    outputs: dict = {}
+    att: dict = {}
+    trace_errors: list = []
+    try:
+        vc = VirtualClock(plan=plan)
+        fab = ServingFabric(params, cfg, serve, metrics_obj=metrics,
+                            vclock=vc)
+        door = FrontDoor(fab)
+        outputs = door.run(reqs, arrivals)
+        att = door.attribution()
+        trace_errors = door.validate()
+    except Exception as e:  # noqa: BLE001 — a drill reports, never dies
+        error = f"{type(e).__name__}: {e}"
+    finally:
+        if door is not None:
+            door.close()
+        if fab is not None:
+            fab.close()
+        if saved is None:
+            os.environ.pop(ENV_MOCK_FABRIC, None)
+        else:
+            os.environ[ENV_MOCK_FABRIC] = saved
+    wall = time.perf_counter() - t0
+
+    decisions = list(metrics.decisions)
+    drift = [d for d in decisions
+             if d["decision"] == "fabric.handoff_drift"]
+    perturbed = [d for d in drift if d["chaos_ms"] > 0]
+    clean = [d for d in drift if d["chaos_ms"] == 0]
+    sums_ok = [a["sum_ok"] for a in att.values()]
+    evidence: dict = {
+        "completed": len(outputs),
+        "handoffs": len([d for d in decisions
+                         if d["decision"] == "fabric.handoff"]),
+        "drift_decisions": len(drift),
+        "perturbed_transfers": len(perturbed),
+        "clean_transfers": len(clean),
+        "max_chaos_ms": (max(d["chaos_ms"] for d in perturbed)
+                         if perturbed else 0.0),
+        "clean_agree": [d["agree"] for d in clean],
+        "attribution_requests": len(att),
+        "attribution_sum_ok": sums_ok,
+        "max_rel_err": (max(a["rel_err"] for a in att.values())
+                        if att else None),
+        "trace_errors": trace_errors,
+        "decision_names": sorted({d["decision"] for d in decisions}),
+    }
+
+    ok, why = True, []
+
+    def need(cond, msg):
+        nonlocal ok
+        if not cond:
+            ok = False
+            why.append(msg)
+
+    need(error is None, f"aborted: {error}")
+    need(len(outputs) == len(reqs),
+         f"only {len(outputs)}/{len(reqs)} requests completed")
+    need(len(drift) == evidence["handoffs"],
+         "not every handoff produced a drift verdict")
+    need(len(perturbed) >= 1, "injected DCN fault never surfaced in "
+                              "fabric.handoff_drift")
+    need(all(d["measured_dcn_ms"] > d["modeled_dcn_ms"]
+             for d in perturbed),
+         "a perturbed transfer measured no slower than priced")
+    need(all(a is not False for a in evidence["clean_agree"]),
+         "an UNperturbed transfer disagreed with the priced verdict")
+    need(not trace_errors, f"tracer lost contiguity: {trace_errors[:3]}")
+    need(att and all(sums_ok),
+         "attribution no longer sums to the request span (1% gate)")
+
+    clear()
+    return DrillResult(
+        fault=fault, expected_tier=EXPECTED_TIER[fault], recovered=ok,
+        reason="; ".join(why), final_step=(fab.step_idx if fab else -1),
+        steps_rerun=0, wall_s=round(wall, 3),
+        evidence=evidence, decisions=decisions)
+
+
 def run_drill(fault: str, *, num_steps: int = 6, checkpoint_every: int = 2,
               workdir: str | None = None, seed: int = 0,
               batch: int = 2) -> DrillResult:
     """Run one fault drill end to end; never raises for a failed drill —
     the result carries the diagnosis instead."""
+    if fault in ("dcn_latency", "dcn_jitter"):
+        # serving-plane faults: drilled against the fabric's virtual
+        # clock, not the training loop (num_steps etc. do not apply)
+        return _run_vclock_drill(fault, seed=seed)
     if fault in ("preempt", "device_loss"):
         return _run_supervised_drill(
             fault, num_steps=num_steps, checkpoint_every=checkpoint_every,

@@ -68,12 +68,22 @@ class ServingFabric:
                  prefill_overrides: dict | None = None,
                  decode_overrides: dict | None = None,
                  metrics_obj=None, controller=None, recorder=None,
-                 telemetry_port=None, affinity: bool = True):
+                 telemetry_port=None, affinity: bool = True,
+                 vclock=None, tracer=None):
+        """``vclock``: a :class:`~flashmoe_tpu.fabric.vclock.
+        VirtualClock` the whole fabric steps on — one lane per replica,
+        tick resolved from the pool plan's decode objective when unset;
+        None (default) is the wall clock, byte-identical to the PR 15
+        paths.  ``tracer``: a shared
+        :class:`~flashmoe_tpu.telemetry_plane.tracing.RequestTracer`
+        every replica reports into (the FrontDoor's trace authority —
+        replicas step sequentially, so one listener is race-free)."""
         self.cfg = cfg
         self.serve = serve if serve is not None else ServeConfig()
         self.metrics = (metrics_obj if metrics_obj is not None
                         else _global_metrics)
         self.controller = controller
+        self.vclock = vclock
 
         devices = jax.devices()
         if replicas is None:
@@ -114,11 +124,20 @@ class ServingFabric:
         # params (the engine-side quant store is a DECODE-pool
         # property), so the handoff sees the same prefill the
         # single-pool engine would run
+        decode_step_ms = (self.pool_plan.decode_ms
+                          if self.pool_plan is not None else None)
+        if self.vclock is not None:
+            # one lane per replica; the decode tick is the pool plan's
+            # per-step objective (what the priced verdict judges
+            # against), so an unperturbed drill reconciles exactly
+            self.vclock.ensure_lanes(self.n_replicas)
+            if self.vclock.tick_ms is None:
+                self.vclock.tick_ms = (decode_step_ms
+                                       if decode_step_ms else 1.0)
         self.handoff = KVHandoff(
             params, prefill_cfg, self.serve.page_size,
             metrics_obj=self.metrics,
-            decode_step_ms=(self.pool_plan.decode_ms
-                            if self.pool_plan is not None else None))
+            decode_step_ms=decode_step_ms, vclock=self.vclock)
 
         # ---- decode replicas -----------------------------------------
         pools_info = (self.pool_plan.snapshot()
@@ -128,7 +147,8 @@ class ServingFabric:
                 params, decode_cfg, self.serve,
                 metrics_obj=self.metrics, recorder=recorder,
                 replica_tag=f"r{i}", prefill_fn=self.handoff.prefill_fn(i),
-                pools_info=pools_info)
+                pools_info=pools_info, clock=self.vclock,
+                tracer=tracer)
             for i in range(self.n_replicas)
         ]
         self.router = ReplicaRouter(
@@ -170,6 +190,8 @@ class ServingFabric:
             "pools": (self.pool_plan.snapshot()
                       if self.pool_plan is not None else None),
             "handoff": self.handoff.snapshot(),
+            "vclock": (self.vclock.snapshot()
+                       if self.vclock is not None else None),
             "router": self.router.snapshot(),
             "engines": [e._vars_snapshot() for e in self.engines],
         }
@@ -201,8 +223,12 @@ class ServingFabric:
         triggered), then the controller observes queue pressure and may
         morph the rotation."""
         recs = []
-        for e in self.engines:
+        for i, e in enumerate(self.engines):
             if e.pending():
+                if self.vclock is not None:
+                    # replica-local virtual time: the real fleet steps
+                    # replicas in parallel, so each gets its own lane
+                    self.vclock.use_lane(i)
                 recs.append(e.step())
         self.step_idx += 1
         if self.controller is not None:
@@ -247,7 +273,7 @@ class ServingFabric:
     def summary(self) -> dict:
         """Merged drill summary: per-replica engine summaries plus the
         fabric's own counters."""
-        return {
+        out = {
             "replicas": self.n_replicas,
             "steps": self.step_idx,
             "handoffs": self.handoff.count,
@@ -256,3 +282,13 @@ class ServingFabric:
             "placement": dict(self._placement),
             "engines": [e.summary() for e in self.engines],
         }
+        if self.vclock is not None:
+            out["handoff_ms_measured"] = round(
+                self.handoff.measured_ms_total, 6)
+            out["handoff_hidden_frac"] = (
+                round(self.handoff.hidden_ms_total
+                      / self.handoff.measured_ms_total, 6)
+                if self.handoff.measured_ms_total > 0 else None)
+            out["handoff_verdicts_agree"] = self.handoff.drift_agree
+            out["handoff_verdicts_total"] = self.handoff.drift_total
+        return out

@@ -1,0 +1,138 @@
+"""The fabric's single front door: one trace/session authority.
+
+Before this module, every fabric client split the request/trace
+namespace per replica UP FRONT (``loadgen.split_requests``) — each
+engine traced its own shard and nobody owned the request's identity
+across the prefill pool, the router, an eviction, or a drain-spill.
+:class:`FrontDoor` closes ROADMAP item 1(c): it wraps a
+:class:`~flashmoe_tpu.fabric.engine.ServingFabric` with
+
+* **one** shared :class:`~flashmoe_tpu.telemetry_plane.tracing.
+  RequestTracer` installed across every replica (they step
+  sequentially on one host thread, so a single listener is race-free)
+  on the fabric's clock (the
+  :class:`~flashmoe_tpu.fabric.vclock.VirtualClock` when armed, wall
+  otherwise) — a request's spans land on ONE track no matter which
+  pools it crossed;
+* **namespace ownership** — a rid submits through the front door at
+  most once (a duplicate raises), and every submit is recorded as a
+  ``frontdoor.submit`` decision carrying the router's placement;
+* **the fleet export** — :meth:`export_fleet_trace` writes ONE
+  ``validate_trace``-gated Perfetto document with a process track per
+  pool and flow arrows linking each request's prefill-pool span to
+  its decode-pool resume
+  (:func:`~flashmoe_tpu.profiler.export.fleet_trace_document`);
+* **attribution** — :meth:`attribution` decomposes every retired
+  request's measured latency into critical-path components
+  (:mod:`flashmoe_tpu.telemetry_plane.attribution`), feeding the
+  per-component ``/metrics`` sketches.
+"""
+
+from __future__ import annotations
+
+import time
+
+from flashmoe_tpu.telemetry_plane.tracing import RequestTracer
+
+
+class FrontDoor:
+    """Trace/session authority over one fabric.  Construct AFTER the
+    fabric (it arms the shared tracer on the fabric's replicas); call
+    :meth:`close` (or close the fabric) when done so the span listener
+    uninstalls."""
+
+    def __init__(self, fabric, *, metrics_obj=None):
+        self.fabric = fabric
+        self.metrics = (metrics_obj if metrics_obj is not None
+                        else fabric.metrics)
+        clock = (fabric.vclock if fabric.vclock is not None
+                 else time.monotonic)
+        self.tracer = RequestTracer(metrics_obj=self.metrics,
+                                    clock=clock)
+        self.tracer.install()
+        for e in fabric.engines:
+            e.tracer = self.tracer
+        self._seen: set = set()
+        self.sessions: dict = {}
+
+    # ---- namespace ----------------------------------------------------
+
+    def submit(self, req, arrival_step: int = 0, *,
+               session=None) -> int:
+        """Submit one request through the front door: route it, record
+        the placement, own its rid.  Returns the chosen replica."""
+        if req.rid in self._seen:
+            raise ValueError(
+                f"rid {req.rid} already submitted through this front "
+                f"door — the trace namespace is owned here, not split "
+                f"per replica")
+        self._seen.add(req.rid)
+        choice = self.fabric.submit(req, arrival_step, session=session)
+        if session is not None:
+            self.sessions.setdefault(session, []).append(req.rid)
+        self.metrics.count("frontdoor.submits")
+        self.metrics.decision(
+            "frontdoor.submit", rid=req.rid, session=session,
+            replica=int(choice), arrival_step=int(arrival_step),
+            submitted=len(self._seen))
+        return choice
+
+    def run(self, requests=None, arrivals=None, *, sessions=None,
+            until=None) -> dict:
+        """Submit ``requests`` through the front door and drive the
+        fabric to completion (the :meth:`ServingFabric.run` twin)."""
+        for idx, req in enumerate(requests or ()):
+            self.submit(req,
+                        int(arrivals[idx]) if arrivals else 0,
+                        session=sessions[idx] if sessions else None)
+        return self.fabric.run(until=until)
+
+    # ---- trace views --------------------------------------------------
+
+    def validate(self) -> list[str]:
+        """The tracer's no-orphan / contiguity gate over the WHOLE
+        fleet's requests (empty = clean)."""
+        return self.tracer.validate()
+
+    def fleet_trace_document(self) -> dict:
+        from flashmoe_tpu.profiler.export import fleet_trace_document
+
+        return fleet_trace_document(self.tracer, self.fabric._placement,
+                                    replicas=self.fabric.n_replicas)
+
+    def export_fleet_trace(self, path: str) -> dict:
+        from flashmoe_tpu.profiler.export import write_fleet_trace
+
+        return write_fleet_trace(self.tracer, self.fabric._placement,
+                                 path, replicas=self.fabric.n_replicas)
+
+    def export_jsonl(self, path: str) -> int:
+        """The fleet's ``serve_trace_span`` records (one shard — the
+        front door owns the namespace, so there is nothing to merge)."""
+        return self.tracer.export_jsonl(path)
+
+    # ---- attribution --------------------------------------------------
+
+    def attribution(self, *, feed_metrics: bool = True) -> dict:
+        """Per-request critical-path attribution for every retired
+        request (``{rid: {components, dominant, sum_ok, ...}}``),
+        spill-aware via the router's ``fabric.route`` decisions.  With
+        ``feed_metrics`` (default) the per-component sketches land on
+        the fabric's metrics object and each request emits a
+        ``serve.attribution`` decision."""
+        from flashmoe_tpu.telemetry_plane.attribution import (
+            attribute_tracer, spilled_rids,
+        )
+
+        spilled = spilled_rids(
+            r for r in self.metrics.decisions
+            if r.get("decision") == "fabric.route")
+        return attribute_tracer(
+            self.tracer, spilled=spilled,
+            metrics_obj=self.metrics if feed_metrics else None)
+
+    def close(self) -> None:
+        self.tracer.uninstall()
+        for e in self.fabric.engines:
+            if e.tracer is self.tracer:
+                e.tracer = None

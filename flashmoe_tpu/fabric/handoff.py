@@ -127,7 +127,7 @@ class KVHandoff:
 
     def __init__(self, params, cfg: MoEConfig, page_size: int, *,
                  wire=None, metrics_obj=None,
-                 decode_step_ms: float | None = None):
+                 decode_step_ms: float | None = None, vclock=None):
         self.params = params
         self.cfg = cfg
         self.page_size = int(page_size)
@@ -140,9 +140,19 @@ class KVHandoff:
         #: must hide under to overlap (PoolPlan.decode_ms); None = not
         #: priced, the overlap verdict is omitted
         self.decode_step_ms = decode_step_ms
+        #: optional :class:`~flashmoe_tpu.fabric.vclock.VirtualClock`:
+        #: every transfer ADVANCES it by the measured DCN cost (modeled
+        #: + chaos), making the overlap verdict a measured quantity —
+        #: reconciled against the priced one per transfer through the
+        #: ``fabric.handoff_drift`` decision
+        self.vclock = vclock
         self.count = 0
         self.bytes_moved = 0
         self.modeled_ms_total = 0.0
+        self.measured_ms_total = 0.0
+        self.hidden_ms_total = 0.0
+        self.drift_agree = 0
+        self.drift_total = 0
 
     def prefill_fn(self, replica: int):
         """The ``ServingEngine(prefill_fn=...)`` seam for one decode
@@ -162,12 +172,19 @@ class KVHandoff:
 
         logits, k_seq, v_seq = _prefill_padded(
             self.params, self.cfg, prompt_padded, jnp.int32(true_len))
+        acct = None
         with trace_span("serve.handoff"):
             payload = encode_kv_run(k_seq, v_seq, self.page_size,
                                     self.wire_dtype)
             k_out, v_out = decode_kv_run(payload, self.cfg.dtype)
-        ms = kv_handoff_ms(self.cfg, payload.pages, self.page_size,
-                           wire=self.wire_dtype)
+            ms = kv_handoff_ms(self.cfg, payload.pages, self.page_size,
+                               wire=self.wire_dtype)
+            if self.vclock is not None:
+                # advance virtual time INSIDE the serve.handoff span:
+                # the request's own prefill span absorbs the DCN wait,
+                # so TTFT is measured UNDER the delay the model priced
+                acct = self.vclock.on_handoff(ms, rid=rid,
+                                              replica=replica)
         self.count += 1
         self.bytes_moved += payload.payload_bytes
         self.modeled_ms_total += ms
@@ -183,14 +200,65 @@ class KVHandoff:
             decode_step_ms=(round(self.decode_step_ms, 6)
                             if self.decode_step_ms is not None else None),
             overlapped=overlapped)
+        if acct is not None:
+            self._reconcile(acct, ms, rid, replica, overlapped)
         return logits, k_out, v_out
+
+    def _reconcile(self, acct: dict, modeled_ms: float, rid,
+                   replica: int, overlapped_priced) -> None:
+        """Measured-vs-priced verdict for one transfer: the virtual
+        clock experienced ``acct`` (modeled + chaos, overlap budget
+        consumed step-wise); the planner priced ``modeled_ms`` against
+        the whole decode tick.  The drift family decision narrates
+        agreement — chaos latency/jitter is exactly what pulls the two
+        apart."""
+        measured = acct["measured_ms"]
+        hidden = acct["hidden_ms"]
+        self.measured_ms_total += measured
+        self.hidden_ms_total += hidden
+        overlapped_measured = bool(acct["exposed_ms"] <= 1e-9)
+        hf_measured = (hidden / measured) if measured > 0 else 1.0
+        hf_priced = None
+        if self.decode_step_ms is not None:
+            hf_priced = (min(modeled_ms, self.decode_step_ms)
+                         / modeled_ms if modeled_ms > 0 else 1.0)
+        agree = (None if overlapped_priced is None
+                 else bool(overlapped_measured == overlapped_priced))
+        self.drift_total += 1
+        if agree:
+            self.drift_agree += 1
+        self.metrics.sketch("fabric.handoff_drift_ms",
+                            measured - modeled_ms)
+        self.metrics.decision(
+            "fabric.handoff_drift", rid=rid, replica=int(replica),
+            modeled_dcn_ms=round(modeled_ms, 6),
+            chaos_ms=acct["chaos_ms"],
+            measured_dcn_ms=round(measured, 6),
+            tick_ms=acct["tick_ms"],
+            hidden_ms=round(hidden, 6),
+            exposed_ms=acct["exposed_ms"],
+            hidden_frac_measured=round(hf_measured, 6),
+            hidden_frac_priced=(round(hf_priced, 6)
+                                if hf_priced is not None else None),
+            overlapped_priced=overlapped_priced,
+            overlapped_measured=overlapped_measured, agree=agree)
 
     def snapshot(self) -> dict:
         """Live ``/vars`` view of the handoff link."""
-        return {
+        out = {
             "wire": self.wire_name,
             "handoffs": self.count,
             "bytes_moved": self.bytes_moved,
             "modeled_ms_total": round(self.modeled_ms_total, 6),
             "decode_step_ms": self.decode_step_ms,
         }
+        if self.vclock is not None:
+            out.update(
+                measured_ms_total=round(self.measured_ms_total, 6),
+                hidden_ms_total=round(self.hidden_ms_total, 6),
+                hidden_fraction=(
+                    round(self.hidden_ms_total / self.measured_ms_total,
+                          6) if self.measured_ms_total > 0 else None),
+                verdicts_agree=self.drift_agree,
+                verdicts_total=self.drift_total)
+        return out

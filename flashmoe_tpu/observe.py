@@ -688,8 +688,20 @@ def trace_report(records: list[dict], rid: int) -> dict:
     in timeline order, eviction gaps flagged, and the totals a latency
     investigation starts from (queue wait vs prefill vs decode-window
     time)."""
-    spans = [r for r in records if r.get("kind") == "serve_trace_span"
-             and r.get("rid") == rid]
+    raw = [r for r in records if r.get("kind") == "serve_trace_span"
+           and r.get("rid") == rid]
+    # merged fleet shards record the SAME span in more than one file
+    # (the prefill pool and the decode pool both witness a handoff):
+    # identical (name, ts, dur, step) rows collapse to one so the
+    # timeline reads contiguous, not twice as long
+    spans, seen = [], set()
+    for s in raw:
+        key = (s.get("name"), s.get("ts_ms"), s.get("dur_ms"),
+               s.get("step"), s.get("resumed"))
+        if key in seen:
+            continue
+        seen.add(key)
+        spans.append(s)
     spans.sort(key=lambda s: s.get("ts_ms", 0.0))
     known = sorted({r.get("rid") for r in records
                     if r.get("kind") == "serve_trace_span"})
@@ -703,6 +715,7 @@ def trace_report(records: list[dict], rid: int) -> dict:
     return {
         "rid": rid,
         "found": bool(spans),
+        "spans_deduped": len(raw) - len(spans),
         "known_rids": known,
         "trace_id": spans[0].get("trace_id") if spans else None,
         "spans": spans,
@@ -727,7 +740,9 @@ def render_trace_text(rep: dict) -> str:
              f"{len(rep['spans'])} spans, {rep['total_ms']} ms end to "
              f"end, {rep['evictions']} eviction(s)"
              + (f" ({rep['eviction_gap_ms']} ms re-queued)"
-                if rep["evictions"] else "")]
+                if rep["evictions"] else "")
+             + (f" [{rep['spans_deduped']} shard-duplicate span(s) "
+                f"collapsed]" if rep.get("spans_deduped") else "")]
     for k, v in rep["phase_ms"].items():
         lines.append(f"  {k:<24s} {v:>10.3f} ms total")
     lines.append("  timeline:")
@@ -767,16 +782,35 @@ def merge_report(paths: list[str]) -> dict:
             info["steps"] = [int(min(steps)), int(max(steps))]
         for r in recs:
             merged.append(dict(r, host=host))
+    # a KV handoff is witnessed by BOTH pools (the prefill side prices
+    # it, the decode side admits its pages): when the shards come from
+    # the two pools the same transfer shows up twice — collapse on the
+    # transfer's identity so fleet counts read per-transfer, not
+    # per-witness
+    deduped, seen, dropped = [], set(), 0
+    for r in merged:
+        if r.get("decision") == "fabric.handoff":
+            key = (r.get("rid"), r.get("replica"), r.get("pages"),
+                   r.get("modeled_dcn_ms"))
+            if key in seen:
+                dropped += 1
+                continue
+            seen.add(key)
+        deduped.append(r)
     return {
         "hosts": hosts,
-        "records": len(merged),
-        "fleet": summarize(merged),
+        "records": len(deduped),
+        "handoffs_deduped": dropped,
+        "fleet": summarize(deduped),
     }
 
 
 def render_merge_text(rep: dict) -> str:
     lines = [f"fleet view: {len(rep['hosts'])} host shard(s), "
-             f"{rep['records']} records"]
+             f"{rep['records']} records"
+             + (f" ({rep['handoffs_deduped']} double-witnessed "
+                f"handoff(s) collapsed)"
+                if rep.get("handoffs_deduped") else "")]
     for host in sorted(rep["hosts"]):
         info = rep["hosts"][host]
         steps = info.get("steps")
@@ -785,6 +819,35 @@ def render_merge_text(rep: dict) -> str:
                         else ""))
     lines.append("")
     lines.append(render_text(rep["fleet"]))
+    return "\n".join(lines)
+
+
+def render_attribution_text(rep: dict) -> str:
+    """``--attribution``: the fleet's latency budget with names on it
+    (:func:`flashmoe_tpu.telemetry_plane.attribution.
+    attribution_report`)."""
+    lines = [f"latency attribution: {rep['requests']} retired "
+             f"request(s)"
+             + (f", {len(rep['spilled'])} spilled off their preferred "
+                f"replica" if rep["spilled"] else "")]
+    if rep["sum_violations"]:
+        lines.append(f"  ** {len(rep['sum_violations'])} request(s) "
+                     f"FAILED the 1% sum gate: "
+                     f"{rep['sum_violations'][:8]}")
+    lines.append("  fleet totals (where the milliseconds went):")
+    for comp, ms in rep["totals_ms"].items():
+        share = rep["shares"].get(comp, 0.0)
+        dom = rep["dominant_counts"].get(comp, 0)
+        lines.append(
+            f"    {comp:<14s} {ms:>10.3f} ms  {share:>6.1%}"
+            + (f"  dominant in {dom}" if dom else ""))
+    lines.append("  per request:")
+    for rid, att in rep["per_request"].items():
+        lines.append(
+            f"    rid={rid:<6} span {att['span_ms']:>10.3f} ms  "
+            f"dominant={att['dominant']}"
+            + ("" if att["sum_ok"]
+               else f"  ** sum off by {att['rel_err']:.1%}"))
     return "\n".join(lines)
 
 
@@ -994,7 +1057,14 @@ def main(argv=None) -> int:
     ap.add_argument("--merge", action="store_true",
                     help="fleet view: treat each input file as one "
                          "host's telemetry shard and summarize the "
-                         "union (telemetry.<host>.jsonl)")
+                         "union (telemetry.<host>.jsonl); handoffs "
+                         "witnessed by both pools collapse to one")
+    ap.add_argument("--attribution", action="store_true",
+                    help="per-request critical-path attribution from "
+                         "serve_trace_span records: where each retired "
+                         "request's latency went (queue wait, router "
+                         "spill, prefill, handoff DCN, decode, "
+                         "eviction gaps) and the fleet rollup")
     ap.add_argument("--regression", action="store_true",
                     help="perf sentry: compare the newest run in the "
                          "history file (default obs/history.jsonl) "
@@ -1009,6 +1079,7 @@ def main(argv=None) -> int:
                              ("--postmortem", bool(args.postmortem)),
                              ("--trace", args.trace is not None),
                              ("--merge", args.merge),
+                             ("--attribution", args.attribution),
                              ("--regression", args.regression)) if on]
     if len(modes) > 1:
         ap.error(f"pick one mode: {' '.join(modes)}")
@@ -1075,6 +1146,18 @@ def main(argv=None) -> int:
         else:
             print(render_trace_text(rep))
         return 0 if rep["found"] else 2
+    if args.attribution:
+        from flashmoe_tpu.telemetry_plane.attribution import (
+            attribution_report,
+        )
+
+        rep = attribution_report(records)
+        if args.json:
+            json.dump(rep, sys.stdout)
+            print()
+        else:
+            print(render_attribution_text(rep))
+        return 0 if rep["requests"] else 2
     if args.ledger:
         led = ledger_report(records)
         if args.json:

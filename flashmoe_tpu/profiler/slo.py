@@ -213,13 +213,17 @@ class SLOWatchdog:
 
     def observe_request(self, step: int, request_id,
                         *, ttft_ms: float | None = None,
-                        tpot_ms: float | None = None) -> list[dict]:
+                        tpot_ms: float | None = None,
+                        dominant: str | None = None) -> list[dict]:
         """Judge one completed serving request against the TTFT/TPOT
         budgets.  Point observations — requests are independent, so
         each violation is its own ``slo.breach`` (target ``ttft`` /
         ``tpot``, with the request id) and there is no recovery pair
         or escalation run: the step budget remains the escalation
-        channel.  Returns the breach records raised."""
+        channel.  ``dominant``: the request's critical-path attribution
+        verdict (telemetry_plane/attribution.py) — carried on the
+        breach so the decision names WHERE the budget went, not just
+        that it went.  Returns the breach records raised."""
         events: list[dict] = []
         for target, measured, budget in (
                 ("ttft", ttft_ms, self.slo.ttft_ms),
@@ -232,7 +236,8 @@ class SLOWatchdog:
                     "slo.breach", target=target, step=int(step),
                     request=request_id,
                     measured_ms=round(float(measured), 3),
-                    budget_ms=float(budget), consecutive=None))
+                    budget_ms=float(budget), consecutive=None,
+                    dominant=dominant))
         return events
 
     def observe_step(self, step: int, step_ms: float,

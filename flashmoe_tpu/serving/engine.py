@@ -525,7 +525,7 @@ class ServingEngine:
                  serve: ServeConfig | None = None, *,
                  recorder=None, slo=None, mesh=None, metrics_obj=None,
                  tracer=None, telemetry_port=None, prefill_fn=None,
-                 replica_tag=None, pools_info=None):
+                 replica_tag=None, pools_info=None, clock=None):
         """``prefill_fn(prompt_padded, true_len, *, rid)`` replaces the
         local prefill when set — the fabric's KV-handoff seam: the
         callable must honor :func:`_prefill_padded`'s contract
@@ -534,7 +534,13 @@ class ServingEngine:
         path only — in a disaggregated fabric long prompts cannot hole
         decode by construction).  ``replica_tag`` (e.g. ``"r0"``)
         additionally keys this engine's TTFT/TPOT sketches per replica;
-        ``pools_info`` is surfaced verbatim in ``/vars``."""
+        ``pools_info`` is surfaced verbatim in ``/vars``.  ``clock``: a
+        zero-arg seconds source replacing ``time.monotonic`` for every
+        latency measurement (arrival, TTFT, TPOT, step time) — a
+        :class:`~flashmoe_tpu.fabric.vclock.VirtualClock` additionally
+        gets its decode tick stepped at the end of every engine step;
+        None (the default) is the wall clock, byte-identical to the
+        pre-seam engine."""
         if cfg.drop_tokens:
             raise ValueError(
                 "the serving engine requires a dropless config "
@@ -552,6 +558,13 @@ class ServingEngine:
         self.metrics = metrics_obj if metrics_obj is not None \
             else _global_metrics
         self.watchdog = _as_watchdog(slo)
+        # ---- measured-latency clock seam -----------------------------
+        # every wall read below goes through self._clock; a VirtualClock
+        # (duck-typed on complete_step) additionally advances its decode
+        # tick at the end of each engine step
+        self._clock = clock if clock is not None else time.monotonic
+        self._vclock = (clock if hasattr(clock, "complete_step")
+                        else None)
         # ---- live telemetry plane (default off = zero threads, no
         # behavior change; outputs are bit-identical either way) ------
         self.tracer = None
@@ -559,7 +572,8 @@ class ServingEngine:
             from flashmoe_tpu.telemetry_plane.tracing import RequestTracer
 
             self.tracer = (tracer if isinstance(tracer, RequestTracer)
-                           else RequestTracer(metrics_obj=self.metrics))
+                           else RequestTracer(metrics_obj=self.metrics,
+                                              clock=self._clock))
             self.tracer.install()
         self.telemetry = None
         if telemetry_port is not None:
@@ -816,7 +830,7 @@ class ServingEngine:
         """Stamp the wall clock on every queue entry whose trace
         arrival step has been reached — the TTFT base.  A future
         arrival accrues no synthetic queue wait."""
-        now = time.monotonic()
+        now = self._clock()
         for entry in self.queue:
             if entry.arrival_s is None \
                     and entry.arrival_step <= self.step_idx:
@@ -956,6 +970,10 @@ class ServingEngine:
             chunk_ids = gpages[first_pg:need_pages]
             rel_last = min(max(t0 - 1 - pos, 0), chunk - 1)
             toks = s.prefill_toks[pos:pos + chunk]
+            if self.tracer is not None:
+                # chunks interleave across slots: re-arm attribution so
+                # the span lands on THIS slot's request track
+                self.tracer.on_prefill_chunk(s.orig.rid)
             with trace_span("serve.prefill_chunk"):
                 logits, kp, vp = _prefill_chunk(
                     self.params, self.cfg,
@@ -1046,7 +1064,7 @@ class ServingEngine:
                     break
 
     def _retire(self, slot: int, s: _Slot) -> None:
-        now = time.monotonic()
+        now = self._clock()
         self._free_slot_pages(slot, s.pages)
         self.slots[slot] = None
         out = (list(s.orig.prompt)
@@ -1090,9 +1108,21 @@ class ServingEngine:
                 rid=s.orig.rid, tokens=n_tok, ttft_ms=ttft_ms,
                 tpot_ms=tpot_ms)
         if self.watchdog is not None:
+            dominant = None
+            if self.tracer is not None:
+                # name the critical-path culprit on any breach this
+                # retirement raises (the track is one closing step-span
+                # short mid-step — good enough to rank components)
+                from flashmoe_tpu.telemetry_plane.attribution import (
+                    attribute_track,
+                )
+
+                att = attribute_track(
+                    self.tracer.request_track(s.orig.rid))
+                dominant = att["dominant"]
             self.watchdog.observe_request(
                 self.step_idx, s.orig.rid, ttft_ms=ttft_ms,
-                tpot_ms=tpot_ms)
+                tpot_ms=tpot_ms, dominant=dominant)
 
     # ---- the engine step ---------------------------------------------
 
@@ -1100,7 +1130,7 @@ class ServingEngine:
         """One engine iteration: admit -> sample/retire -> decode.
         Returns the step's flight record (also appended to the
         recorder when one is attached)."""
-        t0_s = time.monotonic()
+        t0_s = self._clock()
         sv = self.serve
         if self.tracer is not None:
             # open the step window BEFORE admissions: everything in
@@ -1133,7 +1163,7 @@ class ServingEngine:
             toks = np.asarray(_sample_dynamic(
                 self._logits, jnp.asarray(keys),
                 jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps)))
-            now = time.monotonic()
+            now = self._clock()
             for i in active:
                 s = self.slots[i]
                 tok = int(toks[i])
@@ -1187,9 +1217,15 @@ class ServingEngine:
                 self.slots[i].length += 1
 
         # telemetry
+        if self._vclock is not None:
+            # charge the decode tick INSIDE the step window (before
+            # end_step closes it): virtual step duration becomes
+            # max(tick, handoff time), so transfers overlap the tick
+            # and request tracks stay contiguous in virtual time
+            self._vclock.complete_step()
         if self.tracer is not None:
             self.tracer.end_step()
-        step_ms = (time.monotonic() - t0_s) * 1e3
+        step_ms = (self._clock() - t0_s) * 1e3
         n_active = len(self._active())
         qd = len(self.queue)
         occ = self.pool.occupancy

@@ -265,7 +265,8 @@ def fabric_load_sweep(loads, *, replica_counts=(1, 2, 4),
                       prompt_len: int = 8, max_new: int = 6,
                       seed: int = 0, page_size: int = 8,
                       num_pages: int = 64,
-                      telemetry_port: int | None = None) -> list[dict]:
+                      telemetry_port: int | None = None,
+                      vclock: bool = False) -> list[dict]:
     """The ``bench.py --fabric`` sweep: one record per (replica count,
     offered-load point), each driving a fresh
     :class:`~flashmoe_tpu.fabric.engine.ServingFabric` on the mocked
@@ -279,7 +280,18 @@ def fabric_load_sweep(loads, *, replica_counts=(1, 2, 4),
 
     ``telemetry_port`` arms one scrape server for the whole sweep and
     self-scrapes ``/metrics`` mid-drill into each record — the fabric
-    acceptance's live-plane leg."""
+    acceptance's live-plane leg.
+
+    ``vclock`` (``bench.py --fabric --vclock``): each point steps on a
+    :class:`~flashmoe_tpu.fabric.vclock.VirtualClock` behind a
+    :class:`~flashmoe_tpu.fabric.frontdoor.FrontDoor` — requests come
+    from :func:`build_requests` directly (the front door owns the
+    trace namespace; no per-replica pre-split), the TTFT/TPOT
+    percentiles are MEASURED UNDER the modeled DCN delay, and each
+    record adds the measured-vs-priced handoff fields plus the
+    per-request attribution rollup.  The record identity gains a
+    ``vclock`` tag so the perf sentry never baselines virtual-time
+    latencies against wall-clock ones."""
     import os
     import time
 
@@ -318,25 +330,46 @@ def fabric_load_sweep(loads, *, replica_counts=(1, 2, 4),
                 if every < 1:
                     raise ValueError(f"offered-load gap {every} must "
                                      f"be >= 1 engine step")
-                reqs, arrivals = merge_traces(split_requests(
-                    n_requests, replicas=int(k), vocab=cfg.vocab_size,
-                    prompt_len=prompt_len, max_new=max_new, seed=seed,
-                    arrival_every=int(every)))
+                if vclock:
+                    # the front door owns the namespace: ONE global
+                    # trace, no per-replica pre-split of rids/seeds
+                    reqs, arrivals = build_requests(
+                        n_requests, vocab=cfg.vocab_size,
+                        prompt_len=prompt_len, max_new=max_new,
+                        seed=seed, arrival_every=int(every))
+                else:
+                    reqs, arrivals = merge_traces(split_requests(
+                        n_requests, replicas=int(k),
+                        vocab=cfg.vocab_size, prompt_len=prompt_len,
+                        max_new=max_new, seed=seed,
+                        arrival_every=int(every)))
                 mx = Metrics()
                 holder[0] = mx
-                fab = ServingFabric(params, cfg, serve, metrics_obj=mx)
+                vc = door = None
+                if vclock:
+                    from flashmoe_tpu.fabric.frontdoor import FrontDoor
+                    from flashmoe_tpu.fabric.vclock import VirtualClock
+
+                    vc = VirtualClock()
+                fab = ServingFabric(params, cfg, serve, metrics_obj=mx,
+                                    vclock=vc)
+                driver = fab
+                if vclock:
+                    door = FrontDoor(fab)
+                    driver = door
                 t0 = time.monotonic()
                 scrape_rec = None
                 scrape_pause_s = 0.0
                 if server is not None:
-                    fab.run(reqs, arrivals,
-                            until=lambda: "serve.ttft_ms" in mx.sketches)
+                    driver.run(reqs, arrivals,
+                               until=lambda: "serve.ttft_ms"
+                               in mx.sketches)
                     t_pause = time.monotonic()
                     scrape_rec = _scrape_metrics(server)
                     scrape_pause_s = time.monotonic() - t_pause
-                    fab.run()
+                    driver.run()
                 else:
-                    fab.run(reqs, arrivals)
+                    driver.run(reqs, arrivals)
                 wall_s = max(time.monotonic() - t0 - scrape_pause_s,
                              1e-9)
                 s = fab.summary()
@@ -352,6 +385,8 @@ def fabric_load_sweep(loads, *, replica_counts=(1, 2, 4),
                 tpots = [d["tpot_ms"] for d in retires
                          if d.get("tpot_ms") is not None]
                 tag = ",telemetry" if server is not None else ""
+                if vclock:
+                    tag += ",vclock"
                 rec = {
                     "metric": f"fabric_load[replicas={int(k)},"
                               f"every={int(every)},"
@@ -386,6 +421,37 @@ def fabric_load_sweep(loads, *, replica_counts=(1, 2, 4),
                 if scrape_rec is not None:
                     rec["telemetry_scrape"] = scrape_rec
                     rec["telemetry_port"] = server.port
+                if door is not None:
+                    # the measured-latency leg: TTFT/TPOT above are
+                    # VIRTUAL-time numbers (under the priced DCN
+                    # delay); these fields reconcile them against the
+                    # planner's verdicts and the attribution gate
+                    att = door.attribution()
+                    errs = door.validate()
+                    rec["vclock"] = True
+                    rec["tick_ms"] = (round(vc.tick_ms, 6)
+                                      if vc.tick_ms is not None
+                                      else None)
+                    rec["handoff_ms_measured"] = round(
+                        fab.handoff.measured_ms_total, 6)
+                    rec["handoff_hidden_frac"] = (
+                        round(fab.handoff.hidden_ms_total
+                              / fab.handoff.measured_ms_total, 6)
+                        if fab.handoff.measured_ms_total > 0 else None)
+                    rec["handoff_verdicts_agree"] = \
+                        fab.handoff.drift_agree
+                    rec["handoff_verdicts_total"] = \
+                        fab.handoff.drift_total
+                    rec["attribution_sum_ok"] = bool(
+                        att and all(a["sum_ok"] for a in att.values()))
+                    rec["attribution_max_rel_err"] = (
+                        max(a["rel_err"] for a in att.values())
+                        if att else None)
+                    doms = [a["dominant"] for a in att.values()]
+                    rec["attribution_dominant"] = {
+                        d: doms.count(d) for d in sorted(set(doms))}
+                    rec["trace_errors"] = len(errs)
+                    door.close()
                 records.append(rec)
                 fab.close()
     finally:

@@ -202,6 +202,31 @@ KNOBS: tuple[KnobSpec, ...] = (
 
 KNOBS_BY_NAME = {k.name: k for k in KNOBS}
 
+#: serving-plane knobs that live OUTSIDE MoEConfig (constructor seams
+#: on the fabric/engine, not dataclass fields) — documented with the
+#: same KnobSpec vocabulary so docs/OBSERVABILITY.md can cite one
+#: registry, but excluded from :func:`check_knob_coverage`'s
+#: MoEConfig-bidirectional matrix (registering them THERE would flag a
+#: stale row).  Their off-identity story is drilled where they plug in
+#: (tests/test_frontdoor.py's byte-identity gate), not by the jaxpr
+#: invariant engine: a clock never appears in a traced graph.
+SERVING_KNOBS: tuple[KnobSpec, ...] = (
+    KnobSpec(
+        "vclock", off_values=(None,), on={"vclock": "VirtualClock()"},
+        backends=(), changes_graph=False,
+        doc="the fabric's deterministic virtual clock (fabric/"
+            "vclock.py): ServingFabric(vclock=...) steps every replica "
+            "on per-lane virtual time, the KV handoff advances it by "
+            "the measured DCN cost (modeled + chaos), and TTFT/TPOT "
+            "become measured-under-delay numbers reconciled against "
+            "the priced verdicts (fabric.handoff_drift).  Off (None, "
+            "the default) is the wall clock: byte-identical graphs and "
+            "token-bit-equal outputs to the unclocked fabric — the "
+            "clock is a host-side seam that never enters a jit"),
+)
+
+SERVING_KNOBS_BY_NAME = {k.name: k for k in SERVING_KNOBS}
+
 #: fields that select among registered execution paths rather than
 #: toggling graph content; their safety story is config-time validation
 #: (config.py __post_init__) + planner selection tests

@@ -42,12 +42,14 @@ UNIT_TOLERANCE = {
     "ms": 0.15,
     "tokens_per_sec": 0.15,
     "ratio_vs_serialized": 0.15,
+    "hidden_frac": 0.15,
 }
 DEFAULT_TOLERANCE = 0.25
 _DIR = {
     "ms": +1.0,                   # latency: up is worse
     "tokens_per_sec": -1.0,       # throughput: down is worse
     "ratio_vs_serialized": -1.0,  # overlap efficiency: down is worse
+    "hidden_frac": -1.0,          # handoff overlap: less hidden = worse
 }
 
 
@@ -126,6 +128,36 @@ def reference_points(gen: str = "v5e") -> dict[str, dict]:
                 "value": round(rw.cost.weight_bytes / hbm_bs * 1e3, 4),
                 "unit": "ms",
             }
+        # measured-latency plane (ISSUE 17): drive the golden handoff
+        # through the virtual clock itself — first-token latency as a
+        # request EXPERIENCES it (one decode tick with the modeled DCN
+        # transfer overlapping it) and the fleet hidden fraction.
+        # Pure vclock arithmetic over cost-model inputs: deterministic,
+        # and a drift in EITHER the pricing or the clock's
+        # hidden/exposed accounting moves these rows
+        from flashmoe_tpu.fabric.vclock import VirtualClock
+        from flashmoe_tpu.planner.golden import (
+            GOLDEN_KV_PAGE, GOLDEN_KV_PAGES, _predicted_plan,
+        )
+        from flashmoe_tpu.planner.model import kv_handoff_ms
+
+        base = BENCH_CONFIGS[name]
+        tick = _predicted_plan(base, gen, "decode")["total_ms"]
+        ms = kv_handoff_ms(base, GOLDEN_KV_PAGES, GOLDEN_KV_PAGE,
+                           wire=None)
+        vc = VirtualClock(tick_ms=tick)
+        t0 = vc.now_ms()
+        vc.on_handoff(ms)
+        vc.complete_step()
+        points[f"fabric_ttft_vclock_ms[{name},d={GOLDEN_D},{gen}]"] = {
+            "value": round(vc.now_ms() - t0, 4), "unit": "ms",
+        }
+        hf = vc.hidden_fraction()
+        points[f"fabric_handoff_hidden_frac[{name},d={GOLDEN_D},"
+               f"{gen}]"] = {
+            "value": round(hf if hf is not None else 1.0, 4),
+            "unit": "hidden_frac",
+        }
     return points
 
 

@@ -137,8 +137,13 @@ class RequestTracer:
         if self._inner is not None:
             self._inner.span_exit(name, inner_tok)
         now = self._now_ms()
-        if name == "serve.prefill" and self._prefill_rid is not None:
-            self._span(self._prefill_rid, "serve.prefill", t0, now)
+        if name in ("serve.prefill", "serve.prefill_chunk",
+                    "serve.handoff") and self._prefill_rid is not None:
+            # serve.handoff nests inside serve.prefill (the fabric's
+            # KV-page crossing), serve.prefill_chunk is armed per-slot
+            # via on_prefill_chunk — all three attribute to the request
+            # whose prompt is being prefilled
+            self._span(self._prefill_rid, name, t0, now)
         elif name == "serve.decode":
             for rid in self._active_rids:
                 self._span(rid, "serve.decode", t0, now)
@@ -172,6 +177,13 @@ class RequestTracer:
             self._active_rids = self._active_rids + (rid,)
             self._joined_at[rid] = now
             st.steps += 1
+
+    def on_prefill_chunk(self, rid: int) -> None:
+        """Arm prefill attribution for one mid-prefill slot before its
+        ``serve.prefill_chunk`` span — chunked prefills interleave
+        across slots, so the admission-time ``_prefill_rid`` context is
+        stale by the time a later chunk runs."""
+        self._prefill_rid = int(rid)
 
     def on_evict(self, rid: int, step: int) -> None:
         """Eviction re-opens the queued clock: the gap until the

@@ -79,9 +79,17 @@ DECISION_NAMES: dict[str, str] = {
         "a prefill KV run crossed to a decode replica as wire-coded "
         "pages: payload size, modeled DCN cost, and whether it hides "
         "under the decode pool's per-step objective",
+    "fabric.handoff_drift":
+        "measured-vs-priced reconciliation for one KV handoff on the "
+        "virtual clock: measured DCN (modeled + chaos), hidden/exposed "
+        "split against the decode tick, and whether the measured "
+        "overlap verdict agrees with the priced one",
     "fabric.route":
         "the replica router placed a request (session affinity or "
         "join-shortest-queue over live /healthz depths)",
+    "frontdoor.submit":
+        "the fabric front door accepted a request into the fleet-wide "
+        "trace namespace and recorded the router's placement",
     "planner.backend_constraint":
         "auto pick demoted to a backend the config can actually run",
     "planner.drift":
@@ -108,6 +116,11 @@ DECISION_NAMES: dict[str, str] = {
         "a crash postmortem bundle was written (dir, error, step)",
     "serve.admit":
         "the serving engine admitted a request into the decode batch",
+    "serve.attribution":
+        "one retired request's measured latency decomposed into "
+        "critical-path components (queue wait, router spill, prefill, "
+        "handoff DCN, decode, eviction gaps) with the dominant "
+        "contributor named; components sum to the span within 1%",
     "serve.evict":
         "page pressure preempted the youngest request back to the "
         "queue (its pages freed, delivered tokens stand)",

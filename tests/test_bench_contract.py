@@ -637,16 +637,19 @@ def test_cli_regression_wedged_probe_skip_stays_rc0(monkeypatch,
 
 def test_cli_fabric_plumbs_load_sweep(monkeypatch):
     """`bench.py --fabric` hands the fabric sweep its offered loads,
-    request/batch sizes, and the optional live-scrape port."""
+    request/batch sizes, the optional live-scrape port, and the
+    virtual-clock arming."""
     import sys as _sys
 
     import bench
 
     seen = {}
 
-    def fake_fabric(loads, *, requests, max_batch, telemetry_port=None):
+    def fake_fabric(loads, *, requests, max_batch, telemetry_port=None,
+                    vclock=False):
         seen.update(loads=loads, requests=requests,
-                    max_batch=max_batch, telemetry_port=telemetry_port)
+                    max_batch=max_batch, telemetry_port=telemetry_port,
+                    vclock=vclock)
 
     monkeypatch.setattr(bench, "_bench_fabric", fake_fabric)
     monkeypatch.setattr(_sys, "argv",
@@ -654,7 +657,12 @@ def test_cli_fabric_plumbs_load_sweep(monkeypatch):
                          "0", "--deadline", "0"])
     bench.main()
     assert seen == {"loads": [4, 2, 1], "requests": 8, "max_batch": 4,
-                    "telemetry_port": 0}
+                    "telemetry_port": 0, "vclock": False}
+    monkeypatch.setattr(_sys, "argv",
+                        ["bench.py", "--fabric", "--vclock",
+                         "--deadline", "0"])
+    bench.main()
+    assert seen["vclock"] is True and seen["telemetry_port"] is None
 
 
 def test_cli_fabric_flag_exclusivity(monkeypatch, capsys):
@@ -674,6 +682,8 @@ def test_cli_fabric_flag_exclusivity(monkeypatch, capsys):
         ["bench.py", "--fabric", "--wire-dtype", "e4m3"],
         ["bench.py", "--fabric", "--a2a-chunks", "2"],
         ["bench.py", "--telemetry-port", "0"],
+        ["bench.py", "--vclock"],
+        ["bench.py", "--serve", "--vclock"],
     ]
     for argv in cases:
         monkeypatch.setattr(_sys, "argv", argv)

@@ -1,0 +1,485 @@
+"""Measured-latency fabric (PR 17): the front door's trace/session
+authority, the deterministic virtual clock, and per-request SLO
+attribution.
+
+The headline drill is the ISSUE acceptance: the mocked 2-pool x
+2-replica fabric stepping on a :class:`VirtualClock` behind ONE
+:class:`FrontDoor` — every request's spans land on one fleet-wide
+track (``validate_trace``-gated Perfetto document with cross-pool flow
+events), TTFT/TPOT are measured UNDER the modeled DCN handoff delay,
+each transfer's measured hidden/exposed split reconciles with the
+priced overlap verdict (``fabric.handoff_drift``), and the
+critical-path attribution sums to each request's span total within 1%.
+Clocks and names never touch math: the drill is token-bit-equal to the
+plain PR 15 fabric on the same trace.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from flashmoe_tpu.chaos import FaultPlan
+from flashmoe_tpu.fabric import FrontDoor, ServingFabric, VirtualClock
+from flashmoe_tpu.fabric.topo import ENV_MOCK_FABRIC
+from flashmoe_tpu.fabric.vclock import DCN_FAULTS
+from flashmoe_tpu.models.transformer import init_params
+from flashmoe_tpu.profiler.export import validate_trace
+from flashmoe_tpu.serving.engine import Request, ServeConfig
+from flashmoe_tpu.serving.loadgen import (
+    merge_traces, split_requests, tiny_config,
+)
+from flashmoe_tpu.telemetry_plane.attribution import (
+    COMPONENTS, attribute_track,
+)
+from flashmoe_tpu.utils.telemetry import Metrics
+
+CFG = tiny_config()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    return jax.random.randint(jax.random.PRNGKey(1), (8, 8), 0,
+                              CFG.vocab_size)
+
+
+def _requests(prompts, n, max_new=6, **kw):
+    return [Request(rid=i, prompt=tuple(int(t) for t in prompts[i]),
+                    max_new_tokens=max_new, **kw) for i in range(n)]
+
+
+def _serve(**kw):
+    base = dict(max_batch=4, page_size=8, num_pages=8,
+                max_pages_per_slot=4, ctx_bucket_pages=1,
+                prompt_bucket=8)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+# ----------------------------------------------------------------------
+# VirtualClock unit semantics
+# ----------------------------------------------------------------------
+
+def test_vclock_lane_and_hidden_exposed_math():
+    """Per-lane accounting: a transfer hides under the remaining
+    decode-tick budget and exposes the rest; complete_step never
+    double-bills handoff time; lanes are independent."""
+    vc = VirtualClock(tick_ms=2.0, lanes=2)
+    assert vc() == 0.0 and vc.now_ms() == 0.0
+
+    vc.use_lane(0)
+    a = vc.on_handoff(1.5, rid=0, replica=0)
+    assert a["hidden_ms"] == 1.5 and a["exposed_ms"] == 0.0
+    # second transfer in the SAME step: only 0.5 ms of budget left
+    b = vc.on_handoff(1.5, rid=1, replica=0)
+    assert b["hidden_ms"] == 0.5 and b["exposed_ms"] == 1.0
+    # step total = max(tick, handoffs) = 3.0 ms, not tick + handoffs
+    idle = vc.complete_step()
+    assert idle == 0.0
+    assert vc.now_ms() == pytest.approx(3.0)
+
+    # lane 1 never moved; an idle step costs exactly one tick there
+    vc.use_lane(1)
+    assert vc.now_ms() == 0.0
+    vc.complete_step()
+    assert vc.now_ms() == pytest.approx(2.0)
+
+    # rollups
+    assert vc.measured_ms_total == pytest.approx(3.0)
+    assert vc.hidden_ms_total == pytest.approx(2.0)
+    assert vc.hidden_fraction() == pytest.approx(2.0 / 3.0)
+    snap = vc.snapshot()
+    assert snap["lanes"] == 2 and snap["transfers"] == 2
+    assert snap["fault"] is None
+
+    # ensure_lanes grows, use_lane auto-grows
+    vc.use_lane(3)
+    assert len(vc.snapshot()["lane_s"]) == 4
+
+
+def test_vclock_chaos_window_and_determinism():
+    """dcn_latency adds a constant inside the transfer-index window
+    only; dcn_jitter is a seeded crc32 draw — two clocks with the same
+    plan replay bit-identically, a different seed perturbs
+    differently; non-DCN faults are rejected at construction."""
+    plan = FaultPlan("dcn_latency", step=1, duration=2, latency_ms=5.0)
+    vc = VirtualClock(tick_ms=0.0, plan=plan)
+    accts = [vc.on_handoff(1.0) for _ in range(4)]
+    assert [a["chaos_ms"] for a in accts] == [0.0, 5.0, 5.0, 0.0]
+    assert [a["measured_ms"] for a in accts] == [1.0, 6.0, 6.0, 1.0]
+
+    jp = FaultPlan("dcn_jitter", step=0, duration=8, jitter_ms=3.0,
+                   seed=7)
+    v1 = VirtualClock(tick_ms=0.0, plan=jp)
+    v2 = VirtualClock(tick_ms=0.0, plan=jp)
+    c1 = [v1.on_handoff(1.0)["chaos_ms"] for _ in range(8)]
+    c2 = [v2.on_handoff(1.0)["chaos_ms"] for _ in range(8)]
+    assert c1 == c2                          # deterministic replay
+    assert all(0.0 <= c <= 3.0 for c in c1)
+    assert len(set(c1)) > 1                  # actually jitters
+    v3 = VirtualClock(
+        tick_ms=0.0,
+        plan=FaultPlan("dcn_jitter", step=0, duration=8, jitter_ms=3.0,
+                       seed=8))
+    c3 = [v3.on_handoff(1.0)["chaos_ms"] for _ in range(8)]
+    assert c3 != c1                          # seed matters
+
+    with pytest.raises(ValueError, match="dcn_latency"):
+        VirtualClock(plan=FaultPlan("slow_step"))
+    assert set(DCN_FAULTS) == {"dcn_latency", "dcn_jitter"}
+
+
+def test_attribute_track_sum_gate_and_clip():
+    """The decomposition must cover the span: a synthetic track
+    attributes exactly, the TTFT clip (until_ms) re-attributes the
+    prefix, and a router spill reclassifies queue wait."""
+    track = [
+        {"name": "serve.queued", "ts_ms": 0.0, "dur_ms": 2.0,
+         "rid": 0},
+        {"name": "serve.step", "ts_ms": 2.0, "dur_ms": 3.0,
+         "rid": 0},
+        {"name": "serve.prefill", "ts_ms": 2.0, "dur_ms": 3.0,
+         "rid": 0},
+        {"name": "serve.handoff", "ts_ms": 3.0, "dur_ms": 1.0,
+         "rid": 0},
+        {"name": "serve.queued", "ts_ms": 5.0, "dur_ms": 1.0,
+         "rid": 0, "resumed": True},
+        {"name": "serve.step", "ts_ms": 6.0, "dur_ms": 4.0,
+         "rid": 0},
+    ]
+    att = attribute_track(track)
+    assert set(att["components"]) == set(COMPONENTS)
+    assert att["sum_ok"] and att["rel_err"] <= 0.01
+    assert att["span_ms"] == pytest.approx(10.0)
+    assert att["components"]["queue_wait"] == pytest.approx(2.0)
+    assert att["components"]["handoff_dcn"] == pytest.approx(1.0)
+    assert att["components"]["prefill"] == pytest.approx(2.0)
+    assert att["components"]["eviction_gap"] == pytest.approx(1.0)
+    assert att["components"]["decode_steps"] == pytest.approx(4.0)
+    assert att["dominant"] == "decode_steps"
+
+    clipped = attribute_track(track, until_ms=5.0)   # the TTFT prefix
+    assert clipped["sum_ok"]
+    assert clipped["span_ms"] == pytest.approx(5.0)
+    assert clipped["components"]["decode_steps"] == 0.0
+
+    spill = attribute_track(track, spilled=True)
+    assert spill["components"]["router_spill"] == pytest.approx(2.0)
+    assert spill["components"]["queue_wait"] == 0.0
+    assert spill["sum_ok"]
+
+
+# ----------------------------------------------------------------------
+# The 2-pool x 2-replica measured drill (ISSUE acceptance)
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def drill(params, prompts, tmp_path_factory):
+    """Run the PR 15 fabric and the measured (vclock + front door)
+    fabric ONCE on the same trace; every acceptance gate below reads
+    this dict."""
+    old = os.environ.get(ENV_MOCK_FABRIC)
+    os.environ[ENV_MOCK_FABRIC] = "2"
+    try:
+        serve = _serve()
+        arrivals = [0, 0, 0, 0, 1, 1, 2, 3]
+
+        # PR 15 path: no vclock, no front door
+        mx0 = Metrics()
+        fab0 = ServingFabric(params, CFG, serve, metrics_obj=mx0)
+        out0 = fab0.run(_requests(prompts, 8, max_new=10), arrivals)
+        s0 = fab0.summary()
+        fab0.close()
+
+        # measured path: virtual clock behind the front door
+        mx = Metrics()
+        vc = VirtualClock()
+        fab = ServingFabric(params, CFG, serve, metrics_obj=mx,
+                            vclock=vc)
+        door = FrontDoor(fab)
+        out = door.run(_requests(prompts, 8, max_new=10), arrivals)
+        s = fab.summary()
+        att = door.attribution()
+        trace_errors = door.validate()
+        doc = door.fleet_trace_document()
+        shard_dir = tmp_path_factory.mktemp("fleet")
+        n_spans = door.export_jsonl(
+            str(shard_dir / "telemetry.prefill.jsonl"))
+        mx.dump_decisions_jsonl(
+            str(shard_dir / "telemetry.prefill.jsonl"))
+        door.close()
+        fab.close()
+        return {
+            "out0": out0, "s0": s0, "out": out, "s": s, "att": att,
+            "vc": vc, "mx": mx, "doc": doc, "errors": trace_errors,
+            "shard_dir": shard_dir, "n_spans": n_spans,
+        }
+    finally:
+        if old is None:
+            os.environ.pop(ENV_MOCK_FABRIC, None)
+        else:
+            os.environ[ENV_MOCK_FABRIC] = old
+
+
+def test_drill_token_bit_equal_and_off_identity(drill):
+    """The clock and the namespace own time and names, never math:
+    same tokens with and without them — and the OFF path carries no
+    measured keys (the PR 15 summary shape is untouched)."""
+    assert len(drill["out"]) == 8
+    for i in range(8):
+        np.testing.assert_array_equal(np.asarray(drill["out"][i]),
+                                      np.asarray(drill["out0"][i]))
+    assert "handoff_ms_measured" not in drill["s0"]
+    assert "handoff_hidden_frac" not in drill["s0"]
+    # same routing story (the door delegates placement to the router)
+    assert drill["s"]["routed"] == drill["s0"]["routed"]
+    assert drill["s"]["placement"] == drill["s0"]["placement"]
+
+
+def test_drill_measured_summary_and_drift_reconciles(drill):
+    """Every transfer got a measured verdict and the unperturbed drill
+    reconciles: measured hidden/exposed agrees with the priced overlap
+    verdict per transfer, and the summary's hidden fraction is the
+    clock's."""
+    s, vc, mx = drill["s"], drill["vc"], drill["mx"]
+    assert s["handoffs"] >= 1
+    assert s["handoff_ms_measured"] > 0
+    assert s["handoff_verdicts_total"] == s["handoffs"]
+    drift = [d for d in mx.decisions
+             if d["decision"] == "fabric.handoff_drift"]
+    assert len(drift) == s["handoffs"]
+    for d in drift:
+        assert d["measured_dcn_ms"] == pytest.approx(
+            d["modeled_dcn_ms"])          # no chaos armed
+        assert d["chaos_ms"] == 0.0
+        assert d["hidden_ms"] + d["exposed_ms"] == pytest.approx(
+            d["measured_dcn_ms"], abs=1e-6)
+        assert d["agree"] is not False    # measured == priced verdict
+    assert s["handoff_verdicts_agree"] == len(
+        [d for d in drift if d["agree"]])
+    assert s["handoff_hidden_frac"] == pytest.approx(
+        vc.hidden_fraction())
+    # /vars mirrors the clock
+    assert len(vc.transfers) == s["handoffs"]
+
+
+def test_drill_attribution_sums_within_gate(drill):
+    """Per-request critical-path attribution: every retired request
+    decomposes into the six components and sums to its span total
+    within the 1% gate; a dominant contributor is always named and
+    rides the serve.attribution decision + /metrics sketches."""
+    att, mx = drill["att"], drill["mx"]
+    assert set(att) == set(range(8))
+    for rid, a in att.items():
+        assert a["sum_ok"], (rid, a)
+        assert a["dominant"] in COMPONENTS
+        assert a["components"]["handoff_dcn"] >= 0.0
+    decs = [d for d in mx.decisions
+            if d["decision"] == "serve.attribution"]
+    assert len(decs) == 8
+    assert all(d["sum_ok"] for d in decs)
+    assert any(k.startswith("serve.attr.") for k in mx.sketches)
+    # the front door owned every submit
+    subs = [d for d in mx.decisions
+            if d["decision"] == "frontdoor.submit"]
+    assert len(subs) == 8
+    assert subs[-1]["submitted"] == 8
+
+
+def test_drill_fleet_trace_document_valid_with_flows(drill):
+    """ONE Perfetto document for the whole fleet: validate_trace-clean,
+    a process track per replica, and explicit 's'/'f' flow events
+    linking the prefill-pool span to the decode-pool resume of each
+    handed-off request."""
+    assert drill["errors"] == []          # tracer contiguity gate
+    doc = drill["doc"]
+    assert validate_trace(doc) == []
+    evs = doc["traceEvents"]
+    procs = [e for e in evs if e.get("ph") == "M"
+             and e.get("name") == "process_name"]
+    assert len({e["pid"] for e in procs}) >= 2
+    starts = [e for e in evs if e.get("ph") == "s"]
+    finishes = [e for e in evs if e.get("ph") == "f"]
+    assert starts and finishes
+    # every flow id pairs up, and at least one crosses processes
+    by_id = {}
+    for e in starts + finishes:
+        by_id.setdefault(e["id"], []).append(e)
+    assert all(len(v) >= 2 for v in by_id.values())
+    assert any(len({e["pid"] for e in v}) == 2 for v in by_id.values())
+
+
+def test_drill_duplicate_rid_rejected(params, prompts, monkeypatch):
+    """The namespace is owned at the door: a rid submits at most once."""
+    monkeypatch.setenv(ENV_MOCK_FABRIC, "2")
+    fab = ServingFabric(params, CFG, _serve(num_pages=32),
+                        metrics_obj=Metrics())
+    door = FrontDoor(fab)
+    try:
+        reqs = _requests(prompts, 2, max_new=4)
+        door.submit(reqs[0])
+        with pytest.raises(ValueError, match="already submitted"):
+            door.submit(reqs[0])
+        door.submit(reqs[1], session="s0")
+        assert door.sessions == {"s0": [1]}
+        while fab.pending():
+            fab.step()
+    finally:
+        door.close()
+        fab.close()
+
+
+def test_frontdoor_token_bit_equal_to_presplit(params, prompts,
+                                               monkeypatch):
+    """Satellite gate: the SAME merged pre-split trace driven through
+    the plain fabric (the loadgen pre-split path) and through the
+    front door yields token-bit-equal outputs — adopting the door
+    changes ownership, not results."""
+    monkeypatch.setenv(ENV_MOCK_FABRIC, "2")
+    reqs, arrivals = merge_traces(split_requests(
+        4, replicas=2, vocab=CFG.vocab_size, prompt_len=8, max_new=5,
+        seed=3, arrival_every=1))
+    serve = _serve(num_pages=32)
+
+    fab0 = ServingFabric(params, CFG, serve, metrics_obj=Metrics())
+    out0 = fab0.run(reqs, arrivals)
+    fab0.close()
+
+    fab1 = ServingFabric(params, CFG, serve, metrics_obj=Metrics())
+    door = FrontDoor(fab1)
+    out1 = door.run(reqs, arrivals)
+    door.close()
+    fab1.close()
+
+    assert sorted(out0) == sorted(out1)
+    for rid in out0:
+        np.testing.assert_array_equal(np.asarray(out0[rid]),
+                                      np.asarray(out1[rid]))
+
+
+# ----------------------------------------------------------------------
+# Measured golden gate: fp8 flips the verdict on MEASURED numbers
+# ----------------------------------------------------------------------
+
+def test_measured_fp8_flips_golden_verdict():
+    """Re-run the frozen golden fabric points through an actual
+    VirtualClock (tick = the golden decode step, one handoff of the
+    priced cost): the measured verdict (exposed == 0) must equal the
+    priced one for every (config, gen, wire), and the fp8 page wire
+    must flip at least one verdict ON MEASURED NUMBERS — the PR 15
+    pricing property, now experienced."""
+    from flashmoe_tpu.planner.golden import GOLDEN_PATH
+
+    with open(GOLDEN_PATH) as f:
+        fabric = json.load(f)["fabric"]
+    flipped = 0
+    for name, gens in fabric.items():
+        for gen, point in gens.items():
+            tick = point["decode_plan"]["total_ms"]
+            measured = {}
+            for tag, w in point["wires"].items():
+                vc = VirtualClock(tick_ms=tick)
+                acct = vc.on_handoff(w["handoff_ms"])
+                vc.complete_step()
+                overlapped_measured = acct["exposed_ms"] <= 1e-9
+                assert overlapped_measured == w["overlapped"], (
+                    name, gen, tag)
+                # the step stretched by exactly the exposed remainder
+                assert vc.now_ms() == pytest.approx(
+                    max(tick, w["handoff_ms"]), abs=1e-6)
+                measured[tag] = overlapped_measured
+            if measured["e4m3"] and not measured["off"]:
+                flipped += 1
+    assert flipped >= 1, (
+        "no golden point where the fp8 page wire flips the MEASURED "
+        "handoff verdict — the virtual clock lost the pricing's teeth")
+
+
+# ----------------------------------------------------------------------
+# Chaos: the DCN faults drill through the measured plane
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_dcn_latency_drill_recovers():
+    """One matrix row of the chaos drill (slow, like every drill
+    test): the dcn_latency fault perturbs transfers, the drift
+    decisions carry measured > modeled inside the window, attribution
+    stays sum-gated, and the drill self-verifies."""
+    from flashmoe_tpu.chaos.drill import run_drill
+
+    res = run_drill("dcn_latency", seed=0)
+    assert res.recovered, res.evidence
+    assert res.evidence["perturbed_transfers"] >= 1
+    assert res.evidence["handoffs"] == res.evidence["drift_decisions"]
+    assert all(res.evidence["attribution_sum_ok"])
+
+
+# ----------------------------------------------------------------------
+# observe: fleet-shard dedupe + --attribution
+# ----------------------------------------------------------------------
+
+@pytest.fixture()
+def shards(drill, tmp_path):
+    """Two pool shards that both witnessed the drill (the decode shard
+    is a byte-copy of the prefill one — the double-witness worst
+    case)."""
+    src = drill["shard_dir"] / "telemetry.prefill.jsonl"
+    dst = tmp_path / "telemetry.decode.jsonl"
+    dst.write_text(src.read_text())
+    return [str(src), str(dst)]
+
+
+def test_observe_trace_dedupes_fleet_shards(shards):
+    from flashmoe_tpu.observe import (
+        load_jsonl, render_trace_text, trace_report,
+    )
+
+    recs = load_jsonl(shards)
+    rep = trace_report(recs, 1)
+    assert rep["found"]
+    assert rep["spans_deduped"] == len(rep["spans"])   # exact doubles
+    names = {s["name"] for s in rep["spans"]}
+    assert "serve.prefill" in names
+    assert "shard-duplicate span(s) collapsed" in render_trace_text(rep)
+    # a single shard has nothing to collapse
+    one = trace_report(load_jsonl(shards[:1]), 1)
+    assert one["spans_deduped"] == 0
+    assert len(one["spans"]) == len(rep["spans"])
+
+
+def test_observe_merge_dedupes_double_witnessed_handoffs(shards,
+                                                        drill):
+    from flashmoe_tpu.observe import merge_report, render_merge_text
+
+    rep = merge_report(shards)
+    assert set(rep["hosts"]) == {"prefill", "decode"}
+    assert rep["handoffs_deduped"] == drill["s"]["handoffs"]
+    assert "double-witnessed handoff(s) collapsed" in \
+        render_merge_text(rep)
+
+
+def test_observe_attribution_report_matches_door(shards, drill):
+    """The offline report over exported (double-witnessed) shards
+    reproduces the live door's attribution: same requests, same
+    dominants, all sum-gated."""
+    from flashmoe_tpu.observe import load_jsonl, render_attribution_text
+    from flashmoe_tpu.telemetry_plane.attribution import (
+        attribution_report,
+    )
+
+    rep = attribution_report(load_jsonl(shards))
+    assert rep["requests"] == 8 and not rep["sum_violations"]
+    for rid, a in rep["per_request"].items():
+        live = drill["att"][rid]
+        assert a["dominant"] == live["dominant"]
+        assert a["span_ms"] == pytest.approx(live["span_ms"])
+    text = render_attribution_text(rep)
+    assert "latency attribution: 8 retired request(s)" in text
+    assert "dominant" in text

@@ -460,8 +460,15 @@ def test_reference_points_deterministic():
 
     a, b = reg.reference_points(), reg.reference_points()
     assert a == b and len(a) >= 3
-    assert all(v["unit"] == "ms" and v["value"] > 0
+    assert all(v["unit"] in ("ms", "hidden_frac") and v["value"] > 0
                for v in a.values())
+    # the measured-latency plane rides along (PR 17): a virtual-clock
+    # TTFT and a hidden-fraction point per golden config
+    assert any(k.startswith("fabric_ttft_vclock_ms[") and
+               v["unit"] == "ms" for k, v in a.items())
+    assert any(k.startswith("fabric_handoff_hidden_frac[") and
+               v["unit"] == "hidden_frac" and 0 < v["value"] <= 1.0
+               for k, v in a.items())
 
 
 def test_check_regression_zero_baseline_direction_aware():
