@@ -494,6 +494,21 @@ def _bench_fabric(loads, *, requests: int, max_batch: int,
         _flush_observability(rec)
 
 
+def _bench_fabric_faults():
+    """Serving fault-tolerance sweep (``--fabric --faults``): every
+    fault on the serving recovery ladder drilled end to end against a
+    mocked 2-replica fabric, one JSON record per fault with recovery
+    latency, migrated-request count, handoff retry/corrupt totals and
+    the trace-contiguity verdict, plus one brownout record whose
+    headline value is the shed fraction.  Host+CPU like ``--fabric``;
+    identical drills on real multi-host serving."""
+    from flashmoe_tpu.serving.loadgen import fabric_fault_sweep
+
+    for rec in fabric_fault_sweep():
+        print(json.dumps(rec), flush=True)
+        _flush_observability(rec)
+
+
 def _bench_overlap(ep: int, trials: int, *, path: str | None = None,
                    wire_dtype: str | None = None,
                    wire_combine: str | None = None,
@@ -1229,6 +1244,15 @@ def main():
                          "the modeled DCN delay, plus measured-vs-"
                          "priced handoff reconciliation and per-"
                          "request latency attribution on every record")
+    ap.add_argument("--faults", action="store_true",
+                    help="with --fabric: run the serving fault-"
+                         "tolerance sweep instead of the load sweep — "
+                         "one record per chaos fault (replica_crash / "
+                         "handoff_corrupt / handoff_timeout / "
+                         "frontdoor_loss) with recovery latency, "
+                         "migrated-request count, retry totals and "
+                         "shed fraction (docs/RESILIENCE.md "
+                         "'Serving-side ladder')")
     ap.add_argument("--serve-loads", default="4,2,1",
                     help="comma-separated arrival gaps in engine "
                          "steps, lightest first (smaller = higher "
@@ -1305,6 +1329,16 @@ def main():
         ap.error("--vclock applies with --fabric only (the virtual "
                  "clock is the fabric's measured-latency plane; every "
                  "other mode times real work on the wall clock)")
+    if args.faults and not args.fabric:
+        ap.error("--faults applies with --fabric only (the fault "
+                 "sweep drills the serving fabric's recovery ladder; "
+                 "no other mode owns those faults)")
+    if args.faults and args.vclock:
+        ap.error("--faults already steps every drill on the virtual "
+                 "clock; drop --vclock")
+    if args.faults and args.telemetry_port is not None:
+        ap.error("--faults drives self-contained chaos drills with "
+                 "no live scrape window; drop --telemetry-port")
     if args.regression and (args.ckpt or args.overlap or args.sweep
                             or args.tiles or args.quant):
         ap.error("--regression appends measured runs from the "
@@ -1320,6 +1354,8 @@ def main():
     headline_metric = (f"fused_tiles_ms[{args.config}]" if args.tiles
                        else f"quant_ms[{args.config}]" if args.quant
                        else "scaling_ms[slices]" if args.scaling
+                       else "fabric_fault[matrix]"
+                       if (args.fabric and args.faults)
                        else "fabric_tokens_per_sec[replicas]"
                        if args.fabric
                        else f"moe_layer_fwd_ms[{args.config}]")
@@ -1455,9 +1491,12 @@ def main():
                 emit_error(info)
         if args.deadline > 0:
             signal.alarm(args.deadline)  # host+CPU path: no probe leg
-        _bench_fabric([4, 2, 1], requests=8, max_batch=4,
-                      telemetry_port=args.telemetry_port,
-                      vclock=args.vclock)
+        if args.faults:
+            _bench_fabric_faults()
+        else:
+            _bench_fabric([4, 2, 1], requests=8, max_batch=4,
+                          telemetry_port=args.telemetry_port,
+                          vclock=args.vclock)
         _finish_regression()
         return
     if args.tiles:

@@ -32,7 +32,9 @@ from flashmoe_tpu.chaos import inject
 FAULTS = ("nan_expert", "nan_grad", "grad_spike", "slow_step",
           "corrupt_ckpt", "skewed_routing", "path_raise", "preempt",
           "device_loss", "skew_sustained", "slow_device",
-          "dcn_latency", "dcn_jitter")
+          "dcn_latency", "dcn_jitter",
+          "replica_crash", "handoff_corrupt", "handoff_timeout",
+          "frontdoor_loss")
 
 #: which recovery tier is expected to absorb each fault.  The
 #: ``controller:*`` tiers are the self-healing runtime controller
@@ -59,6 +61,16 @@ EXPECTED_TIER = {
     # per-request attribution stays exact
     "dcn_latency": "monitor:handoff_drift",
     "dcn_jitter": "monitor:handoff_drift",
+    # the serving fault-tolerance ladder (docs/RESILIENCE.md
+    # "Serving-side ladder"): a crashed decode replica's requests
+    # MIGRATE via deterministic re-prefill; a corrupt or timed-out KV
+    # handoff is caught by the transport's per-page CRC32 verify /
+    # deadline and RETRIED with capped backoff; a dead front-door peer
+    # fails its namespace leases over to the survivors
+    "replica_crash": "fabric:migrate",
+    "handoff_corrupt": "fabric:handoff_retry",
+    "handoff_timeout": "fabric:handoff_retry",
+    "frontdoor_loss": "fabric:frontdoor_failover",
 }
 
 
@@ -70,7 +82,10 @@ class FaultPlan:
     ``step``:  the step index the fault fires at (host faults fire when
                the training loop reaches it; the in-graph gradient
                faults compare against the traced ``state.step``).
-    ``expert``: target expert for nan_expert / skewed_routing.
+    ``expert``: target expert for nan_expert / skewed_routing; doubles
+               as the target REPLICA for replica_crash (``expert %
+               n_replicas``) and the dying front-door PEER for
+               frontdoor_loss.
     ``scale``: gradient multiplier for grad_spike.
     ``bias``:  router logit bias for skewed_routing.
     ``sleep_s``: stall duration for slow_step (must exceed the
@@ -87,8 +102,12 @@ class FaultPlan:
                every pre-existing single-shot drill byte-compatible.
                The self-healing controller's debounce window requires
                sustained faults: a one-step blip must never trigger a
-               morph or re-placement.  For the DCN faults the window is
-               over TRANSFER index, not engine step.
+               morph or re-placement.  For the DCN faults AND the
+               handoff transport faults (handoff_corrupt /
+               handoff_timeout) the window is over TRANSFER index, not
+               engine step; with ``once`` a faulted transfer's retry
+               is clean (exactly one retry), with ``once=False`` every
+               attempt fails until the retry budget gives up.
     ``latency_ms``: extra DCN delay added to every handoff transfer in
                the window (dcn_latency — a degraded inter-slice link).
     ``jitter_ms``: upper bound of the deterministic per-transfer jitter

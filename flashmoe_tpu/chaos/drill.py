@@ -619,6 +619,197 @@ def _run_vclock_drill(fault: str, *, seed: int) -> DrillResult:
         evidence=evidence, decisions=decisions)
 
 
+def _run_fabric_fault_drill(fault: str, *, seed: int) -> DrillResult:
+    """Drill the serving fault-tolerance ladder (ISSUE 18): a mocked
+    2-replica fabric behind a front door, with ONE of the serving
+    faults armed —
+
+    * ``replica_crash``    — a decode replica dies silently at a fabric
+      step; the health probes detect it and every victim MIGRATES to a
+      survivor via deterministic re-prefill (``fabric:migrate``);
+    * ``handoff_corrupt``  — a KV transfer's bytes flip on the wire;
+      the per-page CRC32 verify refuses them and the transport retries
+      exactly once (``fabric:handoff_retry``);
+    * ``handoff_timeout``  — a transfer stalls past the deadline; same
+      retry tier, reason ``timeout``;
+    * ``frontdoor_loss``   — a front-door PEER dies mid-run; its
+      namespace leases fail over to the survivors with bumped epochs
+      (``fabric:frontdoor_failover``).
+
+    Recovery must be INVISIBLE to the tokens: every request completes
+    with a token stream bit-equal to an uninterrupted single-pool
+    engine on the same trace, the shared tracer stays orphan-free
+    through the transition, the post-failure fleet Perfetto document
+    still validates, and retry/migration costs are reconciled through
+    the virtual clock (the ``fabric.handoff_drift`` family)."""
+    import os
+
+    from flashmoe_tpu.fabric import (
+        FrontDoor, FrontDoorCluster, HandoffTransport, ServingFabric,
+        VirtualClock,
+    )
+    from flashmoe_tpu.fabric.topo import ENV_MOCK_FABRIC
+    from flashmoe_tpu.models.transformer import init_params
+    from flashmoe_tpu.serving.engine import ServeConfig, ServingEngine
+    from flashmoe_tpu.serving.loadgen import build_requests, tiny_config
+
+    clear()
+    cfg = tiny_config()
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    serve = ServeConfig(max_batch=2, page_size=8, num_pages=64,
+                        max_pages_per_slot=4, ctx_bucket_pages=1,
+                        prompt_bucket=8)
+    reqs, arrivals = build_requests(
+        6, vocab=cfg.vocab_size, prompt_len=8, max_new=4, seed=seed,
+        arrival_every=1)
+
+    # the uninterrupted single-pool run the recovery must be bit-equal
+    # to (same module-level jits, same seeded trace)
+    eng = ServingEngine(params, cfg, serve, metrics_obj=Metrics())
+    baseline = eng.run(reqs, arrivals)
+    eng.close()
+
+    metrics = Metrics()
+    saved = os.environ.get(ENV_MOCK_FABRIC)
+    os.environ[ENV_MOCK_FABRIC] = "2"
+    t0 = time.perf_counter()
+    error, fab, door, cluster, transport = None, None, None, None, None
+    outputs: dict = {}
+    att: dict = {}
+    trace_errors: list = []
+    fleet_doc: dict = {}
+    try:
+        vc = VirtualClock()
+        if fault in ("handoff_corrupt", "handoff_timeout"):
+            # window over TRANSFER index, first attempt only (once):
+            # two faulted transfers, each retried exactly once
+            transport = HandoffTransport(
+                metrics_obj=metrics,
+                plan=FaultPlan(fault, step=2, duration=2, seed=seed))
+            fab = ServingFabric(params, cfg, serve, metrics_obj=metrics,
+                                vclock=vc, transport=transport)
+            door = FrontDoor(fab)
+            outputs = door.run(reqs, arrivals)
+        elif fault == "replica_crash":
+            fab = ServingFabric(
+                params, cfg, serve, metrics_obj=metrics, vclock=vc,
+                fault_plan=FaultPlan(fault, step=3, expert=0,
+                                     seed=seed))
+            door = FrontDoor(fab)
+            outputs = door.run(reqs, arrivals)
+        elif fault == "frontdoor_loss":
+            fab = ServingFabric(params, cfg, serve, metrics_obj=metrics,
+                                vclock=vc)
+            cluster = FrontDoorCluster(fab, n_doors=2, n_shards=8,
+                                       metrics_obj=metrics)
+            outputs = cluster.run(reqs, arrivals, fail_at=2,
+                                  fail_peer=0)
+        else:
+            raise ValueError(f"not a fabric fault: {fault!r}")
+        authority = cluster if cluster is not None else door
+        trace_errors = authority.validate()
+        fleet_doc = authority.fleet_trace_document()
+        if door is not None:
+            att = door.attribution()
+    except Exception as e:  # noqa: BLE001 — a drill reports, never dies
+        error = f"{type(e).__name__}: {e}"
+    finally:
+        if door is not None:
+            door.close()
+        if cluster is not None:
+            cluster.close()
+        if fab is not None:
+            fab.close()
+        if saved is None:
+            os.environ.pop(ENV_MOCK_FABRIC, None)
+        else:
+            os.environ[ENV_MOCK_FABRIC] = saved
+    wall = time.perf_counter() - t0
+
+    decisions = list(metrics.decisions)
+
+    def named(name):
+        return [d for d in decisions if d["decision"] == name]
+
+    bit_equal = (sorted(outputs) == sorted(baseline)
+                 and all(outputs[r] == baseline[r] for r in baseline))
+    drift = named("fabric.handoff_drift")
+    retried_drift = [d for d in drift if d.get("retry_ms", 0) > 0]
+    sums_ok = [a["sum_ok"] for a in att.values()]
+    evidence: dict = {
+        "completed": len(outputs),
+        "bit_equal_to_baseline": bit_equal,
+        "handoffs": len(named("fabric.handoff")),
+        "retries": len(named("fabric.handoff_retry")),
+        "corrupt": len(named("fabric.handoff_corrupt")),
+        "migrations": len(named("fabric.migrate")),
+        "crashes": len(named("fabric.replica_crash")),
+        "failovers": len(named("frontdoor.failover")),
+        "retried_drift": len(retried_drift),
+        "trace_errors": trace_errors,
+        "fleet_trace_events": len(fleet_doc.get("traceEvents", [])),
+        "attribution_requests": len(att),
+        "attribution_sum_ok": sums_ok,
+        "decision_names": sorted({d["decision"] for d in decisions}),
+    }
+
+    ok, why = True, []
+
+    def need(cond, msg):
+        nonlocal ok
+        if not cond:
+            ok = False
+            why.append(msg)
+
+    need(error is None, f"aborted: {error}")
+    need(len(outputs) == len(reqs),
+         f"only {len(outputs)}/{len(reqs)} requests completed")
+    need(bit_equal, "a recovered request's token stream diverged from "
+                    "the uninterrupted single-pool run")
+    need(not trace_errors,
+         f"tracer lost contiguity across the failure: "
+         f"{trace_errors[:3]}")
+    need(evidence["fleet_trace_events"] > 0,
+         "post-failure fleet Perfetto document is empty")
+    if fault == "replica_crash":
+        need(evidence["crashes"] == 1,
+             "the crash was never detected")
+        need(evidence["migrations"] >= 1,
+             "no request migrated off the dead replica")
+    elif fault in ("handoff_corrupt", "handoff_timeout"):
+        retries = named("fabric.handoff_retry")
+        need(len(retries) == 2,
+             f"expected exactly one retry per faulted transfer "
+             f"(2 total), saw {len(retries)}")
+        want_reason = ("corrupt" if fault == "handoff_corrupt"
+                       else "timeout")
+        need(all(d["reason"] == want_reason for d in retries),
+             f"retry reasons {[d['reason'] for d in retries]} != "
+             f"{want_reason}")
+        if fault == "handoff_corrupt":
+            need(evidence["corrupt"] == 2,
+                 "CRC verify never named the corrupted pages")
+        need(len(retried_drift) == 2,
+             "retry cost never reconciled through the vclock "
+             "(fabric.handoff_drift retry_ms)")
+        need(att and all(sums_ok),
+             "attribution no longer sums to the request span")
+    elif fault == "frontdoor_loss":
+        fo = named("frontdoor.failover")
+        need(len(fo) >= 1, "no lease failed over off the dead peer")
+        need(all(d["epoch"] >= 1 for d in fo),
+             "a failover did not bump its lease epoch")
+        need(all(d["to_peer"] != 0 for d in fo),
+             "a lease failed over TO the dead peer")
+
+    clear()
+    return DrillResult(
+        fault=fault, expected_tier=EXPECTED_TIER[fault], recovered=ok,
+        reason="; ".join(why), final_step=(fab.step_idx if fab else -1),
+        steps_rerun=0, wall_s=round(wall, 3),
+        evidence=evidence, decisions=decisions)
+
+
 def run_drill(fault: str, *, num_steps: int = 6, checkpoint_every: int = 2,
               workdir: str | None = None, seed: int = 0,
               batch: int = 2) -> DrillResult:
@@ -628,6 +819,11 @@ def run_drill(fault: str, *, num_steps: int = 6, checkpoint_every: int = 2,
         # serving-plane faults: drilled against the fabric's virtual
         # clock, not the training loop (num_steps etc. do not apply)
         return _run_vclock_drill(fault, seed=seed)
+    if fault in ("replica_crash", "handoff_corrupt", "handoff_timeout",
+                 "frontdoor_loss"):
+        # the serving fault-tolerance ladder: drilled against a mocked
+        # 2-replica fabric, recovery judged by token bit-equality
+        return _run_fabric_fault_drill(fault, seed=seed)
     if fault in ("preempt", "device_loss"):
         return _run_supervised_drill(
             fault, num_steps=num_steps, checkpoint_every=checkpoint_every,

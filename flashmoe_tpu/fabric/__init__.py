@@ -21,16 +21,22 @@ seeded trace (tests/test_fabric.py's acceptance drill).
 """
 
 from flashmoe_tpu.fabric.engine import ServingFabric
-from flashmoe_tpu.fabric.frontdoor import FrontDoor
+from flashmoe_tpu.fabric.frontdoor import FrontDoor, FrontDoorCluster
 from flashmoe_tpu.fabric.handoff import (
     KVHandoff, decode_kv_run, encode_kv_run,
 )
 from flashmoe_tpu.fabric.router import ReplicaRouter
 from flashmoe_tpu.fabric.topo import fabric_world
+from flashmoe_tpu.fabric.transport import (
+    HandoffTransport, HandoffTransportError,
+)
 from flashmoe_tpu.fabric.vclock import VirtualClock
 
 __all__ = [
     "FrontDoor",
+    "FrontDoorCluster",
+    "HandoffTransport",
+    "HandoffTransportError",
     "KVHandoff",
     "ReplicaRouter",
     "ServingFabric",

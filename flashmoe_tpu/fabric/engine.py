@@ -69,7 +69,8 @@ class ServingFabric:
                  decode_overrides: dict | None = None,
                  metrics_obj=None, controller=None, recorder=None,
                  telemetry_port=None, affinity: bool = True,
-                 vclock=None, tracer=None):
+                 vclock=None, tracer=None, transport=None,
+                 fault_plan=None):
         """``vclock``: a :class:`~flashmoe_tpu.fabric.vclock.
         VirtualClock` the whole fabric steps on — one lane per replica,
         tick resolved from the pool plan's decode objective when unset;
@@ -77,7 +78,22 @@ class ServingFabric:
         paths.  ``tracer``: a shared
         :class:`~flashmoe_tpu.telemetry_plane.tracing.RequestTracer`
         every replica reports into (the FrontDoor's trace authority —
-        replicas step sequentially, so one listener is race-free)."""
+        replicas step sequentially, so one listener is race-free).
+        ``transport``: a :class:`~flashmoe_tpu.fabric.transport.
+        HandoffTransport` the handoff sends every payload through —
+        per-page CRC32 verify, timeout + bounded retry; None (default)
+        keeps the PR 15 in-process wire.  ``fault_plan``: an armed
+        :class:`~flashmoe_tpu.chaos.FaultPlan` with fault
+        ``replica_crash`` — replica ``plan.expert % n_replicas`` dies
+        silently at fabric step ``plan.step``; the crash DETECTOR
+        (health probes at the top of every step) notices and migrates
+        its requests, it is never told."""
+        if fault_plan is not None \
+                and fault_plan.fault != "replica_crash":
+            raise ValueError(
+                f"ServingFabric only injects 'replica_crash', got "
+                f"plan fault {fault_plan.fault!r}")
+        self.fault_plan = fault_plan
         self.cfg = cfg
         self.serve = serve if serve is not None else ServeConfig()
         self.metrics = (metrics_obj if metrics_obj is not None
@@ -137,7 +153,8 @@ class ServingFabric:
         self.handoff = KVHandoff(
             params, prefill_cfg, self.serve.page_size,
             metrics_obj=self.metrics,
-            decode_step_ms=decode_step_ms, vclock=self.vclock)
+            decode_step_ms=decode_step_ms, vclock=self.vclock,
+            transport=transport)
 
         # ---- decode replicas -----------------------------------------
         pools_info = (self.pool_plan.snapshot()
@@ -151,8 +168,15 @@ class ServingFabric:
                 tracer=tracer)
             for i in range(self.n_replicas)
         ]
+        # the router probes through the fabric's crash filter: a killed
+        # replica's probe RAISES (the process is gone — there is no
+        # polite snapshot), which is exactly what an external /healthz
+        # probe of a dead host experiences
+        self._killed: set[int] = set()   # dead (silently, undetected)
+        self._crashed: set[int] = set()  # detected + evacuated
+        self.migrated = 0
         self.router = ReplicaRouter(
-            [e._health_snapshot for e in self.engines],
+            [self._probe_fn(i) for i in range(self.n_replicas)],
             metrics_obj=self.metrics, affinity=affinity)
         self._placement: dict = {}      # rid -> replica
         self.step_idx = 0
@@ -178,6 +202,8 @@ class ServingFabric:
             "active_requests": sum(r["active_requests"] for r in reps),
             "completed": sum(r["completed"] for r in reps),
             "evictions": sum(r["evictions"] for r in reps),
+            "crashed": sorted(self._crashed),
+            "migrated": self.migrated,
             "router": self.router.snapshot(),
             "replicas": reps,
         }
@@ -203,6 +229,96 @@ class ServingFabric:
         for e in self.engines:
             e.close()
 
+    # ---- crash detection + request migration -------------------------
+
+    def _probe_fn(self, i: int):
+        """Health probe for replica ``i`` as the router sees it: a
+        killed replica RAISES (dead process, no snapshot)."""
+        def probe() -> dict:
+            if i in self._killed:
+                raise RuntimeError(f"replica r{i} is dead")
+            return self.engines[i]._health_snapshot()
+        return probe
+
+    def kill_replica(self, replica: int) -> None:
+        """Kill replica ``replica`` SILENTLY — nothing is announced;
+        the fabric's own health probes must detect the death at the
+        top of the next step and migrate the victims.  (The chaos
+        ``replica_crash`` drill calls this through ``fault_plan``.)"""
+        r = int(replica)
+        if not 0 <= r < self.n_replicas:
+            raise ValueError(f"replica {r} out of range "
+                             f"[0, {self.n_replicas})")
+        if len(self._killed) + 1 >= self.n_replicas:
+            raise RuntimeError(
+                "refusing to kill the last live replica — there would "
+                "be nowhere to migrate its requests")
+        self._killed.add(r)
+
+    def _maybe_inject_crash(self) -> None:
+        p = self.fault_plan
+        if p is None or p.fault != "replica_crash":
+            return
+        target = p.expert % self.n_replicas
+        if self.step_idx == p.step and target not in self._killed:
+            self.kill_replica(target)
+
+    def _detect_crashes(self) -> None:
+        """Probe every not-yet-evacuated replica; a raising probe is a
+        detected death -> evacuate + migrate."""
+        for i in range(self.n_replicas):
+            if i in self._crashed:
+                continue
+            try:
+                self.router.health_fns[i]()
+            except Exception:
+                self._on_replica_death(i)
+
+    def _on_replica_death(self, dead: int) -> None:
+        """One replica's death, end to end: pull it from the rotation,
+        evacuate its work through the PR 10 eviction path (resumed
+        prompts carry every delivered token; trace spans close), and
+        re-route every victim onto the survivors — in-flight requests
+        resume at the head of their new queue, still in admission
+        order, so the deterministic re-prefill replays bit-equal."""
+        self.router.mark_failed(dead)
+        engine = self.engines[dead]
+        inflight, queued = engine.evacuate()
+        self._crashed.add(dead)
+        self.metrics.count("fabric.replica_crashes")
+        self.metrics.decision(
+            "fabric.replica_crash", replica=dead, step=self.step_idx,
+            in_flight=len(inflight), queued=len(queued),
+            survivors=[i for i in range(self.n_replicas)
+                       if i not in self._crashed and
+                       i not in self._killed])
+        front: dict[int, list] = {}
+        for entry in inflight:            # admission order
+            choice = self.router.route(entry.orig.rid)
+            front.setdefault(choice, []).append(entry)
+            self._emit_migrate(entry, dead, choice, resumed=True)
+        for choice, entries in front.items():
+            # adopt(front=True) prepends, so reversed() lands the
+            # oldest-admitted request back at the very head
+            for entry in reversed(entries):
+                self.engines[choice].adopt(entry, front=True)
+        for entry in queued:
+            choice = self.router.route(entry.orig.rid)
+            self.engines[choice].adopt(entry)
+            self._emit_migrate(entry, dead, choice, resumed=False)
+
+    def _emit_migrate(self, entry, dead: int, choice: int, *,
+                      resumed: bool) -> None:
+        self._placement[entry.orig.rid] = choice
+        self.migrated += 1
+        self.metrics.count("fabric.migrations")
+        self.metrics.decision(
+            "fabric.migrate", rid=entry.orig.rid, from_replica=dead,
+            to_replica=choice, resumed=resumed,
+            delivered=(len(entry.req.prompt)
+                       - len(entry.orig.prompt)),
+            remaining=entry.req.max_new_tokens)
+
     # ---- submission / drive ------------------------------------------
 
     def submit(self, req, arrival_step: int = 0, *,
@@ -218,12 +334,16 @@ class ServingFabric:
         return any(e.pending() for e in self.engines)
 
     def step(self) -> dict:
-        """One fabric iteration: every replica with pending work steps
-        once (decode steps overlap the handoff prefills its admissions
-        triggered), then the controller observes queue pressure and may
-        morph the rotation."""
+        """One fabric iteration: inject/detect crashes, then every live
+        replica with pending work steps once (decode steps overlap the
+        handoff prefills its admissions triggered), then the controller
+        observes queue pressure and may morph the rotation."""
+        self._maybe_inject_crash()
+        self._detect_crashes()
         recs = []
         for i, e in enumerate(self.engines):
+            if i in self._killed:
+                continue
             if e.pending():
                 if self.vclock is not None:
                     # replica-local virtual time: the real fleet steps
@@ -280,6 +400,8 @@ class ServingFabric:
             "handoff_bytes": self.handoff.bytes_moved,
             "routed": list(self.router.routed),
             "placement": dict(self._placement),
+            "crashed": sorted(self._crashed),
+            "migrated": self.migrated,
             "engines": [e.summary() for e in self.engines],
         }
         if self.vclock is not None:

@@ -41,32 +41,85 @@ class FrontDoor:
     :meth:`close` (or close the fabric) when done so the span listener
     uninstalls."""
 
-    def __init__(self, fabric, *, metrics_obj=None):
+    def __init__(self, fabric, *, metrics_obj=None, brownout=None,
+                 tracer=None, seen=None, peer=None):
+        """``brownout``: a :class:`~flashmoe_tpu.runtime.controller.
+        BrownoutConfig` arming hysteretic admission shedding — while a
+        brownout episode is active, :meth:`submit` sheds (or degrades)
+        new requests instead of feeding an overloaded fleet.
+        ``tracer`` / ``seen`` / ``peer``: the
+        :class:`FrontDoorCluster` seams — peers of a replicated door
+        share ONE tracer and ONE rid namespace, each tagging its
+        submits with its ``peer`` id; a standalone door (defaults)
+        owns both."""
         self.fabric = fabric
         self.metrics = (metrics_obj if metrics_obj is not None
                         else fabric.metrics)
-        clock = (fabric.vclock if fabric.vclock is not None
-                 else time.monotonic)
-        self.tracer = RequestTracer(metrics_obj=self.metrics,
-                                    clock=clock)
-        self.tracer.install()
+        self.peer = peer
+        self._owns_tracer = tracer is None
+        if tracer is None:
+            clock = (fabric.vclock if fabric.vclock is not None
+                     else time.monotonic)
+            tracer = RequestTracer(metrics_obj=self.metrics,
+                                   clock=clock)
+            tracer.install()
+        self.tracer = tracer
         for e in fabric.engines:
             e.tracer = self.tracer
-        self._seen: set = set()
+        self._seen: set = seen if seen is not None else set()
         self.sessions: dict = {}
+        # ---- brownout state (PR 9 discipline: debounce / cooldown /
+        # budget around a hysteresis band) ----
+        self.brownout = brownout
+        self._bo_active = False
+        self._bo_breach = 0
+        self._bo_clear = 0
+        self._bo_cooldown_until = -1
+        self._bo_episodes = 0
+        self._bo_last_retries = 0
+        self.shed_rids: list = []
+        self.degraded_rids: list = []
 
     # ---- namespace ----------------------------------------------------
 
     def submit(self, req, arrival_step: int = 0, *,
-               session=None) -> int:
+               session=None) -> int | None:
         """Submit one request through the front door: route it, record
-        the placement, own its rid.  Returns the chosen replica."""
+        the placement, own its rid.  Returns the chosen replica — or
+        ``None`` when an active brownout SHED the request (it never
+        enters the fabric; the rid stays owned so a retry under the
+        same rid still raises)."""
         if req.rid in self._seen:
             raise ValueError(
                 f"rid {req.rid} already submitted through this front "
                 f"door — the trace namespace is owned here, not split "
                 f"per replica")
         self._seen.add(req.rid)
+        if self._bo_active:
+            bo = self.brownout
+            depth = self._fleet_depth()
+            if bo.mode == "shed":
+                self.shed_rids.append(req.rid)
+                self.metrics.count("frontdoor.sheds")
+                self.metrics.decision(
+                    "frontdoor.shed", rid=req.rid, peer=self.peer,
+                    mode="reject", step=self.fabric.step_idx,
+                    queue_depth=round(depth, 3),
+                    episode=self._bo_episodes)
+                return None
+            capped = min(req.max_new_tokens, bo.degrade_max_new)
+            if capped < req.max_new_tokens:
+                import dataclasses as _dc
+
+                req = _dc.replace(req, max_new_tokens=capped)
+                self.degraded_rids.append(req.rid)
+                self.metrics.count("frontdoor.degraded")
+                self.metrics.decision(
+                    "frontdoor.shed", rid=req.rid, peer=self.peer,
+                    mode="degrade", step=self.fabric.step_idx,
+                    queue_depth=round(depth, 3),
+                    max_new_tokens=capped,
+                    episode=self._bo_episodes)
         choice = self.fabric.submit(req, arrival_step, session=session)
         if session is not None:
             self.sessions.setdefault(session, []).append(req.rid)
@@ -74,18 +127,111 @@ class FrontDoor:
         self.metrics.decision(
             "frontdoor.submit", rid=req.rid, session=session,
             replica=int(choice), arrival_step=int(arrival_step),
-            submitted=len(self._seen))
+            peer=self.peer, submitted=len(self._seen))
         return choice
+
+    # ---- brownout (hysteretic admission control) ----------------------
+
+    def _fleet_depth(self) -> float:
+        """Mean (queue + active) depth per LIVE replica — crashed
+        replicas neither hold work nor count toward capacity."""
+        fab = self.fabric
+        live = [e for i, e in enumerate(fab.engines)
+                if i not in fab._killed and i not in fab._crashed]
+        if not live:
+            return 0.0
+        return sum(len(e.queue) + len(e._active()) for e in live) \
+            / len(live)
+
+    def _retry_pressure(self) -> int:
+        """Handoff-transport retries since the previous observation."""
+        transport = getattr(self.fabric.handoff, "transport", None)
+        if transport is None:
+            return 0
+        now = transport.retries_total
+        delta = now - self._bo_last_retries
+        self._bo_last_retries = now
+        return delta
+
+    def observe_brownout(self, step: int) -> None:
+        """One admission-control observation (call once per fabric
+        step; :meth:`run` does).  Enter/exit transitions are
+        ``frontdoor.brownout`` decisions; both directions are debounced
+        and entries respect the cooldown and the episode budget."""
+        bo = self.brownout
+        if bo is None:
+            return
+        depth = self._fleet_depth()
+        retries = self._retry_pressure()
+        breach = depth > bo.queue_high or retries >= bo.retry_high
+        if self._bo_active:
+            calm = depth < bo.queue_low and retries == 0
+            self._bo_clear = self._bo_clear + 1 if calm else 0
+            if self._bo_clear >= bo.debounce_steps:
+                self._bo_active = False
+                self._bo_clear = 0
+                self._bo_cooldown_until = step + bo.cooldown_steps
+                self.metrics.decision(
+                    "frontdoor.brownout", state="exit", step=step,
+                    peer=self.peer, queue_depth=round(depth, 3),
+                    retries=retries, episode=self._bo_episodes,
+                    cooldown_until=self._bo_cooldown_until)
+            return
+        in_cooldown = step < self._bo_cooldown_until
+        budget_left = self._bo_episodes < bo.episode_budget
+        self._bo_breach = (self._bo_breach + 1
+                           if breach and not in_cooldown and budget_left
+                           else 0)
+        if self._bo_breach >= bo.debounce_steps:
+            self._bo_active = True
+            self._bo_breach = 0
+            self._bo_episodes += 1
+            self.metrics.count("frontdoor.brownouts")
+            self.metrics.decision(
+                "frontdoor.brownout", state="enter", step=step,
+                peer=self.peer, queue_depth=round(depth, 3),
+                retries=retries, mode=bo.mode,
+                episode=self._bo_episodes,
+                budget_left=bo.episode_budget - self._bo_episodes)
 
     def run(self, requests=None, arrivals=None, *, sessions=None,
             until=None) -> dict:
         """Submit ``requests`` through the front door and drive the
-        fabric to completion (the :meth:`ServingFabric.run` twin)."""
-        for idx, req in enumerate(requests or ()):
-            self.submit(req,
-                        int(arrivals[idx]) if arrivals else 0,
-                        session=sessions[idx] if sessions else None)
-        return self.fabric.run(until=until)
+        fabric to completion (the :meth:`ServingFabric.run` twin).
+
+        With :attr:`brownout` armed the drive is STAGED: each request
+        submits only when the fabric reaches its arrival step, so the
+        admission verdict sees the queue pressure that actually exists
+        at arrival time (an upfront bulk submit would let every request
+        through before the first observation)."""
+        if self.brownout is None:
+            for idx, req in enumerate(requests or ()):
+                self.submit(req,
+                            int(arrivals[idx]) if arrivals else 0,
+                            session=sessions[idx] if sessions else None)
+            return self.fabric.run(until=until)
+        waiting = [(int(arrivals[idx]) if arrivals else 0, req,
+                    sessions[idx] if sessions else None)
+                   for idx, req in enumerate(requests or ())]
+        i = 0
+        while i < len(waiting) or self.fabric.pending():
+            if until is not None and until():
+                break
+            step = self.fabric.step_idx
+            while i < len(waiting) and waiting[i][0] <= step:
+                arrival, req, session = waiting[i]
+                self.submit(req, arrival, session=session)
+                i += 1
+            if step >= self.fabric.serve.max_steps:
+                raise RuntimeError(
+                    f"fabric exceeded max_steps="
+                    f"{self.fabric.serve.max_steps} with work pending")
+            self.fabric.step()
+            self.observe_brownout(self.fabric.step_idx)
+        out: dict = {}
+        for e in self.fabric.engines:
+            out.update(e.outputs)
+        return out
 
     # ---- trace views --------------------------------------------------
 
@@ -130,6 +276,186 @@ class FrontDoor:
         return attribute_tracer(
             self.tracer, spilled=spilled,
             metrics_obj=self.metrics if feed_metrics else None)
+
+    def brownout_snapshot(self) -> dict:
+        """Live view of the admission controller."""
+        return {
+            "armed": self.brownout is not None,
+            "active": self._bo_active,
+            "episodes": self._bo_episodes,
+            "shed": len(self.shed_rids),
+            "degraded": len(self.degraded_rids),
+        }
+
+    def close(self) -> None:
+        if not self._owns_tracer:
+            return                      # the cluster owns the listener
+        self.tracer.uninstall()
+        for e in self.fabric.engines:
+            if e.tracer is self.tracer:
+                e.tracer = None
+
+
+class FrontDoorCluster:
+    """N replicated front-door peers over one fabric: the door itself
+    is no longer a single process (ROADMAP item 1(d)).
+
+    Ownership is **leased by namespace shard**: a request's rid (or
+    session key) crc32-hashes to one of ``n_shards`` shards, and each
+    shard's lease names the PEER that owns submissions for it plus an
+    **epoch** number.  All peers share ONE
+    :class:`~flashmoe_tpu.telemetry_plane.tracing.RequestTracer` and
+    ONE rid namespace (the trace authority is the cluster, not a
+    peer), so when :meth:`fail_door` kills a peer its shards fail over
+    to the survivors — epochs bump, a ``frontdoor.failover`` decision
+    per shard — and the post-failover fleet Perfetto document still
+    validates with zero orphan spans: no request's identity was split
+    across the transition."""
+
+    def __init__(self, fabric, n_doors: int = 2, *,
+                 n_shards: int = 8, metrics_obj=None):
+        if n_doors < 1:
+            raise ValueError(f"cluster needs >= 1 door, got {n_doors}")
+        if n_shards < n_doors:
+            raise ValueError(
+                f"n_shards ({n_shards}) must be >= n_doors "
+                f"({n_doors}) so every peer owns a lease")
+        self.fabric = fabric
+        self.metrics = (metrics_obj if metrics_obj is not None
+                        else fabric.metrics)
+        clock = (fabric.vclock if fabric.vclock is not None
+                 else time.monotonic)
+        self.tracer = RequestTracer(metrics_obj=self.metrics,
+                                    clock=clock)
+        self.tracer.install()
+        self._seen: set = set()
+        self.doors = [
+            FrontDoor(fabric, metrics_obj=self.metrics,
+                      tracer=self.tracer, seen=self._seen, peer=i)
+            for i in range(n_doors)
+        ]
+        self.n_shards = int(n_shards)
+        #: shard -> {"owner": peer id, "epoch": lease generation}
+        self.leases = {s: {"owner": s % n_doors, "epoch": 0}
+                       for s in range(self.n_shards)}
+        self._dead: set = set()
+
+    @property
+    def n_doors(self) -> int:
+        return len(self.doors)
+
+    def shard_of(self, rid, session=None) -> int:
+        import zlib
+
+        key = session if session is not None else rid
+        return zlib.crc32(str(key).encode()) % self.n_shards
+
+    def owner_of(self, rid, session=None) -> int:
+        return self.leases[self.shard_of(rid, session)]["owner"]
+
+    def submit(self, req, arrival_step: int = 0, *,
+               session=None) -> int | None:
+        """Submit through the peer whose lease owns the request's
+        namespace shard."""
+        owner = self.owner_of(req.rid, session)
+        if owner in self._dead:
+            raise RuntimeError(
+                f"lease for shard {self.shard_of(req.rid, session)} "
+                f"names dead peer {owner} — failover did not run")
+        return self.doors[owner].submit(req, arrival_step,
+                                        session=session)
+
+    def fail_door(self, peer: int) -> int:
+        """Kill peer ``peer``: every lease it held fails over to a
+        survivor (crc32-deterministic choice, epoch bumped).  Returns
+        the number of shards that moved."""
+        p = int(peer)
+        if not 0 <= p < self.n_doors:
+            raise ValueError(f"peer {p} out of range "
+                             f"[0, {self.n_doors})")
+        if p in self._dead:
+            return 0
+        survivors = [i for i in range(self.n_doors)
+                     if i not in self._dead and i != p]
+        if not survivors:
+            raise RuntimeError(
+                "refusing to kill the last live front-door peer — "
+                "the namespace would have no owner")
+        self._dead.add(p)
+        moved = 0
+        for shard in sorted(self.leases):
+            lease = self.leases[shard]
+            if lease["owner"] != p:
+                continue
+            new = survivors[shard % len(survivors)]
+            lease["owner"] = new
+            lease["epoch"] += 1
+            moved += 1
+            self.metrics.count("frontdoor.failovers")
+            self.metrics.decision(
+                "frontdoor.failover", shard=shard, from_peer=p,
+                to_peer=new, epoch=lease["epoch"],
+                survivors=list(survivors))
+        return moved
+
+    def run(self, requests=None, arrivals=None, *, sessions=None,
+            fail_at=None, fail_peer: int = 0, until=None) -> dict:
+        """Drive the fleet through the cluster, optionally killing
+        peer ``fail_peer`` when the fabric reaches step ``fail_at`` —
+        requests arriving after the failover submit through the new
+        lease owners, on the SAME shared tracer/namespace."""
+        waiting = [(int(arrivals[idx]) if arrivals else 0, req,
+                    sessions[idx] if sessions else None)
+                   for idx, req in enumerate(requests or ())]
+        i = 0
+        failed = False
+        while i < len(waiting) or self.fabric.pending():
+            if until is not None and until():
+                break
+            step = self.fabric.step_idx
+            if fail_at is not None and not failed and step >= fail_at:
+                self.fail_door(fail_peer)
+                failed = True
+            while i < len(waiting) and waiting[i][0] <= step:
+                arrival, req, session = waiting[i]
+                self.submit(req, arrival, session=session)
+                i += 1
+            if step >= self.fabric.serve.max_steps:
+                raise RuntimeError(
+                    f"fabric exceeded max_steps="
+                    f"{self.fabric.serve.max_steps} with work pending")
+            self.fabric.step()
+        out: dict = {}
+        for e in self.fabric.engines:
+            out.update(e.outputs)
+        return out
+
+    # ---- trace views (the CLUSTER is the authority) -------------------
+
+    def validate(self) -> list[str]:
+        return self.tracer.validate()
+
+    def fleet_trace_document(self) -> dict:
+        from flashmoe_tpu.profiler.export import fleet_trace_document
+
+        return fleet_trace_document(self.tracer, self.fabric._placement,
+                                    replicas=self.fabric.n_replicas)
+
+    def export_fleet_trace(self, path: str) -> dict:
+        from flashmoe_tpu.profiler.export import write_fleet_trace
+
+        return write_fleet_trace(self.tracer, self.fabric._placement,
+                                 path, replicas=self.fabric.n_replicas)
+
+    def snapshot(self) -> dict:
+        """Live ``/vars`` view of the lease table."""
+        return {
+            "doors": self.n_doors,
+            "dead": sorted(self._dead),
+            "shards": self.n_shards,
+            "leases": {s: dict(v) for s, v in self.leases.items()},
+            "max_epoch": max(v["epoch"] for v in self.leases.values()),
+        }
 
     def close(self) -> None:
         self.tracer.uninstall()

@@ -127,7 +127,8 @@ class KVHandoff:
 
     def __init__(self, params, cfg: MoEConfig, page_size: int, *,
                  wire=None, metrics_obj=None,
-                 decode_step_ms: float | None = None, vclock=None):
+                 decode_step_ms: float | None = None, vclock=None,
+                 transport=None):
         self.params = params
         self.cfg = cfg
         self.page_size = int(page_size)
@@ -146,6 +147,13 @@ class KVHandoff:
         #: reconciled against the priced one per transfer through the
         #: ``fabric.handoff_drift`` decision
         self.vclock = vclock
+        #: optional :class:`~flashmoe_tpu.fabric.transport
+        #: .HandoffTransport`: with it set the payload crosses a
+        #: failable wire — per-page CRC32 verification, timeout +
+        #: bounded retry — and the decode pool caches the RECEIVED
+        #: bytes; retry cost rides into the vclock as ``extra_ms``.
+        #: ``None`` keeps the PR 15 in-process path byte-identical.
+        self.transport = transport
         self.count = 0
         self.bytes_moved = 0
         self.modeled_ms_total = 0.0
@@ -173,18 +181,30 @@ class KVHandoff:
         logits, k_seq, v_seq = _prefill_padded(
             self.params, self.cfg, prompt_padded, jnp.int32(true_len))
         acct = None
+        retry_ms = 0.0
+        retries = 0
         with trace_span("serve.handoff"):
             payload = encode_kv_run(k_seq, v_seq, self.page_size,
                                     self.wire_dtype)
-            k_out, v_out = decode_kv_run(payload, self.cfg.dtype)
             ms = kv_handoff_ms(self.cfg, payload.pages, self.page_size,
                                wire=self.wire_dtype)
+            if self.transport is not None:
+                # the failable wire: per-page CRC verify + bounded
+                # retry; what the decode pool caches is what crossed
+                result = self.transport.send(payload, modeled_ms=ms,
+                                             rid=rid, replica=replica)
+                payload = result.payload
+                retry_ms = result.retry_ms
+                retries = result.retries
+            k_out, v_out = decode_kv_run(payload, self.cfg.dtype)
             if self.vclock is not None:
                 # advance virtual time INSIDE the serve.handoff span:
-                # the request's own prefill span absorbs the DCN wait,
-                # so TTFT is measured UNDER the delay the model priced
+                # the request's own prefill span absorbs the DCN wait
+                # (plus any retry retransmissions + backoff), so TTFT
+                # is measured UNDER the delay the model priced
                 acct = self.vclock.on_handoff(ms, rid=rid,
-                                              replica=replica)
+                                              replica=replica,
+                                              extra_ms=retry_ms)
         self.count += 1
         self.bytes_moved += payload.payload_bytes
         self.modeled_ms_total += ms
@@ -199,7 +219,8 @@ class KVHandoff:
             modeled_dcn_ms=round(ms, 6),
             decode_step_ms=(round(self.decode_step_ms, 6)
                             if self.decode_step_ms is not None else None),
-            overlapped=overlapped)
+            overlapped=overlapped, retries=retries,
+            retry_ms=round(retry_ms, 6))
         if acct is not None:
             self._reconcile(acct, ms, rid, replica, overlapped)
         return logits, k_out, v_out
@@ -233,6 +254,7 @@ class KVHandoff:
             "fabric.handoff_drift", rid=rid, replica=int(replica),
             modeled_dcn_ms=round(modeled_ms, 6),
             chaos_ms=acct["chaos_ms"],
+            retry_ms=acct.get("retry_ms", 0.0),
             measured_dcn_ms=round(measured, 6),
             tick_ms=acct["tick_ms"],
             hidden_ms=round(hidden, 6),
@@ -261,4 +283,6 @@ class KVHandoff:
                           6) if self.measured_ms_total > 0 else None),
                 verdicts_agree=self.drift_agree,
                 verdicts_total=self.drift_total)
+        if self.transport is not None:
+            out["transport"] = self.transport.snapshot()
         return out

@@ -42,6 +42,7 @@ class ReplicaRouter:
         self.metrics = (metrics_obj if metrics_obj is not None
                         else _global_metrics)
         self._draining: set[int] = set()
+        self._failed: set[int] = set()
         self.routed = [0] * len(self.health_fns)
 
     @property
@@ -61,6 +62,19 @@ class ReplicaRouter:
 
     def draining(self) -> tuple[int, ...]:
         return tuple(sorted(self._draining))
+
+    def mark_failed(self, replica: int) -> None:
+        """Declare ``replica`` DEAD: unlike a drain (which only steers
+        new placements while in-flight work keeps decoding), a failed
+        replica is excluded even from the everyone-is-draining fallback
+        rotation — its requests must MIGRATE, there is nothing left to
+        decode them.  The fabric calls this from its crash detector."""
+        self._check(replica)
+        self._failed.add(int(replica))
+        self._draining.discard(int(replica))
+
+    def failed(self) -> tuple[int, ...]:
+        return tuple(sorted(self._failed))
 
     def _check(self, replica: int) -> None:
         if not 0 <= int(replica) < self.n_replicas:
@@ -89,11 +103,17 @@ class ReplicaRouter:
         """Place one request; returns the chosen replica id."""
         loads = [self._load(i) for i in range(self.n_replicas)]
         eligible = [i for i, (d, ok) in enumerate(loads)
-                    if ok and i not in self._draining]
+                    if ok and i not in self._draining
+                    and i not in self._failed]
         if not eligible:
             # every replica draining/unhealthy: fall back to the full
-            # rotation rather than dropping the request on the floor
-            eligible = list(range(self.n_replicas))
+            # rotation rather than dropping the request on the floor —
+            # but never to a FAILED replica, which cannot decode at all
+            eligible = [i for i in range(self.n_replicas)
+                        if i not in self._failed]
+        if not eligible:
+            raise RuntimeError(
+                "every replica has failed — nothing left to route to")
         preferred = self._preferred(rid, session)
         if preferred in eligible:
             choice, why = preferred, "affinity"
@@ -116,5 +136,6 @@ class ReplicaRouter:
             "replicas": self.n_replicas,
             "affinity": self.affinity,
             "draining": list(self.draining()),
+            "failed": list(self.failed()),
             "routed": list(self.routed),
         }

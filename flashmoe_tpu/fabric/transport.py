@@ -1,0 +1,332 @@
+"""Failable KV-handoff transport: real wire semantics, in-process.
+
+PR 15's handoff was a codec round trip — the bytes and the DCN pricing
+were real, the wire was not: nothing could be lost, corrupted, or late.
+This module is the transport seam behind
+:class:`~flashmoe_tpu.fabric.handoff.KVHandoff` that makes the handoff
+*failable* (ROADMAP item 1(a)), with the failure semantics a real
+inter-host transport has:
+
+* **wire frames** — every transfer serializes each payload field
+  (K/V pages plus their ``_qscale`` sidecars) to raw bytes and attaches
+  a per-page CRC32 checksum sidecar
+  (:func:`flashmoe_tpu.utils.integrity.crc32_pages` — the same CRC32
+  helper the checkpoint manifests use), riding the frame the way the
+  quant scales ride the page payload;
+* **receiver verification** — the receive side recomputes every page
+  checksum before the bytes are allowed anywhere near the paged cache;
+  a mismatch is a ``fabric.handoff_corrupt`` decision naming the bad
+  pages, never a silent garbage decode;
+* **timeout + bounded retry** — a failed attempt (corrupt or timed
+  out) retries after a capped exponential backoff, at most
+  ``max_retries`` times, each retry recorded as a
+  ``fabric.handoff_retry`` decision; the wasted wire time (the garbage
+  attempt's modeled DCN cost, or the timeout window) plus the backoff
+  is returned as ``retry_ms`` so the caller prices it through the
+  virtual clock — retries are *experienced* by the request's TTFT,
+  reconciled per transfer by the ``fabric.handoff_drift`` verdicts;
+* **deterministic chaos** — an armed
+  :class:`~flashmoe_tpu.chaos.FaultPlan` with fault
+  ``handoff_corrupt`` / ``handoff_timeout`` perturbs the first attempt
+  of every transfer in ``[plan.step, plan.step + plan.duration)``
+  (TRANSFER index, like the DCN faults).  With ``plan.once`` (default)
+  the retry is clean — exactly one retry per faulted transfer; with
+  ``once=False`` every attempt fails and the bounded budget surfaces
+  as a :class:`HandoffTransportError` (the give-up arm).
+
+The byte path is exact: with no fault armed, ``send`` returns a
+payload rebuilt from the received bytes that is bit-identical to the
+sent one, so the fabric's token-bit-equality gates hold with the
+transport on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from flashmoe_tpu.fabric.handoff import KVPagePayload
+from flashmoe_tpu.utils.integrity import crc32_pages
+from flashmoe_tpu.utils.telemetry import metrics as _global_metrics
+
+#: serving faults the transport knows how to inject (chaos matrix rows)
+TRANSPORT_FAULTS = ("handoff_corrupt", "handoff_timeout")
+
+#: the bytes a chaos corruption stamps mid-page (the checkpoint
+#: tamper idiom — ``chaos._corrupt_latest_checkpoint`` flips the same)
+_TAMPER = b"\xde\xad\xbe\xef"
+
+
+class HandoffTransportError(RuntimeError):
+    """A transfer exhausted its retry budget — the handoff failed for
+    real and the caller must treat the prefill as undelivered."""
+
+
+@dataclasses.dataclass(frozen=True)
+class WireFrame:
+    """One payload field on the wire: raw bytes + enough metadata to
+    rebuild the array + the per-page CRC32 sidecar."""
+
+    buf: bytes
+    dtype: object                  # np.dtype (in-process frame)
+    shape: tuple
+    page_crcs: tuple
+
+    def verify(self) -> list:
+        """Indices of pages whose received bytes fail their checksum."""
+        got = crc32_pages(self.buf, len(self.page_crcs))
+        return [i for i, (w, g) in enumerate(zip(self.page_crcs, got))
+                if w != g]
+
+
+def _to_frame(arr, pages: int) -> WireFrame | None:
+    if arr is None:
+        return None
+    host = np.asarray(arr)
+    buf = host.tobytes()
+    return WireFrame(buf, host.dtype, tuple(host.shape),
+                     crc32_pages(buf, pages))
+
+
+def _from_frame(frame: WireFrame | None):
+    if frame is None:
+        return None
+    arr = np.frombuffer(frame.buf, dtype=frame.dtype)
+    return jnp.asarray(arr.reshape(frame.shape))
+
+
+def encode_frames(payload: KVPagePayload) -> dict:
+    """Serialize one payload into wire frames, one per field, each with
+    its per-page checksum sidecar."""
+    n = max(1, payload.pages)
+    return {
+        "k": _to_frame(payload.k, n),
+        "v": _to_frame(payload.v, n),
+        "k_qscale": _to_frame(payload.k_qscale, n),
+        "v_qscale": _to_frame(payload.v_qscale, n),
+    }
+
+
+def verify_frames(frames: dict) -> list:
+    """Every ``(field, page)`` whose received bytes fail the sidecar
+    checksum (empty = the transfer verified clean)."""
+    bad = []
+    for field, frame in frames.items():
+        if frame is None:
+            continue
+        bad.extend((field, p) for p in frame.verify())
+    return bad
+
+
+def decode_frames(frames: dict, payload: KVPagePayload) -> KVPagePayload:
+    """Rebuild the payload FROM THE RECEIVED BYTES (not the sender's
+    arrays) — the wire is real: what the decode pool caches is what
+    crossed, bit-identical only because the transfer verified."""
+    return dataclasses.replace(
+        payload,
+        k=_from_frame(frames["k"]), v=_from_frame(frames["v"]),
+        k_qscale=_from_frame(frames["k_qscale"]),
+        v_qscale=_from_frame(frames["v_qscale"]))
+
+
+def _tampered(frame: WireFrame) -> WireFrame:
+    """Corrupt one frame's bytes mid-buffer (checksums kept — the
+    RECEIVER must notice, that is the whole point)."""
+    buf = frame.buf
+    if not buf:
+        return frame
+    mid = max(0, len(buf) // 2 - len(_TAMPER))
+    out = buf[:mid] + _TAMPER[:len(buf) - mid] + buf[mid + len(_TAMPER):]
+    return dataclasses.replace(frame, buf=out[:len(buf)])
+
+
+@dataclasses.dataclass(frozen=True)
+class TransferResult:
+    """What one :meth:`HandoffTransport.send` experienced."""
+
+    payload: KVPagePayload         # rebuilt from the received bytes
+    attempts: int
+    retries: int
+    corrupt_pages: int
+    timeouts: int
+    retry_ms: float                # wasted wire time + backoff, priced
+                                   # through the vclock by the caller
+
+
+class HandoffTransport:
+    """In-process transport with wire failure semantics.
+
+    ``max_retries``: retry budget per transfer (attempts beyond
+    ``1 + max_retries`` raise :class:`HandoffTransportError`).
+    ``timeout_ms``: the per-attempt deadline — an injected
+    ``handoff_timeout`` attempt stalls for exactly this long before it
+    is abandoned.  ``backoff_ms`` / ``backoff_cap_ms``: capped
+    exponential backoff between attempts (``min(cap, base * 2**(n-1))``
+    after the n-th failure).  ``plan``: an armed
+    :class:`~flashmoe_tpu.chaos.FaultPlan` whose fault is one of
+    :data:`TRANSPORT_FAULTS`.  ``tamper_fn``: test seam — a callable
+    ``(transfer_index, attempt) -> bool`` that forces corruption on a
+    given attempt (the CRC tamper drill)."""
+
+    def __init__(self, *, metrics_obj=None, max_retries: int = 2,
+                 timeout_ms: float = 50.0, backoff_ms: float = 5.0,
+                 backoff_cap_ms: float = 40.0, plan=None,
+                 tamper_fn=None):
+        if plan is not None and plan.fault not in TRANSPORT_FAULTS:
+            raise ValueError(
+                f"HandoffTransport only injects {TRANSPORT_FAULTS}, "
+                f"got plan fault {plan.fault!r}")
+        if max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, "
+                             f"got {max_retries}")
+        self.metrics = (metrics_obj if metrics_obj is not None
+                        else _global_metrics)
+        self.max_retries = int(max_retries)
+        self.timeout_ms = float(timeout_ms)
+        self.backoff_ms = float(backoff_ms)
+        self.backoff_cap_ms = float(backoff_cap_ms)
+        self.plan = plan
+        self.tamper_fn = tamper_fn
+        self.transfers = 0
+        self.retries_total = 0
+        self.corrupt_total = 0
+        self.timeout_total = 0
+        self.retry_ms_total = 0.0
+
+    # ---- chaos --------------------------------------------------------
+
+    def _fault(self, index: int, attempt: int) -> str | None:
+        """Which fault (if any) hits this attempt.  Chaos fires on the
+        first attempt of every transfer in the plan window; with
+        ``plan.once`` (default) the retry is clean, else every attempt
+        fails until the budget gives up."""
+        if self.tamper_fn is not None \
+                and self.tamper_fn(index, attempt):
+            return "handoff_corrupt"
+        p = self.plan
+        if p is None:
+            return None
+        if not (p.step <= index < p.step + p.duration):
+            return None
+        if attempt > 1 and p.once:
+            return None
+        return p.fault
+
+    def _backoff(self, failures: int) -> float:
+        return min(self.backoff_cap_ms,
+                   self.backoff_ms * (2.0 ** (failures - 1)))
+
+    # ---- the wire -----------------------------------------------------
+
+    def _transmit(self, frames: dict, *, tamper: bool) -> dict:
+        """One attempt: the frames cross the (in-process) wire.  A
+        tampered attempt corrupts the largest frame's bytes — the
+        sidecar checksums ride untouched, so the receiver's verify
+        catches it."""
+        if not tamper:
+            return frames
+        victim, size = None, -1
+        for field, frame in frames.items():
+            if frame is not None and len(frame.buf) > size:
+                victim, size = field, len(frame.buf)
+        rx = dict(frames)
+        if victim is not None:
+            rx[victim] = _tampered(rx[victim])
+        return rx
+
+    def send(self, payload: KVPagePayload, *, modeled_ms: float = 0.0,
+             rid=None, replica: int = 0) -> TransferResult:
+        """Move one payload across the wire with verify + retry.
+        Returns the payload rebuilt from the received (verified) bytes
+        plus the transfer's failure accounting."""
+        frames = encode_frames(payload)
+        index = self.transfers
+        self.transfers += 1
+        attempts = 0
+        retry_ms = 0.0
+        corrupt_pages = 0
+        timeouts = 0
+        rx = frames
+        while True:
+            attempts += 1
+            fault = self._fault(index, attempts)
+            if fault == "handoff_timeout":
+                # the attempt never completes: pay the full deadline,
+                # back off, retransmit
+                timeouts += 1
+                self.timeout_total += 1
+                back = self._backoff(attempts)
+                retry_ms += self.timeout_ms + back
+                self.metrics.count("fabric.handoff_retries")
+                self.metrics.decision(
+                    "fabric.handoff_retry", rid=rid,
+                    replica=int(replica), transfer=index,
+                    attempt=attempts, reason="timeout",
+                    wasted_ms=round(self.timeout_ms, 6),
+                    backoff_ms=round(back, 6),
+                    budget_left=self.max_retries - (attempts - 1) - 1)
+                self._check_budget(attempts, index, rid, replica,
+                                   "timeout")
+                continue
+            rx = self._transmit(frames,
+                                tamper=(fault == "handoff_corrupt"))
+            bad = verify_frames(rx)
+            if bad:
+                # garbage crossed the wire: the bytes were paid for,
+                # the checksum refused them at the receiver
+                corrupt_pages += len(bad)
+                self.corrupt_total += len(bad)
+                self.metrics.count("fabric.handoff_corrupts")
+                self.metrics.decision(
+                    "fabric.handoff_corrupt", rid=rid,
+                    replica=int(replica), transfer=index,
+                    attempt=attempts, bad_pages=bad[:4],
+                    bad_page_count=len(bad))
+                back = self._backoff(attempts)
+                retry_ms += float(modeled_ms) + back
+                self.metrics.count("fabric.handoff_retries")
+                self.metrics.decision(
+                    "fabric.handoff_retry", rid=rid,
+                    replica=int(replica), transfer=index,
+                    attempt=attempts, reason="corrupt",
+                    wasted_ms=round(float(modeled_ms), 6),
+                    backoff_ms=round(back, 6),
+                    budget_left=self.max_retries - (attempts - 1) - 1)
+                self._check_budget(attempts, index, rid, replica,
+                                   "corrupt")
+                continue
+            break
+        retries = attempts - 1
+        self.retries_total += retries
+        self.retry_ms_total += retry_ms
+        if retry_ms:
+            self.metrics.sketch("fabric.handoff_retry_ms", retry_ms)
+        return TransferResult(
+            payload=decode_frames(rx, payload), attempts=attempts,
+            retries=retries, corrupt_pages=corrupt_pages,
+            timeouts=timeouts, retry_ms=retry_ms)
+
+    def _check_budget(self, attempts: int, index: int, rid, replica,
+                      reason: str) -> None:
+        if attempts >= 1 + self.max_retries:
+            raise HandoffTransportError(
+                f"KV handoff transfer {index} (rid={rid}, replica="
+                f"{replica}) failed after {attempts} attempts "
+                f"({reason}); retry budget max_retries="
+                f"{self.max_retries} exhausted")
+
+    def snapshot(self) -> dict:
+        """Live ``/vars`` view of the transport."""
+        return {
+            "transfers": self.transfers,
+            "retries_total": self.retries_total,
+            "corrupt_total": self.corrupt_total,
+            "timeout_total": self.timeout_total,
+            "retry_ms_total": round(self.retry_ms_total, 6),
+            "max_retries": self.max_retries,
+            "timeout_ms": self.timeout_ms,
+            "fault": (self.plan.fault if self.plan is not None
+                      else None),
+        }

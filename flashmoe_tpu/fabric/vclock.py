@@ -120,15 +120,20 @@ class VirtualClock:
     # ---- fabric hooks -------------------------------------------------
 
     def on_handoff(self, modeled_ms: float, *, rid=None,
-                   replica=None) -> dict:
+                   replica=None, extra_ms: float = 0.0) -> dict:
         """One KV-page transfer lands on the active lane: advance by
-        the measured cost (modeled + chaos) and account how much of it
-        hides under the remaining decode-tick budget.  Returns the
-        per-transfer accounting dict (also kept in :attr:`transfers`)."""
+        the measured cost (modeled + chaos + ``extra_ms``) and account
+        how much of it hides under the remaining decode-tick budget.
+        ``extra_ms`` is transport overhead the wire actually spent —
+        retry retransmissions and backoff
+        (:class:`~flashmoe_tpu.fabric.transport.HandoffTransport`) —
+        so a retried handoff is *experienced* by the request's TTFT,
+        not just counted.  Returns the per-transfer accounting dict
+        (also kept in :attr:`transfers`)."""
         index = self._handoffs
         self._handoffs += 1
         chaos = self._chaos_ms(index)
-        measured = float(modeled_ms) + chaos
+        measured = float(modeled_ms) + chaos + float(extra_ms)
         tick = float(self.tick_ms) if self.tick_ms is not None else 0.0
         lane = self._active
         budget = max(0.0, tick - self._step_handoff_ms[lane])
@@ -142,6 +147,7 @@ class VirtualClock:
             "lane": lane,
             "modeled_ms": round(float(modeled_ms), 6),
             "chaos_ms": round(chaos, 6),
+            "retry_ms": round(float(extra_ms), 6),
             "measured_ms": round(measured, 6),
             "hidden_ms": round(hidden, 6),
             "exposed_ms": round(exposed, 6),

@@ -477,6 +477,13 @@ def serving_report(records: list[dict]) -> dict:
     ho_overlapped = ho_verdicts = 0
     ho_wire = None
     admissions = evictions = slo_ttft = slo_tpot = 0
+    migrations: list = []
+    crashes: list = []
+    retries: list = []
+    corrupts = 0
+    sheds: dict = {}
+    brownouts: dict = {}
+    failovers: list = []
     for r in records:
         kind, dec = r.get("kind"), r.get("decision")
         if kind == "serve_step":
@@ -526,6 +533,22 @@ def serving_report(records: list[dict]) -> dict:
             admissions += 1
         elif dec == "serve.evict":
             evictions += 1
+        elif dec == "fabric.migrate":
+            migrations.append(r)
+        elif dec == "fabric.replica_crash":
+            crashes.append(r)
+        elif dec == "fabric.handoff_retry":
+            retries.append(r)
+        elif dec == "fabric.handoff_corrupt":
+            corrupts += 1
+        elif dec == "frontdoor.shed":
+            mode = str(r.get("mode") or "reject")
+            sheds[mode] = sheds.get(mode, 0) + 1
+        elif dec == "frontdoor.brownout":
+            st = str(r.get("state") or "?")
+            brownouts[st] = brownouts.get(st, 0) + 1
+        elif dec == "frontdoor.failover":
+            failovers.append(r)
         elif dec == "slo.breach":
             if r.get("target") == "ttft":
                 slo_ttft += 1
@@ -599,6 +622,61 @@ def serving_report(records: list[dict]) -> dict:
                                 if ho_verdicts else None),
             "wire": ho_wire,
         } if ho_n else None),
+        # the serving failure story (ISSUE 18): crash timeline,
+        # migrations, retried handoffs, brownout shedding, front-door
+        # failovers — the section an incident review reads first
+        "fabric_failures": _fabric_failures(
+            crashes, migrations, retries, corrupts, sheds, brownouts,
+            failovers),
+    }
+
+
+def _fabric_failures(crashes, migrations, retries, corrupts, sheds,
+                     brownouts, failovers):
+    """Aggregate the serving fault-tolerance decisions into the
+    ``--serving`` report's failure section (None when the run saw no
+    failure activity — the common case stays quiet)."""
+    if not (crashes or migrations or retries or corrupts
+            or sheds or brownouts or failovers):
+        return None
+
+    def hist(values):
+        out: dict = {}
+        for v in values:
+            out[str(v)] = out.get(str(v), 0) + 1
+        return dict(sorted(out.items()))
+
+    mig_paths = hist(f"r{m.get('from_replica')}->r{m.get('to_replica')}"
+                     for m in migrations)
+    return {
+        "crashes": [{"replica": c.get("replica"),
+                     "step": c.get("step"),
+                     "in_flight": c.get("in_flight"),
+                     "queued": c.get("queued")} for c in crashes],
+        "migrations": {
+            "total": len(migrations),
+            "resumed_mid_decode": sum(bool(m.get("resumed"))
+                                      for m in migrations),
+            "paths": mig_paths,
+        },
+        "handoff_retries": {
+            "total": len(retries),
+            "reasons": hist(r.get("reason") for r in retries),
+            "wasted_ms": round(sum(float(r.get("wasted_ms", 0.0))
+                                   for r in retries), 3),
+            "backoff_ms_hist": hist(r.get("backoff_ms")
+                                    for r in retries),
+        },
+        "corrupt_transfers": corrupts,
+        "shed": dict(sorted(sheds.items())),
+        "brownout_transitions": dict(sorted(brownouts.items())),
+        "failovers": {
+            "total": len(failovers),
+            "max_epoch": max((int(f.get("epoch", 0))
+                              for f in failovers), default=0),
+            "paths": hist(f"p{f.get('from_peer')}->p{f.get('to_peer')}"
+                          for f in failovers),
+        },
     }
 
 
@@ -679,6 +757,48 @@ def render_serving_text(rep: dict) -> str:
         b = rep["slo_breaches"]
         lines.append(f"  SLO breaches: ttft={b['ttft']} "
                      f"tpot={b['tpot']}")
+    ff = rep.get("fabric_failures")
+    if ff:
+        lines.append("  -- failures --")
+        for c in ff["crashes"]:
+            lines.append(
+                f"  replica crash: r{c['replica']} at step {c['step']} "
+                f"({c['in_flight']} in flight, {c['queued']} queued)")
+        mg = ff["migrations"]
+        if mg["total"]:
+            paths = " ".join(f"{k}:{v}" for k, v
+                             in mg["paths"].items())
+            lines.append(
+                f"  migrations: {mg['total']} "
+                f"({mg['resumed_mid_decode']} resumed mid-decode)  "
+                f"{paths}")
+        hr = ff["handoff_retries"]
+        if hr["total"]:
+            reasons = " ".join(f"{k}={v}" for k, v
+                               in hr["reasons"].items())
+            backoff = " ".join(f"{k}ms:{v}" for k, v
+                               in hr["backoff_ms_hist"].items())
+            lines.append(
+                f"  handoff retries: {hr['total']} ({reasons}), wasted "
+                f"{hr['wasted_ms']} ms on the wire, backoff {backoff}")
+        if ff.get("corrupt_transfers"):
+            lines.append(f"  corrupt transfers: "
+                         f"{ff['corrupt_transfers']} (CRC named the "
+                         f"pages; all re-sent)")
+        if ff.get("shed"):
+            shed = " ".join(f"{k}={v}" for k, v in ff["shed"].items())
+            lines.append(f"  brownout shed admissions: {shed}")
+        if ff.get("brownout_transitions"):
+            tr = " ".join(f"{k}={v}" for k, v
+                          in ff["brownout_transitions"].items())
+            lines.append(f"  brownout transitions: {tr}")
+        fo = ff["failovers"]
+        if fo["total"]:
+            paths = " ".join(f"{k}:{v}" for k, v
+                             in fo["paths"].items())
+            lines.append(
+                f"  front-door failovers: {fo['total']} leases moved "
+                f"(max epoch {fo['max_epoch']})  {paths}")
     return "\n".join(lines)
 
 

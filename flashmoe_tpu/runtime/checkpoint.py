@@ -37,7 +37,6 @@ import glob
 import json
 import os
 import threading
-import zlib
 from typing import Any
 
 import jax
@@ -122,13 +121,12 @@ def _manifest_path(directory: str, step: int) -> str:
 
 
 def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
-    crc = 0
-    with open(path, "rb") as f:
-        while True:
-            b = f.read(chunk)
-            if not b:
-                return crc
-            crc = zlib.crc32(b, crc)
+    # one CRC implementation repo-wide: the KV-handoff transport
+    # (fabric/transport.py) checksums its wire frames with the same
+    # helper this manifest uses for payload files
+    from flashmoe_tpu.utils.integrity import crc32_file
+
+    return crc32_file(path, chunk)
 
 
 def _walk_payload(root: str) -> dict[str, dict]:

@@ -144,6 +144,61 @@ class ControllerConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class BrownoutConfig:
+    """Front-door brownout load-shedding thresholds (ISSUE 18, the
+    RaMP-style degrade-don't-die arm of the serving ladder).
+
+    The :class:`~flashmoe_tpu.fabric.frontdoor.FrontDoor` observes
+    fleet queue pressure and handoff-transport retry pressure every
+    fabric step; when the hysteretic thresholds breach for
+    ``debounce_steps`` consecutive observations it enters a brownout
+    EPISODE — new admissions are shed (rejected at the door) or
+    degraded (token budget capped) until pressure falls below the low
+    watermark for the same debounce window.  Episodes are bounded by
+    ``episode_budget`` and separated by ``cooldown_steps`` — the PR 9
+    controller discipline, applied to admission control: a one-step
+    blip must never shed a request, and a flapping signal must never
+    oscillate the door."""
+
+    #: mean per-live-replica (queue + active) depth above which the
+    #: fleet counts as overloaded ...
+    queue_high: float = 6.0
+    #: ... and below which a brownout episode may end (hysteresis band)
+    queue_low: float = 2.0
+    #: handoff-transport retries observed since the previous step at or
+    #: above this also count as a breach (the wire is failing — new
+    #: admissions would pay retry latency on top of queue wait)
+    retry_high: int = 3
+    #: admission verdict while browned out: "shed" rejects the request
+    #: at the door; "degrade" admits it with max_new_tokens capped at
+    #: ``degrade_max_new``
+    mode: str = "shed"
+    degrade_max_new: int = 4
+    debounce_steps: int = 2
+    cooldown_steps: int = 4
+    episode_budget: int = 2
+
+    def __post_init__(self):
+        if self.queue_low >= self.queue_high:
+            raise ValueError(
+                "queue_low must be < queue_high (the hysteresis band "
+                "keeps the brownout from oscillating)")
+        if self.mode not in ("shed", "degrade"):
+            raise ValueError(f"mode must be 'shed' or 'degrade', "
+                             f"got {self.mode!r}")
+        if self.degrade_max_new < 1:
+            raise ValueError("degrade_max_new must be >= 1")
+        if self.debounce_steps < 1:
+            raise ValueError("debounce_steps must be >= 1")
+        if self.cooldown_steps < 0:
+            raise ValueError("cooldown_steps must be >= 0")
+        if self.episode_budget < 1:
+            raise ValueError("episode_budget must be >= 1")
+        if self.retry_high < 1:
+            raise ValueError("retry_high must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
 class MorphAction:
     """Path morph: rebuild the step with ``overrides`` applied."""
 

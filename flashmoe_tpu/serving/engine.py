@@ -670,7 +670,7 @@ class ServingEngine:
         self.step_idx = 0
         self.outputs: dict[int, list] = {}
         self.stats = {
-            "submitted": 0, "completed": 0, "evictions": 0,
+            "submitted": 0, "completed": 0, "evictions": 0, "adopted": 0,
             "tokens": 0, "steps": 0, "max_queue_depth": 0,
             "max_active": 0, "decode_buckets": set(),
             "prefill_buckets": set(), "peak_occupancy": 0.0,
@@ -786,6 +786,48 @@ class ServingEngine:
         self.queue.append(_QueueEntry(int(arrival_step), req, req,
                                       None, None))
         self.stats["submitted"] += 1
+
+    # ---- crash migration (the fabric's recovery path) ----------------
+
+    def evacuate(self) -> tuple:
+        """Crash evacuation: preempt EVERY active slot back through the
+        PR 10 eviction path — each in-flight request's resumed prompt
+        carries its delivered tokens, its pages free, its trace step
+        span closes (``on_evict`` reopens the queued clock, so the
+        fleet trace stays orphan-free) — then hand the whole queue to
+        the caller.  Returns ``(inflight, queued)``: the evicted
+        in-flight entries in ADMISSION order, and the entries that were
+        still queued.  The engine is empty afterwards; the fabric
+        re-routes both lists onto surviving replicas
+        (:meth:`adopt`), and the deterministic resume makes the
+        migrated token streams bit-equal to an uninterrupted run."""
+        queued = list(self.queue)
+        while self._evict_youngest():
+            pass
+        # _evict_youngest requeues at the FRONT, youngest first — so
+        # the front of the deque now reads oldest-admitted .. youngest,
+        # followed by the entries that were already queued
+        inflight = list(self.queue)[:len(self.queue) - len(queued)]
+        self.queue.clear()
+        return inflight, queued
+
+    def adopt(self, entry: _QueueEntry, *, front: bool = False) -> None:
+        """Adopt a migrated queue entry from a crashed replica: a RAW
+        queue insertion that preserves the entry's arrival and
+        first-token clocks (the client already holds its delivered
+        tokens — TTFT/TPOT must not restart) and its resumed prompt.
+        ``front=True`` resumes ahead of local work: migrated in-flight
+        requests outrank never-admitted ones, matching the eviction
+        path's own head-of-queue discipline."""
+        if front:
+            # immediately admittable: the local step counter may trail
+            # the dead replica's, and a resumed request must not wait
+            # for it to catch up
+            entry.arrival_step = min(entry.arrival_step, self.step_idx)
+            self.queue.appendleft(entry)
+        else:
+            self.queue.append(entry)
+        self.stats["adopted"] += 1
 
     # ---- internals ---------------------------------------------------
 

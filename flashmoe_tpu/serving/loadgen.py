@@ -462,3 +462,133 @@ def fabric_load_sweep(loads, *, replica_counts=(1, 2, 4),
         if server is not None:
             server.stop()
     return records
+
+
+#: the serving fault-tolerance ladder drilled by ``--fabric --faults``
+#: (chaos.EXPECTED_TIER owns the fault -> recovery-tier mapping)
+SERVING_FAULTS = ("replica_crash", "handoff_corrupt",
+                  "handoff_timeout", "frontdoor_loss")
+
+
+def fabric_fault_sweep(faults=None, *, seed: int = 0,
+                       include_brownout: bool = True) -> list[dict]:
+    """The ``bench.py --fabric --faults`` sweep: one record per
+    serving fault, each running that fault's chaos drill
+    (:func:`flashmoe_tpu.chaos.drill.run_drill`) against a mocked
+    2-replica fabric and reporting the recovery ledger — wall-clock
+    recovery latency as the headline value plus migrated-request
+    count, handoff retry/corrupt totals, front-door failovers, and
+    the trace-contiguity verdict.  A drill that does not recover
+    carries ``error`` so the perf sentry never baselines a broken
+    run's latency.
+
+    ``include_brownout`` appends one more record: a seeded flood
+    through a brownout-armed :class:`~flashmoe_tpu.fabric.frontdoor.
+    FrontDoor` on the virtual clock, whose headline value is the shed
+    fraction (``unit: frac`` — admissions rejected / offered)."""
+    import jax
+
+    from flashmoe_tpu.chaos.drill import run_drill
+
+    faults = tuple(faults) if faults is not None else SERVING_FAULTS
+    bad = [f for f in faults if f not in SERVING_FAULTS]
+    if bad:
+        raise ValueError(f"not serving faults: {bad} "
+                         f"(choose from {SERVING_FAULTS})")
+    records = []
+    for fault in faults:
+        r = run_drill(fault, seed=seed)
+        ev = r.evidence
+        rec = {
+            "metric": f"fabric_fault[{fault}]",
+            "value": round(r.wall_s * 1e3, 1),
+            "unit": "ms",
+            "fault": fault,
+            "tier": r.expected_tier,
+            "recovered": r.recovered,
+            "completed": ev.get("completed", 0),
+            "bit_equal": ev.get("bit_equal_to_baseline", False),
+            "migrated": ev.get("migrations", 0),
+            "retries": ev.get("retries", 0),
+            "corrupt": ev.get("corrupt", 0),
+            "failovers": ev.get("failovers", 0),
+            "shed_frac": 0.0,   # fault drills never shed; the brownout
+            "trace_errors": len(ev.get("trace_errors") or []),
+            "backend": jax.default_backend(),
+        }
+        if not r.recovered:
+            rec["error"] = r.reason[:200]
+        records.append(rec)
+    if include_brownout:
+        records.append(_brownout_shed_record(seed=seed))
+    return records
+
+
+def _brownout_shed_record(*, seed: int = 0) -> dict:
+    """One deterministic brownout drill: a seeded flood against the
+    hysteretic admission controller on the virtual clock (shed
+    decisions depend only on queue depth and step index — bit-stable
+    across machines)."""
+    import os
+    import time
+
+    import jax
+
+    from flashmoe_tpu.fabric.engine import ServingFabric
+    from flashmoe_tpu.fabric.frontdoor import FrontDoor
+    from flashmoe_tpu.fabric.topo import ENV_MOCK_FABRIC
+    from flashmoe_tpu.fabric.vclock import VirtualClock
+    from flashmoe_tpu.models.transformer import init_params
+    from flashmoe_tpu.runtime.controller import BrownoutConfig
+    from flashmoe_tpu.serving.engine import ServeConfig
+    from flashmoe_tpu.utils.telemetry import Metrics
+
+    cfg = tiny_config()
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    serve = ServeConfig(
+        max_batch=2, page_size=8, num_pages=64, max_pages_per_slot=4,
+        ctx_bucket_pages=1, prompt_bucket=8)
+    flood, _ = build_requests(10, vocab=cfg.vocab_size, prompt_len=8,
+                              max_new=6, seed=seed + 1,
+                              arrival_every=1)
+    # front-loaded arrivals: the burst trips the threshold, the tail
+    # arrives while the brownout holds
+    arrivals = [0, 0, 0, 0, 2, 2, 3, 3, 4, 5]
+    bo = BrownoutConfig(queue_high=2.0, queue_low=0.5,
+                        debounce_steps=1, cooldown_steps=2,
+                        episode_budget=2)
+    mx = Metrics()
+    saved = os.environ.get(ENV_MOCK_FABRIC)
+    os.environ[ENV_MOCK_FABRIC] = "2"
+    fab = door = None
+    t0 = time.perf_counter()
+    try:
+        fab = ServingFabric(params, cfg, serve, metrics_obj=mx,
+                            vclock=VirtualClock())
+        door = FrontDoor(fab, brownout=bo)
+        out = door.run(flood, arrivals)
+        errs = door.validate()
+        snap = door.brownout_snapshot()
+    finally:
+        if door is not None:
+            door.close()
+        if fab is not None:
+            fab.close()
+        if saved is None:
+            os.environ.pop(ENV_MOCK_FABRIC, None)
+        else:
+            os.environ[ENV_MOCK_FABRIC] = saved
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    return {
+        "metric": "fabric_shed[brownout]",
+        "value": round(snap["shed"] / len(flood), 4),
+        "unit": "frac",
+        "offered": len(flood),
+        "completed": len(out),
+        "shed": snap["shed"],
+        "degraded": snap["degraded"],
+        "episodes": snap["episodes"],
+        "trace_errors": len(errs),
+        "wall_ms": round(wall_ms, 1),
+        "backend": jax.default_backend(),
+    }

@@ -223,6 +223,48 @@ SERVING_KNOBS: tuple[KnobSpec, ...] = (
             "the default) is the wall clock: byte-identical graphs and "
             "token-bit-equal outputs to the unclocked fabric — the "
             "clock is a host-side seam that never enters a jit"),
+    KnobSpec(
+        "transport", off_values=(None,),
+        on={"transport": "HandoffTransport()"},
+        backends=(), changes_graph=False,
+        doc="the failable KV-handoff wire (fabric/transport.py): "
+            "ServingFabric(transport=...) routes every prefill->decode "
+            "page stream through a serialize/verify/deserialize hop "
+            "with per-page CRC32 checksums, capped-exponential-backoff "
+            "retries on corruption or timeout (fabric.handoff_retry / "
+            "fabric.handoff_corrupt), and the wasted wire time priced "
+            "into the virtual clock (handoff_drift retry_ms).  Off "
+            "(None, the default) hands the payload object across "
+            "in-process untouched — byte-identical to the PR 15 path; "
+            "on with a clean wire is token-bit-equal because the "
+            "decode side caches the RECEIVED bytes"),
+    KnobSpec(
+        "brownout", off_values=(None,),
+        on={"brownout": "BrownoutConfig()"},
+        backends=(), changes_graph=False,
+        doc="hysteretic brownout load-shedding at the front door "
+            "(runtime/controller.py BrownoutConfig + frontdoor.py): "
+            "FrontDoor(brownout=...) stages admissions and sheds "
+            "(mode='shed') or truncates (mode='degrade') NEW arrivals "
+            "while fleet queue depth or handoff-retry pressure holds "
+            "above the enter threshold, with the controller's debounce"
+            "/cooldown/episode-budget discipline (frontdoor.brownout / "
+            "frontdoor.shed).  Off (None, the default) admits "
+            "everything up front — the PR 15/17 path unchanged; "
+            "already-admitted requests are never touched either way"),
+    KnobSpec(
+        "fault_plan", off_values=(None,),
+        on={"fault_plan": "FaultPlan('replica_crash', ...)"},
+        backends=(), changes_graph=False,
+        doc="deterministic replica-crash injection (fabric/engine.py): "
+            "ServingFabric(fault_plan=...) silently kills the planned "
+            "replica at the planned step; the next step's health "
+            "probes detect it, the router fences it (mark_failed), and "
+            "its in-flight requests re-queue at the FRONT of surviving "
+            "replicas via the eviction-resume path — token-bit-equal "
+            "recovery (fabric.replica_crash / fabric.migrate).  Off "
+            "(None, the default) injects nothing; detection and "
+            "migration still guard real probe failures"),
 )
 
 SERVING_KNOBS_BY_NAME = {k.name: k for k in SERVING_KNOBS}

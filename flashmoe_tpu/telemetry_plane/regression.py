@@ -43,6 +43,7 @@ UNIT_TOLERANCE = {
     "tokens_per_sec": 0.15,
     "ratio_vs_serialized": 0.15,
     "hidden_frac": 0.15,
+    "frac": 0.15,
 }
 DEFAULT_TOLERANCE = 0.25
 _DIR = {
@@ -50,6 +51,7 @@ _DIR = {
     "tokens_per_sec": -1.0,       # throughput: down is worse
     "ratio_vs_serialized": -1.0,  # overlap efficiency: down is worse
     "hidden_frac": -1.0,          # handoff overlap: less hidden = worse
+    "frac": +1.0,                 # shed fraction: more shedding = worse
 }
 
 
@@ -158,7 +160,74 @@ def reference_points(gen: str = "v5e") -> dict[str, dict]:
             "value": round(hf if hf is not None else 1.0, 4),
             "unit": "hidden_frac",
         }
+        # serving fault-tolerance plane (ISSUE 18): the modeled
+        # replica-crash recovery latency — one decode tick of detection
+        # delay (health probes run at step boundaries), the re-streamed
+        # KV handoff to the adopting replica, and the first resumed
+        # decode tick.  Pure cost-model + vclock arithmetic: a drift in
+        # the DCN pricing or the tick model moves this row before any
+        # chaos drill measures it
+        points[f"fabric_recovery_ms[{name},d={GOLDEN_D},{gen}]"] = {
+            "value": round(2 * tick + ms, 4), "unit": "ms",
+        }
+    # brownout shed fraction at the default BrownoutConfig against the
+    # reference flood: deterministic hysteresis arithmetic — retuning
+    # the admission controller's thresholds/debounce moves this row,
+    # so an accidental "sheds half the traffic" default trips the
+    # sentry before it ships
+    from flashmoe_tpu.runtime.controller import BrownoutConfig
+
+    points["fabric_shed_frac[brownout,reference]"] = {
+        "value": round(_reference_shed_frac(BrownoutConfig()), 4),
+        "unit": "frac",
+    }
     return points
+
+
+#: the reference flood behind ``fabric_shed_frac[brownout,reference]``:
+#: per-step arrivals of a front-loaded burst with a long tail, served
+#: at ``_REFERENCE_SERVICE_RATE`` requests/step
+_REFERENCE_FLOOD = (8, 4, 4, 2, 2, 1, 1, 1, 0, 0, 0, 0)
+_REFERENCE_SERVICE_RATE = 2.0
+
+
+def _reference_shed_frac(bo) -> float:
+    """Shed fraction of the reference flood under the hysteretic
+    brownout controller — the same enter/exit discipline as
+    ``FrontDoor.observe_brownout`` (breach debounce, calm debounce,
+    cooldown, episode budget) run over a synthetic queue-depth
+    trajectory in pure arithmetic."""
+    depth = 0.0
+    active = False
+    breach = clear = episodes = 0
+    cooldown_until = -1
+    shed = offered = 0
+    for step, a in enumerate(_REFERENCE_FLOOD):
+        offered += a
+        if active:
+            shed += a
+        else:
+            depth += a
+        depth = max(0.0, depth - _REFERENCE_SERVICE_RATE)
+        if active:
+            calm = depth < bo.queue_low
+            clear = clear + 1 if calm else 0
+            if clear >= bo.debounce_steps:
+                active = False
+                clear = 0
+                cooldown_until = step + bo.cooldown_steps
+        else:
+            hot = depth > bo.queue_high
+            if hot and step >= cooldown_until \
+                    and episodes < bo.episode_budget:
+                breach += 1
+            else:
+                breach = 0
+            if breach >= bo.debounce_steps:
+                active = True
+                breach = 0
+                episodes += 1
+    return shed / offered if offered else 0.0
 
 
 def append_run(path: str, points: dict[str, dict], *,

@@ -84,9 +84,35 @@ DECISION_NAMES: dict[str, str] = {
         "virtual clock: measured DCN (modeled + chaos), hidden/exposed "
         "split against the decode tick, and whether the measured "
         "overlap verdict agrees with the priced one",
+    "fabric.handoff_corrupt":
+        "a KV-handoff transfer failed its per-page CRC32 verify at the "
+        "receiver: which pages were corrupted, on which attempt — the "
+        "bytes never reach the paged cache",
+    "fabric.handoff_retry":
+        "the handoff transport retransmitted a failed transfer "
+        "(corrupt or timed out): attempt number, wasted wire ms, "
+        "capped-exponential backoff, remaining retry budget",
+    "fabric.migrate":
+        "a crashed replica's request moved to a survivor: the resumed "
+        "prompt carries every delivered token, so the deterministic "
+        "re-prefill replays the token stream bit-equal",
+    "fabric.replica_crash":
+        "the fabric's health probes detected a dead decode replica: "
+        "in-flight and queued victim counts, surviving rotation",
     "fabric.route":
         "the replica router placed a request (session affinity or "
         "join-shortest-queue over live /healthz depths)",
+    "frontdoor.brownout":
+        "the front door's hysteretic overload detector changed state "
+        "(enter/exit): queue pressure vs thresholds, debounce/cooldown "
+        "/budget bookkeeping (PR 9 controller discipline)",
+    "frontdoor.failover":
+        "a dead front-door peer's namespace lease moved to a survivor: "
+        "shard, old/new owner, bumped epoch",
+    "frontdoor.shed":
+        "a brownout admission verdict: the arriving request was shed "
+        "(rejected) or degraded (token budget capped) instead of "
+        "joining an overloaded fleet",
     "frontdoor.submit":
         "the fabric front door accepted a request into the fleet-wide "
         "trace namespace and recorded the router's placement",

@@ -693,6 +693,79 @@ def test_cli_fabric_flag_exclusivity(monkeypatch, capsys):
         capsys.readouterr()
 
 
+def test_cli_fabric_faults_plumbs_fault_sweep(monkeypatch):
+    """`bench.py --fabric --faults` dispatches the fault sweep (not
+    the load sweep) — the recovery-ladder records ride the same
+    emit/observability path as every other mode."""
+    import sys as _sys
+
+    import bench
+
+    seen = {"faults": 0}
+    monkeypatch.setattr(bench, "_bench_fabric_faults",
+                        lambda: seen.update(faults=seen["faults"] + 1))
+    monkeypatch.setattr(
+        bench, "_bench_fabric",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("--faults must not run the load sweep")))
+    monkeypatch.setattr(_sys, "argv",
+                        ["bench.py", "--fabric", "--faults",
+                         "--deadline", "0"])
+    bench.main()
+    assert seen["faults"] == 1
+
+
+def test_cli_faults_flag_exclusivity(monkeypatch, capsys):
+    """--faults fail-fasts outside --fabric and refuses knobs the
+    fault sweep would silently ignore (--vclock is implied — every
+    drill already steps on the virtual clock; there is no live scrape
+    window for --telemetry-port)."""
+    import sys as _sys
+
+    import bench
+
+    cases = [
+        ["bench.py", "--faults"],
+        ["bench.py", "--serve", "--faults"],
+        ["bench.py", "--fabric", "--faults", "--vclock"],
+        ["bench.py", "--fabric", "--faults", "--telemetry-port", "0"],
+    ]
+    for argv in cases:
+        monkeypatch.setattr(_sys, "argv", argv)
+        with pytest.raises(SystemExit) as e:
+            bench.main()
+        assert e.value.code == 2, argv
+        capsys.readouterr()
+
+
+def test_cli_fabric_faults_probe_hang_skips(monkeypatch, capsys):
+    """--fabric --faults inherits the probe fail-fast contract on real
+    hardware: a hung probe yields ONE skipped:true record (with the
+    fault-matrix headline identity) and rc 0 — the drills never run."""
+    import sys as _sys
+
+    import bench
+
+    monkeypatch.setenv("FLASHMOE_OVERLAP_TPU", "1")
+    monkeypatch.setattr(
+        bench, "_probe_backend_retry",
+        lambda budget_s, each_s=90, max_attempts=0:
+        (False, "backend probe hung >10s after 2 attempts / 20s", True))
+    monkeypatch.setattr(
+        bench, "_bench_fabric_faults",
+        lambda: (_ for _ in ()).throw(
+            AssertionError("drills must not run on a hung probe")))
+    monkeypatch.setattr(_sys, "argv",
+                        ["bench.py", "--fabric", "--faults"])
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["skipped"] is True
+    assert rec["metric"] == "fabric_fault[matrix]"
+    assert rec["value"] is None and "hung" in rec["reason"]
+
+
 def test_cli_fabric_emits_skipped_record_when_probe_hangs(monkeypatch,
                                                           capsys):
     """On real hardware (FLASHMOE_OVERLAP_TPU=1) --fabric inherits the

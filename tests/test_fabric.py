@@ -395,6 +395,7 @@ def test_serving_report_surfaces_fabric_decisions():
 # Chunked prefill x eviction (single-pool engine)
 # ----------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_chunked_prefill_with_eviction_bit_equal(params):
     """A 24-token prompt admitted in 8-token chunks under page
     pressure: requests evict and re-prefill (again chunked) and the

@@ -1,0 +1,498 @@
+"""Fault-tolerant serving fabric (PR 18): the serving-side recovery
+ladder.
+
+Fast lanes drill each mechanism directly — the CRC'd failable handoff
+transport (tamper => exactly one retry, bit-equal payload), silent
+replica crash => probe detection => front-of-queue migration with
+token-bit-equal streams, hysteretic brownout shedding, and the
+lease-replicated front-door cluster's epoch-bumped failover — all on
+mocked ``FLASHMOE_MOCK_FABRIC`` worlds stepping a
+:class:`VirtualClock` (trace validation needs virtual time: sibling
+jit compiles hole a wall-clock timeline).  The slow lane runs the four
+chaos-matrix drills end to end (``@pytest.mark.slow`` per the lint's
+tier-1 budget guard).
+"""
+
+import dataclasses
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from flashmoe_tpu.chaos import EXPECTED_TIER, FAULTS, FaultPlan
+from flashmoe_tpu.fabric import (
+    FrontDoor, FrontDoorCluster, HandoffTransport, HandoffTransportError,
+    ServingFabric, VirtualClock,
+)
+from flashmoe_tpu.fabric.handoff import encode_kv_run
+from flashmoe_tpu.fabric.router import ReplicaRouter
+from flashmoe_tpu.fabric.topo import ENV_MOCK_FABRIC
+from flashmoe_tpu.fabric.transport import (
+    encode_frames, verify_frames,
+)
+from flashmoe_tpu.models.transformer import init_params
+from flashmoe_tpu.runtime.controller import BrownoutConfig
+from flashmoe_tpu.serving.engine import ServeConfig, ServingEngine
+from flashmoe_tpu.serving.loadgen import build_requests, tiny_config
+from flashmoe_tpu.utils.integrity import crc32_bytes, crc32_pages
+from flashmoe_tpu.utils.telemetry import DECISION_NAMES, Metrics
+
+CFG = tiny_config()
+SERVE = ServeConfig(max_batch=2, page_size=8, num_pages=64,
+                    max_pages_per_slot=4, ctx_bucket_pages=1,
+                    prompt_bucket=8)
+
+SERVING_FAULTS = ("replica_crash", "handoff_corrupt",
+                  "handoff_timeout", "frontdoor_loss")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def trace():
+    return build_requests(6, vocab=CFG.vocab_size, prompt_len=8,
+                          max_new=4, seed=0, arrival_every=1)
+
+
+@pytest.fixture(scope="module")
+def baseline(params, trace):
+    """The gold standard: the same seeded trace through one
+    uninterrupted single-pool engine."""
+    reqs, arrivals = trace
+    eng = ServingEngine(params, CFG, SERVE, metrics_obj=Metrics())
+    out = eng.run(reqs, arrivals)
+    eng.close()
+    return out
+
+
+@pytest.fixture()
+def mock2(monkeypatch):
+    monkeypatch.setenv(ENV_MOCK_FABRIC, "2")
+
+
+def _assert_bit_equal(outputs, baseline):
+    assert sorted(outputs) == sorted(baseline)
+    for rid in baseline:
+        assert outputs[rid] == baseline[rid], f"rid {rid} diverged"
+
+
+# ----------------------------------------------------------------------
+# CRC helpers + wire frames (pure unit)
+# ----------------------------------------------------------------------
+
+def test_crc32_pages_splits_and_detects_flips():
+    data = bytes(range(251)) * 4
+    crcs = crc32_pages(data, 4)
+    assert len(crcs) == 4
+    # whole-buffer checksum is NOT the concatenation trivially, but a
+    # one-byte flip must change exactly the page that holds it
+    flipped = bytearray(data)
+    flipped[300] ^= 0xFF
+    crcs2 = crc32_pages(bytes(flipped), 4)
+    diff = [i for i, (a, b) in enumerate(zip(crcs, crcs2)) if a != b]
+    assert diff == [300 // (len(data) // 4)]
+    # degenerate shapes stay defined
+    assert crc32_pages(b"", 3) == (crc32_bytes(b""),) * 3
+    assert len(crc32_pages(data, 1)) == 1
+
+
+def test_wire_frames_roundtrip_and_verify():
+    k = jax.random.normal(jax.random.PRNGKey(2), (2, 2, 16, 4))
+    v = jax.random.normal(jax.random.PRNGKey(3), (2, 2, 16, 4))
+    payload = encode_kv_run(np.asarray(k), np.asarray(v), 8, None)
+    frames = encode_frames(payload)
+    assert verify_frames(frames) == []
+    # stamp garbage into the k frame: verify names (field, page)
+    bad = dataclasses.replace(
+        frames["k"], buf=b"\x00" * len(frames["k"].buf))
+    assert frames["k"].buf != bad.buf
+    broken = dict(frames, k=bad)
+    named = verify_frames(broken)
+    assert named and all(f == "k" for f, _ in named)
+
+
+# ----------------------------------------------------------------------
+# HandoffTransport (no engine)
+# ----------------------------------------------------------------------
+
+def _payload(seed=4):
+    k = np.asarray(jax.random.normal(jax.random.PRNGKey(seed),
+                                     (2, 2, 16, 4)))
+    v = np.asarray(jax.random.normal(jax.random.PRNGKey(seed + 1),
+                                     (2, 2, 16, 4)))
+    return encode_kv_run(k, v, 8, None)
+
+
+def test_transport_clean_send_is_bit_identical():
+    mx = Metrics()
+    t = HandoffTransport(metrics_obj=mx)
+    p = _payload()
+    res = t.send(p, modeled_ms=0.5, rid=0)
+    assert res.attempts == 1 and res.retries == 0
+    assert res.retry_ms == 0.0
+    np.testing.assert_array_equal(np.asarray(res.payload.k),
+                                  np.asarray(p.k))
+    np.testing.assert_array_equal(np.asarray(res.payload.v),
+                                  np.asarray(p.v))
+    assert t.snapshot()["retries_total"] == 0
+    assert not [d for d in mx.decisions
+                if d["decision"] == "fabric.handoff_retry"]
+
+
+def test_transport_tamper_trips_crc_and_retries_exactly_once():
+    mx = Metrics()
+    t = HandoffTransport(
+        metrics_obj=mx,
+        tamper_fn=lambda index, attempt: index == 0 and attempt == 1)
+    p = _payload()
+    res = t.send(p, modeled_ms=0.5, rid=7, replica=1)
+    assert res.attempts == 2 and res.retries == 1
+    assert res.corrupt_pages > 0 and res.timeouts == 0
+    assert res.retry_ms > 0.5  # wasted wire + backoff
+    np.testing.assert_array_equal(np.asarray(res.payload.k),
+                                  np.asarray(p.k))
+    corrupt = [d for d in mx.decisions
+               if d["decision"] == "fabric.handoff_corrupt"]
+    retry = [d for d in mx.decisions
+             if d["decision"] == "fabric.handoff_retry"]
+    assert len(corrupt) == 1 and corrupt[0]["bad_page_count"] > 0
+    assert len(retry) == 1 and retry[0]["reason"] == "corrupt"
+    assert retry[0]["rid"] == 7 and retry[0]["replica"] == 1
+    # the second transfer is clean: fault fired on transfer 0 only
+    res2 = t.send(_payload(8), modeled_ms=0.5, rid=8)
+    assert res2.retries == 0
+
+
+def test_transport_timeout_plan_and_budget_exhaustion():
+    mx = Metrics()
+    t = HandoffTransport(
+        metrics_obj=mx, max_retries=2, timeout_ms=10.0, backoff_ms=2.0,
+        plan=FaultPlan("handoff_timeout", step=0, duration=1))
+    res = t.send(_payload(), modeled_ms=0.5)
+    assert res.timeouts == 1 and res.retries == 1
+    assert res.retry_ms == pytest.approx(10.0 + 2.0)
+    # a persistent fault (once=False) exhausts the bounded budget
+    t2 = HandoffTransport(
+        metrics_obj=mx, max_retries=2,
+        plan=FaultPlan("handoff_timeout", step=0, duration=1,
+                       once=False))
+    with pytest.raises(HandoffTransportError, match="retry budget"):
+        t2.send(_payload())
+    assert t2.timeout_total == 3  # 1 first attempt + 2 retries
+
+
+def test_transport_backoff_caps_and_validates():
+    t = HandoffTransport(backoff_ms=5.0, backoff_cap_ms=12.0)
+    assert t._backoff(1) == 5.0
+    assert t._backoff(2) == 10.0
+    assert t._backoff(3) == 12.0  # capped, not 20
+    with pytest.raises(ValueError, match="only injects"):
+        HandoffTransport(plan=FaultPlan("nan_grad"))
+    with pytest.raises(ValueError, match="max_retries"):
+        HandoffTransport(max_retries=-1)
+
+
+# ----------------------------------------------------------------------
+# Router fencing + engine evacuate/adopt (no fabric)
+# ----------------------------------------------------------------------
+
+def test_router_mark_failed_fences_and_last_death_raises():
+    depths = {0: 5, 1: 1, 2: 3}
+    router = ReplicaRouter(
+        [lambda i=i: {"queue_depth": depths[i], "active_requests": 0}
+         for i in range(3)], metrics_obj=Metrics(), affinity=False)
+    assert router.route(100) == 1          # JSQ picks the shallowest
+    router.mark_failed(1)
+    assert router.failed() == (1,)
+    for rid in range(101, 110):
+        assert router.route(rid) != 1      # the corpse never serves
+    router.mark_failed(2)
+    assert all(router.route(rid) == 0 for rid in range(110, 115))
+    router.mark_failed(0)
+    with pytest.raises(RuntimeError, match="every replica has failed"):
+        router.route(200)
+    assert router.snapshot()["failed"] == [0, 1, 2]
+
+
+def test_engine_evacuate_returns_all_and_adopt_front(params, trace):
+    reqs, _ = trace
+    eng = ServingEngine(params, CFG, SERVE, metrics_obj=Metrics())
+    for r in reqs[:4]:
+        eng.submit(r)
+    for _ in range(2):          # some admitted, some still queued
+        eng.step()
+    inflight, queued = eng.evacuate()
+    assert len(inflight) + len(queued) == 4 - len(eng.outputs)
+    assert not eng.pending()    # nothing left behind on the corpse
+    # in-flight victims carry their delivered tokens in the resumed
+    # prompt (the bit-equal migration invariant)
+    for entry in inflight:
+        assert len(entry.req.prompt) >= len(entry.orig.prompt)
+    adopter = ServingEngine(params, CFG, SERVE, metrics_obj=Metrics())
+    tail = reqs[4]
+    adopter.submit(tail)
+    for entry in inflight:
+        adopter.adopt(entry, front=True)
+    # front adoption queues ahead of the local arrival and admits
+    # immediately (arrival_step clamped to the adopter's clock);
+    # each front insert prepends, so the head is the LAST adoptee
+    head = adopter.queue[0]
+    assert head.orig.rid == inflight[-1].orig.rid
+    assert head.arrival_step <= adopter.step_idx
+    assert adopter.stats["adopted"] == len(inflight)
+    eng.close()
+    adopter.close()
+
+
+# ----------------------------------------------------------------------
+# Fast per-fault smokes (mocked fabric, virtual clock)
+# ----------------------------------------------------------------------
+
+def test_fabric_crash_migration_bit_equal(params, trace, baseline,
+                                          mock2):
+    reqs, arrivals = trace
+    mx = Metrics()
+    fab = ServingFabric(params, CFG, SERVE, metrics_obj=mx,
+                        vclock=VirtualClock(),
+                        fault_plan=FaultPlan("replica_crash", step=3,
+                                             expert=0))
+    door = FrontDoor(fab)
+    out = door.run(reqs, arrivals)
+    errs = door.validate()
+    door.close()
+    fab.close()
+    _assert_bit_equal(out, baseline)
+    assert errs == []
+    crash = [d for d in mx.decisions
+             if d["decision"] == "fabric.replica_crash"]
+    mig = [d for d in mx.decisions if d["decision"] == "fabric.migrate"]
+    assert len(crash) == 1 and crash[0]["replica"] == 0
+    assert mig and all(d["from_replica"] == 0 for d in mig)
+    assert fab.migrated == len(mig)
+    assert fab.router.failed() == (0,)
+
+
+def test_fabric_transport_corrupt_retries_and_bit_equal(params, trace,
+                                                        baseline,
+                                                        mock2):
+    reqs, arrivals = trace
+    mx = Metrics()
+    t = HandoffTransport(metrics_obj=mx,
+                         plan=FaultPlan("handoff_corrupt", step=1,
+                                        duration=2))
+    fab = ServingFabric(params, CFG, SERVE, metrics_obj=mx,
+                        vclock=VirtualClock(), transport=t)
+    door = FrontDoor(fab)
+    out = door.run(reqs, arrivals)
+    errs = door.validate()
+    door.close()
+    fab.close()
+    _assert_bit_equal(out, baseline)
+    assert errs == []
+    assert t.retries_total == 2      # one retry per faulted transfer
+    drift = [d for d in mx.decisions
+             if d["decision"] == "fabric.handoff_drift"]
+    perturbed = [d for d in drift if d["retry_ms"] > 0]
+    assert len(perturbed) == 2       # retry cost priced into the clock
+    assert fab.handoff.snapshot()["transport"]["corrupt_total"] > 0
+
+
+def test_frontdoor_brownout_sheds_and_recovers(params, mock2):
+    flood, _ = build_requests(10, vocab=CFG.vocab_size, prompt_len=8,
+                              max_new=6, seed=1, arrival_every=0)
+    arrivals = [0, 0, 0, 0, 2, 2, 3, 3, 4, 5]
+    mx = Metrics()
+    fab = ServingFabric(params, CFG, SERVE, metrics_obj=mx,
+                        vclock=VirtualClock())
+    door = FrontDoor(fab, brownout=BrownoutConfig(
+        queue_high=2.0, queue_low=0.5, debounce_steps=1,
+        cooldown_steps=2, episode_budget=2))
+    out = door.run(flood, arrivals)
+    errs = door.validate()
+    snap = door.brownout_snapshot()
+    door.close()
+    fab.close()
+    assert errs == []
+    shed = [d for d in mx.decisions
+            if d["decision"] == "frontdoor.shed"]
+    trans = [d["state"] for d in mx.decisions
+             if d["decision"] == "frontdoor.brownout"]
+    assert snap["shed"] == len(shed) >= 1
+    assert "enter" in trans and "exit" in trans
+    # conservation: every offered request either completed or was shed
+    assert len(out) + len(door.shed_rids) == len(flood)
+    # admitted requests were never touched by the brownout
+    assert all(rid not in out for rid in door.shed_rids)
+
+
+def test_frontdoor_brownout_degrade_caps_tokens(params, mock2):
+    flood, _ = build_requests(8, vocab=CFG.vocab_size, prompt_len=8,
+                              max_new=6, seed=2, arrival_every=0)
+    arrivals = [0, 0, 0, 0, 2, 2, 3, 4]
+    mx = Metrics()
+    fab = ServingFabric(params, CFG, SERVE, metrics_obj=mx,
+                        vclock=VirtualClock())
+    door = FrontDoor(fab, brownout=BrownoutConfig(
+        queue_high=2.0, queue_low=0.5, mode="degrade",
+        degrade_max_new=2, debounce_steps=1, cooldown_steps=2))
+    out = door.run(flood, arrivals)
+    door.close()
+    fab.close()
+    degraded = [d for d in mx.decisions
+                if d["decision"] == "frontdoor.shed"
+                and d["mode"] == "degrade"]
+    assert degraded and door.degraded_rids
+    assert all(d["max_new_tokens"] == 2 for d in degraded)
+    # degraded requests complete (short), nothing is dropped; outputs
+    # echo the 8-token prompt, so the cap shows as prompt + 2
+    assert len(out) == len(flood)
+    for d in degraded:
+        assert len(out[d["rid"]]) <= 8 + 2
+
+
+def test_frontdoor_cluster_failover_bit_equal(params, trace, baseline,
+                                              mock2):
+    reqs, arrivals = trace
+    mx = Metrics()
+    fab = ServingFabric(params, CFG, SERVE, metrics_obj=mx,
+                        vclock=VirtualClock())
+    cl = FrontDoorCluster(fab, n_doors=2, n_shards=8, metrics_obj=mx)
+    out = cl.run(reqs, arrivals, fail_at=2, fail_peer=0)
+    errs = cl.validate()
+    snap = cl.snapshot()
+    doc = cl.fleet_trace_document()
+    cl.close()
+    fab.close()
+    _assert_bit_equal(out, baseline)
+    assert errs == []                # zero orphan spans post-failover
+    assert doc["traceEvents"]
+    fo = [d for d in mx.decisions
+          if d["decision"] == "frontdoor.failover"]
+    assert fo and all(d["from_peer"] == 0 and d["to_peer"] != 0
+                      for d in fo)
+    assert all(d["epoch"] >= 1 for d in fo)
+    assert snap["max_epoch"] >= 1 and snap["dead"] == [0]
+    # every lease ended up owned by a survivor
+    assert all(lease["owner"] != 0 for lease in cl.leases.values())
+
+
+def test_frontdoor_cluster_validates_and_fences(params, mock2):
+    fab = ServingFabric(params, CFG, SERVE, metrics_obj=Metrics(),
+                        vclock=VirtualClock())
+    cl = FrontDoorCluster(fab, n_doors=2, n_shards=8,
+                          metrics_obj=Metrics())
+    with pytest.raises(ValueError, match="door"):
+        FrontDoorCluster(fab, n_doors=0)
+    cl.fail_door(0)
+    with pytest.raises(RuntimeError, match="last live"):
+        cl.fail_door(1)
+    cl.close()
+    fab.close()
+
+
+# ----------------------------------------------------------------------
+# Registry / matrix bookkeeping
+# ----------------------------------------------------------------------
+
+def test_serving_faults_registered_with_tiers():
+    for fault in SERVING_FAULTS:
+        assert fault in FAULTS
+        assert EXPECTED_TIER[fault].startswith("fabric:")
+    for name in ("fabric.handoff_corrupt", "fabric.handoff_retry",
+                 "fabric.migrate", "fabric.replica_crash",
+                 "frontdoor.brownout", "frontdoor.failover",
+                 "frontdoor.shed"):
+        assert name in DECISION_NAMES
+
+
+def test_brownout_config_validates():
+    with pytest.raises(ValueError):
+        BrownoutConfig(queue_high=2.0, queue_low=3.0)
+    with pytest.raises(ValueError):
+        BrownoutConfig(mode="panic")
+    with pytest.raises(ValueError):
+        BrownoutConfig(degrade_max_new=0)
+    with pytest.raises(ValueError):
+        BrownoutConfig(episode_budget=0)
+
+
+def test_reference_shed_frac_matches_committed_sentry_row():
+    import json
+
+    from flashmoe_tpu.telemetry_plane.regression import (
+        _reference_shed_frac,
+    )
+
+    frac = _reference_shed_frac(BrownoutConfig())
+    assert 0.0 < frac < 1.0
+    hist = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "obs", "history.jsonl")
+    with open(hist) as f:
+        entry = json.loads(f.readline())
+    row = entry["metrics"]["fabric_shed_frac[brownout,reference]"]
+    assert row["value"] == pytest.approx(round(frac, 4))
+    assert row["unit"] == "frac"
+
+
+def test_fabric_fault_sweep_record_contract(monkeypatch):
+    """The bench sweep's record shape, with the drills faked out —
+    the real drills run under the slow mark below."""
+    from flashmoe_tpu.chaos import drill as drill_mod
+    from flashmoe_tpu.serving import loadgen
+
+    def fake_drill(fault, *, seed=0, **kw):
+        return drill_mod.DrillResult(
+            fault=fault, expected_tier=EXPECTED_TIER[fault],
+            recovered=(fault != "handoff_timeout"), reason="boom",
+            final_step=6, steps_rerun=0, wall_s=0.123,
+            evidence={"completed": 6, "bit_equal_to_baseline": True,
+                      "migrations": 2, "retries": 1, "corrupt": 1,
+                      "failovers": 0, "trace_errors": []},
+            decisions=[])
+
+    monkeypatch.setattr(drill_mod, "run_drill", fake_drill)
+    monkeypatch.setattr(loadgen, "_brownout_shed_record",
+                        lambda *, seed=0: {"metric":
+                                           "fabric_shed[brownout]",
+                                           "value": 0.4,
+                                           "unit": "frac"})
+    recs = loadgen.fabric_fault_sweep(seed=0)
+    assert [r["metric"] for r in recs] == [
+        "fabric_fault[replica_crash]", "fabric_fault[handoff_corrupt]",
+        "fabric_fault[handoff_timeout]",
+        "fabric_fault[frontdoor_loss]", "fabric_shed[brownout]"]
+    crash = recs[0]
+    assert crash["unit"] == "ms" and crash["value"] == 123.0
+    assert crash["migrated"] == 2 and crash["retries"] == 1
+    assert crash["bit_equal"] is True and "error" not in crash
+    # an unrecovered drill carries error so the sentry skips it
+    assert recs[2]["error"] == "boom"
+    with pytest.raises(ValueError, match="not serving faults"):
+        loadgen.fabric_fault_sweep(["nan_grad"])
+
+
+# ----------------------------------------------------------------------
+# The chaos-matrix drills (slow lane)
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fault", SERVING_FAULTS)
+def test_serving_fault_drill_recovers(fault):
+    from flashmoe_tpu.chaos.drill import run_drill
+
+    r = run_drill(fault)
+    assert r.recovered, f"{fault}: {r.reason}"
+    ev = r.evidence
+    assert ev["bit_equal_to_baseline"] is True
+    assert ev["trace_errors"] == []
+    assert ev["fleet_trace_events"] > 0
+    if fault == "replica_crash":
+        assert ev["crashes"] == 1 and ev["migrations"] >= 1
+    elif fault in ("handoff_corrupt", "handoff_timeout"):
+        assert ev["retries"] == 2 and ev["retried_drift"] == 2
+    elif fault == "frontdoor_loss":
+        assert ev["failovers"] >= 1

@@ -142,6 +142,7 @@ def test_transport_cost_model_prefers_aggregation():
 # Per-hop wire dtypes (MoEConfig.wire_dtype_dcn, ISSUE 13)
 # ----------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_dcn_wire_inert_on_flat_and_off_identical(devices):
     """wire_dtype_dcn must be a pure DCN-hop knob: on the flat exchange
     it is inert (bit-identical output), and on the hierarchical
@@ -166,6 +167,7 @@ def test_dcn_wire_inert_on_flat_and_off_identical(devices):
                                   np.asarray(hier.out))
 
 
+@pytest.mark.slow
 def test_dcn_wire_fp8_hop_close_to_oracle_with_per_hop_error(devices):
     """An fp8 DCN hop under a raw ICI hop: output stays close to the
     oracle (one fp8 round trip per leg), and MoEStats reports the two

@@ -121,6 +121,7 @@ COLLECTIVES = ("all_to_all", "psum", "pmean", "all_gather", "ppermute",
                "ragged_all_to_all")
 
 
+@pytest.mark.slow
 def test_ep_stats_off_bit_identical_no_extra_collectives(devices):
     from flashmoe_tpu.parallel.ep import ep_moe_layer
     from flashmoe_tpu.parallel.mesh import make_mesh

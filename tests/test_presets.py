@@ -40,6 +40,7 @@ def test_preset_layer_runs_small(name):
         )
 
 
+@pytest.mark.slow
 def test_weak_scaling_256_bench_config(devices):
     """BASELINE config #5 (256-expert weak-scaling / payload-skew) must be
     driver-invokable by name (bench.py --config weak_scaling_256) and

@@ -183,6 +183,7 @@ def test_invariant_engine_covers_expert_quant(devices):
 # Execution: closeness + fake-quant/pre-quant identity
 # ----------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_reference_config_int8_closeness_gate():
     """THE acceptance numerics gate: int8 per-channel quantized
     MoE-layer output within 2e-2 relative error of the f32 layer on
@@ -204,6 +205,7 @@ def test_reference_config_int8_closeness_gate():
                                   np.asarray(base.expert_counts))
 
 
+@pytest.mark.slow
 def test_fake_quant_bit_identical_to_prequantized_state(setup, devices):
     """cfg.expert_quant with full-precision params fake-quants in-graph
     with the SAME absmax arithmetic quantize_state bakes offline — the
@@ -227,6 +229,7 @@ def test_fake_quant_bit_identical_to_prequantized_state(setup, devices):
     assert 0 < rel <= 2e-2
 
 
+@pytest.mark.slow
 def test_quant_error_stat_rides_moestats(setup, devices):
     cfg, params, x = setup
     mesh = make_mesh(cfg, dp=1, devices=devices[:4])

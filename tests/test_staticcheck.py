@@ -84,6 +84,7 @@ def test_lint_clean_on_repo():
     assert run_lint() == []
 
 
+@pytest.mark.slow
 def test_cli_all_json(capsys, devices):
     """The CI entry point: ``--all`` runs every engine and exits 0 on
     the repo (nonzero path proven by the planted tests below)."""

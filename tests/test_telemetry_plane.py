@@ -460,8 +460,8 @@ def test_reference_points_deterministic():
 
     a, b = reg.reference_points(), reg.reference_points()
     assert a == b and len(a) >= 3
-    assert all(v["unit"] in ("ms", "hidden_frac") and v["value"] > 0
-               for v in a.values())
+    assert all(v["unit"] in ("ms", "hidden_frac", "frac")
+               and v["value"] > 0 for v in a.values())
     # the measured-latency plane rides along (PR 17): a virtual-clock
     # TTFT and a hidden-fraction point per golden config
     assert any(k.startswith("fabric_ttft_vclock_ms[") and
@@ -469,6 +469,12 @@ def test_reference_points_deterministic():
     assert any(k.startswith("fabric_handoff_hidden_frac[") and
                v["unit"] == "hidden_frac" and 0 < v["value"] <= 1.0
                for k, v in a.items())
+    # PR 18: fault-recovery latency per golden config plus the analytic
+    # brownout shed fraction, gating the serving-side failure ladder
+    assert any(k.startswith("fabric_recovery_ms[") and
+               v["unit"] == "ms" for k, v in a.items())
+    shed = a["fabric_shed_frac[brownout,reference]"]
+    assert shed["unit"] == "frac" and 0 < shed["value"] < 1.0
 
 
 def test_check_regression_zero_baseline_direction_aware():

@@ -170,6 +170,7 @@ def test_ep_wire_on_tracks_oracle(wd, wc, devices):
         assert 0.0 < float(on.stats.wire_rtq_error) < 0.1
 
 
+@pytest.mark.slow
 def test_hierarchical_a2a_wire_roundtrip_matches_flat(devices):
     """The two-stage (intra-slice, inter-slice) exchange must carry
     payload AND fp8 scales consistently through both hops: with the wire
