@@ -470,7 +470,7 @@ def _bench_serve(loads, *, requests: int, max_batch: int,
 
 def _bench_fabric(loads, *, requests: int, max_batch: int,
                   telemetry_port: int | None = None,
-                  vclock: bool = False):
+                  vclock: bool = False, wire: str = "inproc"):
     """Disaggregated-fabric offered-load sweep (``--fabric``): the
     :class:`~flashmoe_tpu.fabric.engine.ServingFabric` driven over
     mocked 1/2/4-replica worlds (``FLASHMOE_MOCK_FABRIC``, set per
@@ -483,13 +483,17 @@ def _bench_fabric(loads, *, requests: int, max_batch: int,
     clock behind the front door — TTFT/TPOT are measured UNDER the
     modeled DCN delay and each record adds the measured-vs-priced
     handoff fields plus the per-request attribution rollup
-    (docs/OBSERVABILITY.md 'Virtual clock')."""
+    (docs/OBSERVABILITY.md 'Virtual clock').
+
+    ``wire`` (``--wire tcp``): every KV handoff crosses a real
+    localhost socket; the record identity gains a ``wire=tcp`` tag so
+    the sentry baselines socket and in-process throughput apart."""
     from flashmoe_tpu.serving.loadgen import fabric_load_sweep
 
     for rec in fabric_load_sweep(loads, n_requests=requests,
                                  max_batch=max_batch,
                                  telemetry_port=telemetry_port,
-                                 vclock=vclock):
+                                 vclock=vclock, wire=wire):
         print(json.dumps(rec), flush=True)
         _flush_observability(rec)
 
@@ -1249,10 +1253,22 @@ def main():
                          "tolerance sweep instead of the load sweep — "
                          "one record per chaos fault (replica_crash / "
                          "handoff_corrupt / handoff_timeout / "
-                         "frontdoor_loss) with recovery latency, "
-                         "migrated-request count, retry totals and "
-                         "shed fraction (docs/RESILIENCE.md "
+                         "frontdoor_loss / net_partition / "
+                         "lease_split_brain / replica_stall / "
+                         "lease_torn_write) with recovery latency, "
+                         "migrated-request count, retry totals, "
+                         "heartbeat detection latency and shed "
+                         "fraction (docs/RESILIENCE.md "
                          "'Serving-side ladder')")
+    ap.add_argument("--wire", default="inproc",
+                    choices=("inproc", "tcp"),
+                    help="with --fabric: the KV-handoff wire for the "
+                         "load sweep — 'tcp' sends every transfer "
+                         "through a real localhost socket (length-"
+                         "prefixed frames + per-page CRC verify) and "
+                         "tags each record's identity with wire=tcp; "
+                         "'inproc' (default) is the byte-identical "
+                         "in-process path")
     ap.add_argument("--serve-loads", default="4,2,1",
                     help="comma-separated arrival gaps in engine "
                          "steps, lightest first (smaller = higher "
@@ -1339,6 +1355,14 @@ def main():
     if args.faults and args.telemetry_port is not None:
         ap.error("--faults drives self-contained chaos drills with "
                  "no live scrape window; drop --telemetry-port")
+    if args.wire != "inproc" and not args.fabric:
+        ap.error("--wire applies with --fabric only (the socket wire "
+                 "carries KV handoffs between fabric pools; no other "
+                 "mode moves KV pages)")
+    if args.faults and args.wire != "inproc":
+        ap.error("--faults picks each drill's wire itself "
+                 "(net_partition runs tcp, the rest in-process); "
+                 "drop --wire")
     if args.regression and (args.ckpt or args.overlap or args.sweep
                             or args.tiles or args.quant):
         ap.error("--regression appends measured runs from the "
@@ -1496,7 +1520,7 @@ def main():
         else:
             _bench_fabric([4, 2, 1], requests=8, max_batch=4,
                           telemetry_port=args.telemetry_port,
-                          vclock=args.vclock)
+                          vclock=args.vclock, wire=args.wire)
         _finish_regression()
         return
     if args.tiles:

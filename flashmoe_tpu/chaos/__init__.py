@@ -34,7 +34,9 @@ FAULTS = ("nan_expert", "nan_grad", "grad_spike", "slow_step",
           "device_loss", "skew_sustained", "slow_device",
           "dcn_latency", "dcn_jitter",
           "replica_crash", "handoff_corrupt", "handoff_timeout",
-          "frontdoor_loss")
+          "frontdoor_loss",
+          "net_partition", "lease_split_brain", "replica_stall",
+          "lease_torn_write")
 
 #: which recovery tier is expected to absorb each fault.  The
 #: ``controller:*`` tiers are the self-healing runtime controller
@@ -71,6 +73,17 @@ EXPECTED_TIER = {
     "handoff_corrupt": "fabric:handoff_retry",
     "handoff_timeout": "fabric:handoff_retry",
     "frontdoor_loss": "fabric:frontdoor_failover",
+    # cross-process faults (PR 19): a wire that drops a transfer
+    # mid-stream is retried on a fresh connection; a zombie door
+    # re-asserting a revoked lease is REFUSED by the store's epoch
+    # fencing; a replica that hangs mid-step (not dead — the probe
+    # still answers) is caught by the sub-step heartbeat watchdog and
+    # migrated; a lease writer killed mid-append is rolled back to the
+    # last intact CRC-framed record
+    "net_partition": "fabric:partition_retry",
+    "lease_split_brain": "fabric:lease_fence",
+    "replica_stall": "fabric:heartbeat_migrate",
+    "lease_torn_write": "fabric:lease_repair",
 }
 
 
@@ -104,7 +117,8 @@ class FaultPlan:
                sustained faults: a one-step blip must never trigger a
                morph or re-placement.  For the DCN faults AND the
                handoff transport faults (handoff_corrupt /
-               handoff_timeout) the window is over TRANSFER index, not
+               handoff_timeout / net_partition) the window is over
+               TRANSFER index, not
                engine step; with ``once`` a faulted transfer's retry
                is clean (exactly one retry), with ``once=False`` every
                attempt fails until the retry budget gives up.

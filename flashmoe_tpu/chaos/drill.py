@@ -634,7 +634,22 @@ def _run_fabric_fault_drill(fault: str, *, seed: int) -> DrillResult:
       retry tier, reason ``timeout``;
     * ``frontdoor_loss``   — a front-door PEER dies mid-run; its
       namespace leases fail over to the survivors with bumped epochs
-      (``fabric:frontdoor_failover``).
+      (``fabric:frontdoor_failover``);
+    * ``net_partition``    — the tcp wire drops transfers MID-STREAM
+      (partial bytes really cross a kernel socket and the receiver
+      really discards them); the sender reconnects and retries
+      (``fabric:partition_retry``);
+    * ``lease_split_brain`` — the lease table lives in an EXTERNAL
+      fcntl-locked store; after a failover the dead peer plays zombie
+      and re-asserts a moved shard at its stale epoch — the store's
+      fencing token REFUSES it, zero requests double-served
+      (``fabric:lease_fence``);
+    * ``replica_stall``    — a decode replica hangs MID-STEP (its
+      health probe still answers); the sub-step heartbeat deadline
+      catches it and the victims migrate (``fabric:heartbeat_migrate``);
+    * ``lease_torn_write`` — a lease writer is killed mid-append; the
+      store's CRC framing refuses the torn record and rolls back to
+      the last intact epoch (``fabric:lease_repair``).
 
     Recovery must be INVISIBLE to the tokens: every request completes
     with a token stream bit-equal to an uninterrupted single-pool
@@ -645,8 +660,8 @@ def _run_fabric_fault_drill(fault: str, *, seed: int) -> DrillResult:
     import os
 
     from flashmoe_tpu.fabric import (
-        FrontDoor, FrontDoorCluster, HandoffTransport, ServingFabric,
-        VirtualClock,
+        FrontDoor, FrontDoorCluster, HandoffTransport, HeartbeatConfig,
+        LeaseStore, ServingFabric, StaleLeaseError, VirtualClock,
     )
     from flashmoe_tpu.fabric.topo import ENV_MOCK_FABRIC
     from flashmoe_tpu.models.transformer import init_params
@@ -674,12 +689,19 @@ def _run_fabric_fault_drill(fault: str, *, seed: int) -> DrillResult:
     os.environ[ENV_MOCK_FABRIC] = "2"
     t0 = time.perf_counter()
     error, fab, door, cluster, transport = None, None, None, None, None
+    store, store_path = None, None
+    zombie_attempts, zombie_refused = 0, 0
+    torn_bytes, restored_epoch = 0, -1
     outputs: dict = {}
     att: dict = {}
     trace_errors: list = []
     fleet_doc: dict = {}
     try:
         vc = VirtualClock()
+        if fault in ("lease_split_brain", "lease_torn_write"):
+            fd, store_path = tempfile.mkstemp(
+                prefix="flashmoe-drill-leases-", suffix=".bin")
+            os.close(fd)
         if fault in ("handoff_corrupt", "handoff_timeout"):
             # window over TRANSFER index, first attempt only (once):
             # two faulted transfers, each retried exactly once
@@ -704,6 +726,68 @@ def _run_fabric_fault_drill(fault: str, *, seed: int) -> DrillResult:
                                        metrics_obj=metrics)
             outputs = cluster.run(reqs, arrivals, fail_at=2,
                                   fail_peer=0)
+        elif fault == "net_partition":
+            # the REAL tcp wire: two transfers are cut mid-stream at
+            # the kernel socket layer (partial bytes actually cross),
+            # the receiver discards the torn frames, the sender
+            # reconnects and retries on the capped-backoff ladder
+            transport = HandoffTransport(
+                metrics_obj=metrics, wire="tcp",
+                plan=FaultPlan(fault, step=2, duration=2, seed=seed))
+            fab = ServingFabric(params, cfg, serve, metrics_obj=metrics,
+                                vclock=vc, transport=transport)
+            door = FrontDoor(fab)
+            outputs = door.run(reqs, arrivals)
+        elif fault == "lease_split_brain":
+            store = LeaseStore(store_path, metrics_obj=metrics)
+            fab = ServingFabric(params, cfg, serve, metrics_obj=metrics,
+                                vclock=vc)
+            cluster = FrontDoorCluster(fab, n_doors=2, n_shards=8,
+                                       metrics_obj=metrics, store=store)
+            # the epochs the doomed peer believes it holds, BEFORE the
+            # failover moves them
+            stale = {s: ls.epoch for s, ls in store.leases().items()
+                     if ls.owner == 0}
+            outputs = cluster.run(reqs, arrivals, fail_at=2,
+                                  fail_peer=0)
+            # the zombie arm: the failed peer wakes back up and
+            # re-asserts every shard it lost, using the fencing token
+            # it believes is next — every write must be REFUSED
+            for shard, epoch in sorted(stale.items()):
+                zombie_attempts += 1
+                try:
+                    store.write_lease(shard, 0, epoch + 1,
+                                      reason="zombie_reassert")
+                except StaleLeaseError:
+                    zombie_refused += 1
+        elif fault == "replica_stall":
+            # the victim hangs MID-STEP (after its admit heartbeat,
+            # inside prefill); its probe still answers, so only the
+            # sub-step heartbeat deadline can catch it
+            fab = ServingFabric(
+                params, cfg, serve, metrics_obj=metrics, vclock=vc,
+                heartbeat=HeartbeatConfig(misses_to_stall=2),
+                fault_plan=FaultPlan(fault, step=3, expert=0,
+                                     seed=seed))
+            door = FrontDoor(fab)
+            outputs = door.run(reqs, arrivals)
+        elif fault == "lease_torn_write":
+            # seed a store, advance shard 3 to epoch 1, then kill the
+            # writer mid-append of epoch 2 — the torn record must be
+            # refused and the table rolled back to epoch 1
+            store = LeaseStore(store_path, metrics_obj=metrics)
+            store.init_leases({s: s % 2 for s in range(8)})
+            store.write_lease(3, 1, 1, reason="pre_crash")
+            store.write_lease(3, 1, 2, reason="crash_victim")
+            torn_bytes = store.tear_last_record()
+            fab = ServingFabric(params, cfg, serve, metrics_obj=metrics,
+                                vclock=vc)
+            # the cluster's first mutating write repairs the tail
+            cluster = FrontDoorCluster(fab, n_doors=2, n_shards=8,
+                                       metrics_obj=metrics, store=store)
+            restored_epoch = store.leases()[3].epoch
+            outputs = cluster.run(reqs, arrivals, fail_at=2,
+                                  fail_peer=0)
         else:
             raise ValueError(f"not a fabric fault: {fault!r}")
         authority = cluster if cluster is not None else door
@@ -720,6 +804,13 @@ def _run_fabric_fault_drill(fault: str, *, seed: int) -> DrillResult:
             cluster.close()
         if fab is not None:
             fab.close()
+        if transport is not None:
+            transport.close()
+        if store_path is not None:
+            try:
+                os.unlink(store_path)
+            except OSError:
+                pass
         if saved is None:
             os.environ.pop(ENV_MOCK_FABRIC, None)
         else:
@@ -745,6 +836,15 @@ def _run_fabric_fault_drill(fault: str, *, seed: int) -> DrillResult:
         "migrations": len(named("fabric.migrate")),
         "crashes": len(named("fabric.replica_crash")),
         "failovers": len(named("frontdoor.failover")),
+        "partitions": len(named("fabric.partition")),
+        "fences": len(named("frontdoor.fence")),
+        "lease_repairs": len(named("frontdoor.lease_repair")),
+        "stalls": len(named("fabric.heartbeat_stall")),
+        "heartbeat_misses": len(named("fabric.heartbeat_miss")),
+        "zombie_attempts": zombie_attempts,
+        "zombie_refused": zombie_refused,
+        "torn_bytes": torn_bytes,
+        "restored_epoch": restored_epoch,
         "retried_drift": len(retried_drift),
         "trace_errors": trace_errors,
         "fleet_trace_events": len(fleet_doc.get("traceEvents", [])),
@@ -801,6 +901,63 @@ def _run_fabric_fault_drill(fault: str, *, seed: int) -> DrillResult:
              "a failover did not bump its lease epoch")
         need(all(d["to_peer"] != 0 for d in fo),
              "a lease failed over TO the dead peer")
+    elif fault == "net_partition":
+        retries = named("fabric.handoff_retry")
+        parts = named("fabric.partition")
+        need(len(parts) == 2,
+             f"expected 2 partitioned transfers, saw {len(parts)}")
+        need(all(d["wire"] == "tcp" and d["injected"] for d in parts),
+             "a partition verdict did not come off the tcp wire")
+        need(all(d.get("dropped_bytes", 0) > 0 for d in parts),
+             "no partial bytes actually crossed the socket before "
+             "the cut")
+        need(len(retries) == 2
+             and all(d["reason"] == "reset" for d in retries),
+             f"expected 2 retries with reason=reset, saw "
+             f"{[d.get('reason') for d in retries]}")
+        need(len(retried_drift) == 2,
+             "retry cost never reconciled through the vclock "
+             "(fabric.handoff_drift retry_ms)")
+        need(att and all(sums_ok),
+             "attribution no longer sums to the request span")
+    elif fault == "lease_split_brain":
+        fo = named("frontdoor.failover")
+        fences = named("frontdoor.fence")
+        need(len(fo) >= 1, "no lease failed over off the dead peer")
+        need(zombie_attempts >= 1,
+             "the zombie never re-asserted a moved shard")
+        need(zombie_refused == zombie_attempts,
+             f"split brain: {zombie_attempts - zombie_refused} zombie "
+             f"stale-epoch writes were ACCEPTED")
+        need(len(fences) == zombie_refused
+             and all(d["refused"] for d in fences),
+             "a refusal was not logged as a frontdoor.fence decision")
+    elif fault == "replica_stall":
+        stalls = named("fabric.heartbeat_stall")
+        need(len(stalls) == 1,
+             "the mid-step hang was never declared a stall")
+        need(evidence["heartbeat_misses"] >= 2,
+             "the watchdog skipped its hysteresis window")
+        need(stalls and stalls[0]["detect_ms"] > 0,
+             "stall detection latency was not priced")
+        need(stalls and stalls[0]["step"] > 3,
+             "stall declared at or before the hang step — the probe "
+             "false-positived where only heartbeats can see")
+        need(evidence["crashes"] == 1,
+             "the stalled replica was never fenced off")
+        need(evidence["migrations"] >= 1,
+             "no request migrated off the stalled replica")
+    elif fault == "lease_torn_write":
+        reps = named("frontdoor.lease_repair")
+        need(torn_bytes > 0, "the kill never tore any bytes")
+        need(len(reps) >= 1,
+             "the torn tail was never repaired "
+             "(frontdoor.lease_repair)")
+        need(restored_epoch == 1,
+             f"rolled back to epoch {restored_epoch}, wanted the "
+             f"last intact epoch 1")
+        need(evidence["failovers"] >= 1,
+             "failover on top of the repaired store never happened")
 
     clear()
     return DrillResult(
@@ -820,7 +977,8 @@ def run_drill(fault: str, *, num_steps: int = 6, checkpoint_every: int = 2,
         # clock, not the training loop (num_steps etc. do not apply)
         return _run_vclock_drill(fault, seed=seed)
     if fault in ("replica_crash", "handoff_corrupt", "handoff_timeout",
-                 "frontdoor_loss"):
+                 "frontdoor_loss", "net_partition", "lease_split_brain",
+                 "replica_stall", "lease_torn_write"):
         # the serving fault-tolerance ladder: drilled against a mocked
         # 2-replica fabric, recovery judged by token bit-equality
         return _run_fabric_fault_drill(fault, seed=seed)

@@ -25,10 +25,15 @@ from flashmoe_tpu.fabric.frontdoor import FrontDoor, FrontDoorCluster
 from flashmoe_tpu.fabric.handoff import (
     KVHandoff, decode_kv_run, encode_kv_run,
 )
+from flashmoe_tpu.fabric.leasestore import (
+    HeartbeatConfig, HeartbeatPublisher, HeartbeatWatchdog, LeaseStore,
+    StaleLeaseError,
+)
 from flashmoe_tpu.fabric.router import ReplicaRouter
 from flashmoe_tpu.fabric.topo import fabric_world
 from flashmoe_tpu.fabric.transport import (
-    HandoffTransport, HandoffTransportError,
+    HandoffTransport, HandoffTransportError, WIRE_MODES,
+    wire_overhead_ms,
 )
 from flashmoe_tpu.fabric.vclock import VirtualClock
 
@@ -37,11 +42,18 @@ __all__ = [
     "FrontDoorCluster",
     "HandoffTransport",
     "HandoffTransportError",
+    "HeartbeatConfig",
+    "HeartbeatPublisher",
+    "HeartbeatWatchdog",
     "KVHandoff",
+    "LeaseStore",
     "ReplicaRouter",
     "ServingFabric",
+    "StaleLeaseError",
     "VirtualClock",
+    "WIRE_MODES",
     "decode_kv_run",
     "encode_kv_run",
     "fabric_world",
+    "wire_overhead_ms",
 ]

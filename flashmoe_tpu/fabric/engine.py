@@ -37,6 +37,9 @@ token regardless of how requests land on replicas.
 
 from __future__ import annotations
 
+import os
+import tempfile
+
 import jax
 
 from flashmoe_tpu.config import MoEConfig
@@ -45,6 +48,13 @@ from flashmoe_tpu.fabric.router import ReplicaRouter
 from flashmoe_tpu.fabric.topo import fabric_world
 from flashmoe_tpu.serving.engine import ServeConfig, ServingEngine
 from flashmoe_tpu.utils.telemetry import metrics as _global_metrics
+
+
+class _ReplicaStallInjected(RuntimeError):
+    """A ``replica_stall`` chaos plan hung the victim mid-step: its
+    engine never returns from this step.  The fabric models the hung
+    thread by catching this and never stepping the replica again —
+    NOTHING announces the stall; only the heartbeat deadline can."""
 
 
 class ServingFabric:
@@ -70,7 +80,7 @@ class ServingFabric:
                  metrics_obj=None, controller=None, recorder=None,
                  telemetry_port=None, affinity: bool = True,
                  vclock=None, tracer=None, transport=None,
-                 fault_plan=None):
+                 fault_plan=None, heartbeat=None):
         """``vclock``: a :class:`~flashmoe_tpu.fabric.vclock.
         VirtualClock` the whole fabric steps on — one lane per replica,
         tick resolved from the pool plan's decode objective when unset;
@@ -87,12 +97,29 @@ class ServingFabric:
         ``replica_crash`` — replica ``plan.expert % n_replicas`` dies
         silently at fabric step ``plan.step``; the crash DETECTOR
         (health probes at the top of every step) notices and migrates
-        its requests, it is never told."""
+        its requests, it is never told — or ``replica_stall``: the
+        victim HANGS mid-step (its health probe still answers, so only
+        the sub-step heartbeat deadline catches it).  ``heartbeat``: a
+        :class:`~flashmoe_tpu.fabric.leasestore.HeartbeatConfig` —
+        every replica publishes sub-step heartbeats into the external
+        lease store and a watchdog declares a replica with pending work
+        stalled after ``misses_to_stall`` beat-less fabric steps,
+        triggering the same fence+evacuate+adopt migration a detected
+        crash takes; None (default) publishes nothing — byte-identical
+        to the PR 18 fabric."""
         if fault_plan is not None \
-                and fault_plan.fault != "replica_crash":
+                and fault_plan.fault not in ("replica_crash",
+                                             "replica_stall"):
             raise ValueError(
-                f"ServingFabric only injects 'replica_crash', got "
-                f"plan fault {fault_plan.fault!r}")
+                f"ServingFabric only injects 'replica_crash' / "
+                f"'replica_stall', got plan fault {fault_plan.fault!r}")
+        if fault_plan is not None \
+                and fault_plan.fault == "replica_stall" \
+                and heartbeat is None:
+            raise ValueError(
+                "a replica_stall plan needs heartbeat= armed: a "
+                "mid-step hang is only detectable through the sub-step "
+                "heartbeat deadline (probes still answer)")
         self.fault_plan = fault_plan
         self.cfg = cfg
         self.serve = serve if serve is not None else ServeConfig()
@@ -156,6 +183,39 @@ class ServingFabric:
             decode_step_ms=decode_step_ms, vclock=self.vclock,
             transport=transport)
 
+        # ---- sub-step heartbeats (external lease store) --------------
+        self.heartbeat_cfg = heartbeat
+        self.lease_store = None
+        self.hb_watchdog = None
+        self._own_store_path = None
+        self._stalled: set[int] = set()  # hung mid-step (undetected
+        #                                  until the heartbeat deadline)
+        hb_fns: list = [None] * self.n_replicas
+        if heartbeat is not None:
+            from flashmoe_tpu.fabric.leasestore import (
+                HeartbeatPublisher, HeartbeatWatchdog, LeaseStore,
+            )
+
+            store_path = heartbeat.store_path
+            if store_path is None:
+                fd, store_path = tempfile.mkstemp(
+                    prefix="flashmoe-leases-", suffix=".bin")
+                os.close(fd)
+                self._own_store_path = store_path
+            self.lease_store = LeaseStore(
+                store_path, metrics_obj=self.metrics)
+            tick = (self.vclock.tick_ms if self.vclock is not None
+                    else (decode_step_ms or 0.0))
+            self.hb_watchdog = HeartbeatWatchdog(
+                self.lease_store,
+                misses_to_stall=heartbeat.misses_to_stall,
+                tick_ms=tick, metrics_obj=self.metrics)
+            for i in range(self.n_replicas):
+                pub = HeartbeatPublisher(
+                    self.lease_store, i, clock=self.vclock,
+                    step_fn=(lambda i=i: self.engines[i].step_idx))
+                hb_fns[i] = self._wrap_heartbeat(i, pub)
+
         # ---- decode replicas -----------------------------------------
         pools_info = (self.pool_plan.snapshot()
                       if self.pool_plan is not None else None)
@@ -165,7 +225,7 @@ class ServingFabric:
                 metrics_obj=self.metrics, recorder=recorder,
                 replica_tag=f"r{i}", prefill_fn=self.handoff.prefill_fn(i),
                 pools_info=pools_info, clock=self.vclock,
-                tracer=tracer)
+                tracer=tracer, heartbeat_fn=hb_fns[i])
             for i in range(self.n_replicas)
         ]
         # the router probes through the fabric's crash filter: a killed
@@ -203,6 +263,7 @@ class ServingFabric:
             "completed": sum(r["completed"] for r in reps),
             "evictions": sum(r["evictions"] for r in reps),
             "crashed": sorted(self._crashed),
+            "stalled": sorted(self._stalled),
             "migrated": self.migrated,
             "router": self.router.snapshot(),
             "replicas": reps,
@@ -218,6 +279,10 @@ class ServingFabric:
             "handoff": self.handoff.snapshot(),
             "vclock": (self.vclock.snapshot()
                        if self.vclock is not None else None),
+            "lease_store": (self.lease_store.snapshot()
+                            if self.lease_store is not None else None),
+            "watchdog": (self.hb_watchdog.snapshot()
+                         if self.hb_watchdog is not None else None),
             "router": self.router.snapshot(),
             "engines": [e._vars_snapshot() for e in self.engines],
         }
@@ -228,8 +293,34 @@ class ServingFabric:
             self.telemetry = None
         for e in self.engines:
             e.close()
+        if self._own_store_path is not None:
+            try:
+                os.unlink(self._own_store_path)
+            except OSError:
+                pass
+            self._own_store_path = None
 
     # ---- crash detection + request migration -------------------------
+
+    def _wrap_heartbeat(self, i: int, publisher):
+        """Replica ``i``'s heartbeat callable, with the
+        ``replica_stall`` injection spliced in: at fabric step
+        ``plan.step`` the victim hangs at the ``prefill`` phase
+        boundary — it beat at ``admit``, then went silent mid-step,
+        before any token sampled.  Everything already admitted/prefilled
+        is the partial-step work the migration must reconcile."""
+        def beat(phase: str) -> None:
+            p = self.fault_plan
+            if (p is not None and p.fault == "replica_stall"
+                    and i == p.expert % self.n_replicas
+                    and self.step_idx == p.step
+                    and phase == "prefill"
+                    and i not in self._stalled):
+                raise _ReplicaStallInjected(
+                    f"chaos: replica r{i} hung mid-step "
+                    f"{self.step_idx} at phase {phase!r}")
+            publisher(phase)
+        return beat
 
     def _probe_fn(self, i: int):
         """Health probe for replica ``i`` as the router sees it: a
@@ -319,6 +410,27 @@ class ServingFabric:
                        - len(entry.orig.prompt)),
             remaining=entry.req.max_new_tokens)
 
+    def _observe_heartbeats(self) -> None:
+        """One watchdog sweep after the replicas stepped: a replica
+        with pending work that advanced no heartbeat seq takes a miss;
+        at the deadline it is declared stalled and takes the same
+        fence+evacuate+adopt path a detected crash does — the sub-step
+        arm of the recovery ladder."""
+        if self.hb_watchdog is None:
+            return
+        live = [i for i in range(self.n_replicas)
+                if i not in self._killed and i not in self._crashed]
+        newly = self.hb_watchdog.observe(
+            self.step_idx, live,
+            pending=lambda r: self.engines[r].pending())
+        for victim in newly:
+            if self.vclock is not None:
+                # evacuate on the victim's own (frozen) lane so the
+                # eviction instants continue from where it hung and
+                # the resumed-gap spans stay contiguous
+                self.vclock.use_lane(victim)
+            self._on_replica_death(victim)
+
     # ---- submission / drive ------------------------------------------
 
     def submit(self, req, arrival_step: int = 0, *,
@@ -342,14 +454,27 @@ class ServingFabric:
         self._detect_crashes()
         recs = []
         for i, e in enumerate(self.engines):
-            if i in self._killed:
+            if i in self._killed or i in self._stalled:
                 continue
             if e.pending():
                 if self.vclock is not None:
                     # replica-local virtual time: the real fleet steps
                     # replicas in parallel, so each gets its own lane
                     self.vclock.use_lane(i)
-                recs.append(e.step())
+                try:
+                    recs.append(e.step())
+                except _ReplicaStallInjected:
+                    # the replica hung mid-step: its thread never
+                    # returns, so it never steps again — and nothing
+                    # announces it (the probe still answers).  Close
+                    # its open step window at the hang instant so the
+                    # trace authority's tracks stay contiguous; the
+                    # WORK stays parked on the hung replica until the
+                    # heartbeat deadline notices.
+                    self._stalled.add(i)
+                    if e.tracer is not None:
+                        e.tracer.end_step()
+        self._observe_heartbeats()
         self.step_idx += 1
         if self.controller is not None:
             depths = [e._health_snapshot() for e in self.engines]
@@ -401,9 +526,12 @@ class ServingFabric:
             "routed": list(self.router.routed),
             "placement": dict(self._placement),
             "crashed": sorted(self._crashed),
+            "stalled": sorted(self._stalled),
             "migrated": self.migrated,
             "engines": [e.summary() for e in self.engines],
         }
+        if self.hb_watchdog is not None:
+            out["heartbeat"] = self.hb_watchdog.snapshot()
         if self.vclock is not None:
             out["handoff_ms_measured"] = round(
                 self.handoff.measured_ms_total, 6)

@@ -30,6 +30,8 @@ across the prefill pool, the router, an eviction, or a drain-spill.
 
 from __future__ import annotations
 
+import json
+import os
 import time
 
 from flashmoe_tpu.telemetry_plane.tracing import RequestTracer
@@ -310,10 +312,19 @@ class FrontDoorCluster:
     to the survivors — epochs bump, a ``frontdoor.failover`` decision
     per shard — and the post-failover fleet Perfetto document still
     validates with zero orphan spans: no request's identity was split
-    across the transition."""
+    across the transition.
+
+    With ``store`` (a :class:`~flashmoe_tpu.fabric.leasestore.
+    LeaseStore`) the lease table lives OUTSIDE the process: every
+    owner read and every failover write goes through the fcntl-locked,
+    CRC-framed, epoch-fenced file — peers in separate OS processes
+    share it, a failover's epoch bumps fence off any zombie peer
+    re-asserting its old leases, and a writer killed mid-append is
+    rolled back to the last intact record.  ``store=None`` (default)
+    keeps the in-memory table, byte-identical to the PR 18 cluster."""
 
     def __init__(self, fabric, n_doors: int = 2, *,
-                 n_shards: int = 8, metrics_obj=None):
+                 n_shards: int = 8, metrics_obj=None, store=None):
         if n_doors < 1:
             raise ValueError(f"cluster needs >= 1 door, got {n_doors}")
         if n_shards < n_doors:
@@ -335,9 +346,17 @@ class FrontDoorCluster:
             for i in range(n_doors)
         ]
         self.n_shards = int(n_shards)
-        #: shard -> {"owner": peer id, "epoch": lease generation}
+        self.store = store
+        #: shard -> {"owner": peer id, "epoch": lease generation} (the
+        #: in-memory table; with ``store`` the external file is the
+        #: authority and this dict is unused)
         self.leases = {s: {"owner": s % n_doors, "epoch": 0}
                        for s in range(self.n_shards)}
+        if store is not None:
+            # only missing shards are seeded: a peer joining an
+            # existing store adopts the live table, never resets it
+            store.init_leases({s: s % n_doors
+                               for s in range(self.n_shards)})
         self._dead: set = set()
 
     @property
@@ -350,8 +369,16 @@ class FrontDoorCluster:
         key = session if session is not None else rid
         return zlib.crc32(str(key).encode()) % self.n_shards
 
+    def _lease_table(self) -> dict:
+        """The live lease table: the external store's last intact
+        state when one is attached, else the in-memory dict."""
+        if self.store is not None:
+            return {s: {"owner": ls.owner, "epoch": ls.epoch}
+                    for s, ls in self.store.leases().items()}
+        return self.leases
+
     def owner_of(self, rid, session=None) -> int:
-        return self.leases[self.shard_of(rid, session)]["owner"]
+        return self._lease_table()[self.shard_of(rid, session)]["owner"]
 
     def submit(self, req, arrival_step: int = 0, *,
                session=None) -> int | None:
@@ -383,18 +410,32 @@ class FrontDoorCluster:
                 "the namespace would have no owner")
         self._dead.add(p)
         moved = 0
-        for shard in sorted(self.leases):
-            lease = self.leases[shard]
+        table = self._lease_table()
+        for shard in sorted(table):
+            lease = table[shard]
             if lease["owner"] != p:
                 continue
             new = survivors[shard % len(survivors)]
-            lease["owner"] = new
-            lease["epoch"] += 1
+            epoch = lease["epoch"] + 1
+            if self.store is not None:
+                from flashmoe_tpu.fabric.leasestore import \
+                    StaleLeaseError
+
+                try:
+                    self.store.write_lease(shard, new, epoch,
+                                           reason="failover")
+                except StaleLeaseError:
+                    # a racing peer already moved this shard at a
+                    # newer epoch — its failover stands, not ours
+                    continue
+            else:
+                self.leases[shard]["owner"] = new
+                self.leases[shard]["epoch"] = epoch
             moved += 1
             self.metrics.count("frontdoor.failovers")
             self.metrics.decision(
                 "frontdoor.failover", shard=shard, from_peer=p,
-                to_peer=new, epoch=lease["epoch"],
+                to_peer=new, epoch=epoch,
                 survivors=list(survivors))
         return moved
 
@@ -447,14 +488,38 @@ class FrontDoorCluster:
         return write_fleet_trace(self.tracer, self.fabric._placement,
                                  path, replicas=self.fabric.n_replicas)
 
+    def export_door_shards(self, dirpath: str) -> dict:
+        """Write one telemetry shard per LIVE door
+        (``telemetry.door<i>.jsonl``): the trace records it is an
+        authority for plus every decision it witnessed.  In a
+        cross-process deployment each door writes its own shard;
+        ``observe --merge`` re-joins them into one fleet view, deduping
+        double-witnessed records — the externalized trace-authority
+        story (zero orphan spans after the merge)."""
+        recs = [*self.tracer.records(),
+                *(dict(d) for d in self.metrics.decisions)]
+        out = {}
+        for i in range(self.n_doors):
+            if i in self._dead:
+                continue
+            path = os.path.join(dirpath, f"telemetry.door{i}.jsonl")
+            with open(path, "w") as fh:
+                for r in recs:
+                    fh.write(json.dumps(r, default=str) + "\n")
+            out[f"door{i}"] = {"path": path, "records": len(recs)}
+        return out
+
     def snapshot(self) -> dict:
         """Live ``/vars`` view of the lease table."""
+        table = self._lease_table()
         return {
             "doors": self.n_doors,
             "dead": sorted(self._dead),
             "shards": self.n_shards,
-            "leases": {s: dict(v) for s, v in self.leases.items()},
-            "max_epoch": max(v["epoch"] for v in self.leases.values()),
+            "external_store": (self.store.path
+                               if self.store is not None else None),
+            "leases": {s: dict(v) for s, v in table.items()},
+            "max_epoch": max(v["epoch"] for v in table.values()),
         }
 
     def close(self) -> None:

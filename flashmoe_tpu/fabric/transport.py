@@ -34,15 +34,31 @@ inter-host transport has:
   ``once=False`` every attempt fails and the bounded budget surfaces
   as a :class:`HandoffTransportError` (the give-up arm).
 
+* **a real socket wire** — ``wire="tcp"`` moves every transfer across
+  a localhost TCP connection (stdlib :mod:`socket`, length-prefixed
+  frame protocol, a receiver thread that rebuilds frames FROM THE
+  STREAM): connection reset, partial read, and recv timeout become
+  *real* kernel failure modes that feed the same retry ladder as the
+  injected faults, and the ``net_partition`` chaos fault drops a
+  transfer mid-stream for real (partial bytes cross, the receiver
+  discards them, the sender reconnects on retry).  The default
+  ``wire="inproc"`` keeps the PR 18 byte-copy path untouched —
+  byte-identical, zero threads, zero sockets.
+
 The byte path is exact: with no fault armed, ``send`` returns a
 payload rebuilt from the received bytes that is bit-identical to the
 sent one, so the fabric's token-bit-equality gates hold with the
-transport on.
+transport on — on either wire.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
+import queue
+import socket
+import struct
+import threading
 
 import jax.numpy as jnp
 import numpy as np
@@ -52,7 +68,27 @@ from flashmoe_tpu.utils.integrity import crc32_pages
 from flashmoe_tpu.utils.telemetry import metrics as _global_metrics
 
 #: serving faults the transport knows how to inject (chaos matrix rows)
-TRANSPORT_FAULTS = ("handoff_corrupt", "handoff_timeout")
+TRANSPORT_FAULTS = ("handoff_corrupt", "handoff_timeout",
+                    "net_partition")
+
+#: transport wire modes: in-process byte copy (default, byte-identical
+#: to PR 18) vs a real localhost TCP socket pair
+WIRE_MODES = ("inproc", "tcp")
+
+#: modeled per-transfer cost of the tcp leg over inproc (connect
+#: amortization + length-prefixed framing + syscall pair) — the
+#: deterministic basis of the ``fabric_wire_overhead_ms`` sentry row
+TCP_OVERHEAD_BASE_MS = 0.05
+TCP_OVERHEAD_PER_KIB_MS = 0.0002
+
+
+def wire_overhead_ms(payload_bytes: int, wire: str = "inproc") -> float:
+    """Modeled extra latency of carrying one transfer on ``wire``
+    versus the in-process copy (deterministic, for the perf sentry)."""
+    if wire != "tcp":
+        return 0.0
+    return TCP_OVERHEAD_BASE_MS + (
+        float(payload_bytes) / 1024.0) * TCP_OVERHEAD_PER_KIB_MS
 
 #: the bytes a chaos corruption stamps mid-page (the checkpoint
 #: tamper idiom — ``chaos._corrupt_latest_checkpoint`` flips the same)
@@ -142,6 +178,204 @@ def _tampered(frame: WireFrame) -> WireFrame:
     return dataclasses.replace(frame, buf=out[:len(buf)])
 
 
+_LEN = struct.Struct("<I")
+_WIRE_FIELDS = ("k", "v", "k_qscale", "v_qscale")
+
+
+class _WireReset(OSError):
+    """The kernel socket failed mid-attempt (reset / broken pipe /
+    refused) — the attempt's bytes are gone; retry on a fresh
+    connection."""
+
+
+class _WireTimeout(OSError):
+    """The receiver produced nothing inside the recv deadline."""
+
+
+class _PartialTransfer(Exception):
+    """The stream ended mid-transfer — the receiver drops the bytes."""
+
+
+def _dtype_of(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # ml_dtypes names (bfloat16 / float8_*) are attributes, not
+        # always registered dtype strings
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _pack_frames(frames: dict) -> bytes:
+    """Length-prefixed wire encoding of one transfer: a JSON header
+    (field order, dtype, shape, per-page CRC sidecar, byte counts)
+    followed by the raw frame buffers."""
+    header, bufs = [], []
+    for field in _WIRE_FIELDS:
+        fr = frames.get(field)
+        if fr is None:
+            header.append(None)
+            continue
+        header.append({"field": field,
+                       "dtype": np.dtype(fr.dtype).name,
+                       "shape": list(fr.shape),
+                       "page_crcs": list(fr.page_crcs),
+                       "nbytes": len(fr.buf)})
+        bufs.append(fr.buf)
+    hjson = json.dumps(header).encode()
+    return _LEN.pack(len(hjson)) + hjson + b"".join(bufs)
+
+
+class _TcpWire:
+    """The localhost TCP leg: one server socket, a receiver thread
+    that rebuilds :class:`WireFrame` dicts from the byte stream, and a
+    sender connection that reconnects after a reset.  Everything the
+    receiver hands back came off the kernel socket — a transfer the
+    stream truncates (``net_partition``, or a real peer death) is
+    discarded at the first short read, never delivered."""
+
+    def __init__(self, *, recv_timeout_s: float = 5.0):
+        self.recv_timeout_s = float(recv_timeout_s)
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self._srv.settimeout(0.2)
+        self.port = self._srv.getsockname()[1]
+        self._rx: queue.Queue = queue.Queue()
+        self._stop = False
+        self._sock = None
+        self.partial_drops = 0
+        self._thread = threading.Thread(
+            target=self._serve, name="flashmoe-kv-wire", daemon=True)
+        self._thread.start()
+
+    # ---- receiver thread ---------------------------------------------
+
+    def _recv_exact(self, conn, n: int):
+        chunks, got = [], 0
+        while got < n:
+            try:
+                b = conn.recv(min(1 << 16, n - got))
+            except socket.timeout:
+                if self._stop:
+                    return None
+                continue
+            except OSError:
+                return None
+            if not b:
+                return None
+            chunks.append(b)
+            got += len(b)
+        return b"".join(chunks)
+
+    def _read_transfer(self, conn):
+        """One transfer off the stream.  ``None`` = clean EOF before a
+        transfer started; a short read mid-transfer raises
+        :class:`_PartialTransfer` and the bytes are dropped."""
+        raw = self._recv_exact(conn, _LEN.size)
+        if raw is None:
+            return None
+        (hlen,) = _LEN.unpack(raw)
+        hraw = self._recv_exact(conn, hlen)
+        if hraw is None:
+            raise _PartialTransfer
+        frames = {f: None for f in _WIRE_FIELDS}
+        for entry in json.loads(hraw.decode()):
+            if entry is None:
+                continue
+            buf = self._recv_exact(conn, entry["nbytes"])
+            if buf is None:
+                raise _PartialTransfer
+            frames[entry["field"]] = WireFrame(
+                buf, _dtype_of(entry["dtype"]), tuple(entry["shape"]),
+                tuple(entry["page_crcs"]))
+        return frames
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, _addr = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            conn.settimeout(0.2)
+            with conn:
+                while not self._stop:
+                    try:
+                        frames = self._read_transfer(conn)
+                    except _PartialTransfer:
+                        self.partial_drops += 1
+                        break
+                    except Exception:
+                        break
+                    if frames is None:
+                        break
+                    self._rx.put(frames)
+
+    # ---- sender side --------------------------------------------------
+
+    def _connect(self):
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                ("127.0.0.1", self.port), timeout=self.recv_timeout_s)
+        return self._sock
+
+    def _reset(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def roundtrip(self, frames: dict) -> dict:
+        """One attempt: the transfer crosses the kernel socket and the
+        RECEIVER's rebuild comes back.  Raises :class:`_WireReset` on a
+        send-side socket failure, :class:`_WireTimeout` when nothing
+        arrives inside the deadline."""
+        blob = _pack_frames(frames)
+        try:
+            self._connect().sendall(blob)
+        except OSError as e:
+            self._reset()
+            raise _WireReset(str(e)) from e
+        try:
+            return self._rx.get(timeout=self.recv_timeout_s)
+        except queue.Empty:
+            self._reset()
+            raise _WireTimeout(
+                f"no transfer received within "
+                f"{self.recv_timeout_s}s") from None
+
+    def drop_mid_transfer(self, frames: dict,
+                          fraction: float = 0.5) -> int:
+        """``net_partition`` injection: push a partial transfer, then
+        hard-close the connection.  The partial bytes REALLY cross the
+        kernel socket and the receiver REALLY discards them at the
+        short read — returns the bytes that never made it."""
+        blob = _pack_frames(frames)
+        cut = max(1, min(len(blob) - 1, int(len(blob) * fraction)))
+        try:
+            self._connect().sendall(blob[:cut])
+        except OSError:
+            pass
+        self._reset()
+        return len(blob) - cut
+
+    def close(self):
+        self._stop = True
+        self._reset()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
+
+    def snapshot(self) -> dict:
+        return {"port": self.port,
+                "partial_drops": self.partial_drops,
+                "recv_timeout_s": self.recv_timeout_s}
+
+
 @dataclasses.dataclass(frozen=True)
 class TransferResult:
     """What one :meth:`HandoffTransport.send` experienced."""
@@ -168,12 +402,14 @@ class HandoffTransport:
     :class:`~flashmoe_tpu.chaos.FaultPlan` whose fault is one of
     :data:`TRANSPORT_FAULTS`.  ``tamper_fn``: test seam — a callable
     ``(transfer_index, attempt) -> bool`` that forces corruption on a
-    given attempt (the CRC tamper drill)."""
+    given attempt (the CRC tamper drill).  ``wire``: one of
+    :data:`WIRE_MODES` — ``"tcp"`` carries every transfer over a real
+    localhost socket (close the transport when done)."""
 
     def __init__(self, *, metrics_obj=None, max_retries: int = 2,
                  timeout_ms: float = 50.0, backoff_ms: float = 5.0,
                  backoff_cap_ms: float = 40.0, plan=None,
-                 tamper_fn=None):
+                 tamper_fn=None, wire: str = "inproc"):
         if plan is not None and plan.fault not in TRANSPORT_FAULTS:
             raise ValueError(
                 f"HandoffTransport only injects {TRANSPORT_FAULTS}, "
@@ -181,6 +417,9 @@ class HandoffTransport:
         if max_retries < 0:
             raise ValueError(f"max_retries must be >= 0, "
                              f"got {max_retries}")
+        if wire not in WIRE_MODES:
+            raise ValueError(f"wire must be one of {WIRE_MODES}, "
+                             f"got {wire!r}")
         self.metrics = (metrics_obj if metrics_obj is not None
                         else _global_metrics)
         self.max_retries = int(max_retries)
@@ -189,10 +428,14 @@ class HandoffTransport:
         self.backoff_cap_ms = float(backoff_cap_ms)
         self.plan = plan
         self.tamper_fn = tamper_fn
+        self.wire = wire
+        self._wire = _TcpWire() if wire == "tcp" else None
         self.transfers = 0
         self.retries_total = 0
         self.corrupt_total = 0
         self.timeout_total = 0
+        self.partition_total = 0
+        self.reset_total = 0
         self.retry_ms_total = 0.0
 
     # ---- chaos --------------------------------------------------------
@@ -221,20 +464,23 @@ class HandoffTransport:
     # ---- the wire -----------------------------------------------------
 
     def _transmit(self, frames: dict, *, tamper: bool) -> dict:
-        """One attempt: the frames cross the (in-process) wire.  A
-        tampered attempt corrupts the largest frame's bytes — the
-        sidecar checksums ride untouched, so the receiver's verify
-        catches it."""
-        if not tamper:
-            return frames
-        victim, size = None, -1
-        for field, frame in frames.items():
-            if frame is not None and len(frame.buf) > size:
-                victim, size = field, len(frame.buf)
-        rx = dict(frames)
-        if victim is not None:
-            rx[victim] = _tampered(rx[victim])
-        return rx
+        """One attempt: the frames cross the wire — an in-process copy
+        by default, the kernel socket under ``wire="tcp"``.  A tampered
+        attempt corrupts the largest frame's bytes BEFORE they ship —
+        the sidecar checksums ride untouched (in the tcp header), so
+        the receiver's verify catches it."""
+        tx = frames
+        if tamper:
+            victim, size = None, -1
+            for field, frame in frames.items():
+                if frame is not None and len(frame.buf) > size:
+                    victim, size = field, len(frame.buf)
+            tx = dict(frames)
+            if victim is not None:
+                tx[victim] = _tampered(tx[victim])
+        if self._wire is None:
+            return tx
+        return self._wire.roundtrip(tx)
 
     def send(self, payload: KVPagePayload, *, modeled_ms: float = 0.0,
              rid=None, replica: int = 0) -> TransferResult:
@@ -270,8 +516,76 @@ class HandoffTransport:
                 self._check_budget(attempts, index, rid, replica,
                                    "timeout")
                 continue
-            rx = self._transmit(frames,
-                                tamper=(fault == "handoff_corrupt"))
+            if fault == "net_partition":
+                # the wire drops mid-transfer: on tcp, partial bytes
+                # REALLY cross and the receiver REALLY discards them;
+                # inproc models the same drop.  Either way the
+                # attempt's modeled wire time was wasted.
+                dropped = (self._wire.drop_mid_transfer(frames)
+                           if self._wire is not None else None)
+                self.partition_total += 1
+                back = self._backoff(attempts)
+                retry_ms += float(modeled_ms) + back
+                self.metrics.count("fabric.partitions")
+                self.metrics.decision(
+                    "fabric.partition", rid=rid, replica=int(replica),
+                    transfer=index, attempt=attempts, wire=self.wire,
+                    dropped_bytes=dropped, injected=True)
+                self.metrics.count("fabric.handoff_retries")
+                self.metrics.decision(
+                    "fabric.handoff_retry", rid=rid,
+                    replica=int(replica), transfer=index,
+                    attempt=attempts, reason="reset",
+                    wasted_ms=round(float(modeled_ms), 6),
+                    backoff_ms=round(back, 6),
+                    budget_left=self.max_retries - (attempts - 1) - 1)
+                self._check_budget(attempts, index, rid, replica,
+                                   "reset")
+                continue
+            try:
+                rx = self._transmit(
+                    frames, tamper=(fault == "handoff_corrupt"))
+            except _WireReset as e:
+                # a REAL kernel-socket failure (connection reset,
+                # broken pipe, partial write) — the same ladder as the
+                # injected partition
+                self.reset_total += 1
+                back = self._backoff(attempts)
+                retry_ms += float(modeled_ms) + back
+                self.metrics.count("fabric.partitions")
+                self.metrics.decision(
+                    "fabric.partition", rid=rid, replica=int(replica),
+                    transfer=index, attempt=attempts, wire=self.wire,
+                    dropped_bytes=None, injected=False,
+                    error=str(e)[:80])
+                self.metrics.count("fabric.handoff_retries")
+                self.metrics.decision(
+                    "fabric.handoff_retry", rid=rid,
+                    replica=int(replica), transfer=index,
+                    attempt=attempts, reason="reset",
+                    wasted_ms=round(float(modeled_ms), 6),
+                    backoff_ms=round(back, 6),
+                    budget_left=self.max_retries - (attempts - 1) - 1)
+                self._check_budget(attempts, index, rid, replica,
+                                   "reset")
+                continue
+            except _WireTimeout:
+                # a REAL recv deadline: the receiver produced nothing
+                timeouts += 1
+                self.timeout_total += 1
+                back = self._backoff(attempts)
+                retry_ms += self.timeout_ms + back
+                self.metrics.count("fabric.handoff_retries")
+                self.metrics.decision(
+                    "fabric.handoff_retry", rid=rid,
+                    replica=int(replica), transfer=index,
+                    attempt=attempts, reason="timeout",
+                    wasted_ms=round(self.timeout_ms, 6),
+                    backoff_ms=round(back, 6),
+                    budget_left=self.max_retries - (attempts - 1) - 1)
+                self._check_budget(attempts, index, rid, replica,
+                                   "timeout")
+                continue
             bad = verify_frames(rx)
             if bad:
                 # garbage crossed the wire: the bytes were paid for,
@@ -317,6 +631,13 @@ class HandoffTransport:
                 f"({reason}); retry budget max_retries="
                 f"{self.max_retries} exhausted")
 
+    def close(self) -> None:
+        """Tear down the wire (tcp: close sockets, join the receiver
+        thread).  Safe to call twice; a no-op for ``inproc``."""
+        if self._wire is not None:
+            self._wire.close()
+            self._wire = None
+
     def snapshot(self) -> dict:
         """Live ``/vars`` view of the transport."""
         return {
@@ -324,9 +645,14 @@ class HandoffTransport:
             "retries_total": self.retries_total,
             "corrupt_total": self.corrupt_total,
             "timeout_total": self.timeout_total,
+            "partition_total": self.partition_total,
+            "reset_total": self.reset_total,
             "retry_ms_total": round(self.retry_ms_total, 6),
             "max_retries": self.max_retries,
             "timeout_ms": self.timeout_ms,
+            "wire": self.wire,
+            "wire_drops": (self._wire.partial_drops
+                           if self._wire is not None else 0),
             "fault": (self.plan.fault if self.plan is not None
                       else None),
         }

@@ -484,6 +484,11 @@ def serving_report(records: list[dict]) -> dict:
     sheds: dict = {}
     brownouts: dict = {}
     failovers: list = []
+    partitions: list = []
+    fences: list = []
+    repairs: list = []
+    stalls: list = []
+    hb_misses = 0
     for r in records:
         kind, dec = r.get("kind"), r.get("decision")
         if kind == "serve_step":
@@ -549,6 +554,16 @@ def serving_report(records: list[dict]) -> dict:
             brownouts[st] = brownouts.get(st, 0) + 1
         elif dec == "frontdoor.failover":
             failovers.append(r)
+        elif dec == "fabric.partition":
+            partitions.append(r)
+        elif dec == "frontdoor.fence":
+            fences.append(r)
+        elif dec == "frontdoor.lease_repair":
+            repairs.append(r)
+        elif dec == "fabric.heartbeat_stall":
+            stalls.append(r)
+        elif dec == "fabric.heartbeat_miss":
+            hb_misses += 1
         elif dec == "slo.breach":
             if r.get("target") == "ttft":
                 slo_ttft += 1
@@ -622,22 +637,25 @@ def serving_report(records: list[dict]) -> dict:
                                 if ho_verdicts else None),
             "wire": ho_wire,
         } if ho_n else None),
-        # the serving failure story (ISSUE 18): crash timeline,
+        # the serving failure story (ISSUE 18/19): crash timeline,
         # migrations, retried handoffs, brownout shedding, front-door
-        # failovers — the section an incident review reads first
+        # failovers, wire partitions, lease fencing/repair and
+        # heartbeat stalls — the section an incident review reads first
         "fabric_failures": _fabric_failures(
             crashes, migrations, retries, corrupts, sheds, brownouts,
-            failovers),
+            failovers, partitions, fences, repairs, stalls, hb_misses),
     }
 
 
 def _fabric_failures(crashes, migrations, retries, corrupts, sheds,
-                     brownouts, failovers):
+                     brownouts, failovers, partitions=(), fences=(),
+                     repairs=(), stalls=(), hb_misses=0):
     """Aggregate the serving fault-tolerance decisions into the
     ``--serving`` report's failure section (None when the run saw no
     failure activity — the common case stays quiet)."""
     if not (crashes or migrations or retries or corrupts
-            or sheds or brownouts or failovers):
+            or sheds or brownouts or failovers or partitions
+            or fences or repairs or stalls or hb_misses):
         return None
 
     def hist(values):
@@ -677,6 +695,42 @@ def _fabric_failures(crashes, migrations, retries, corrupts, sheds,
             "paths": hist(f"p{f.get('from_peer')}->p{f.get('to_peer')}"
                           for f in failovers),
         },
+        # the cross-process arms (ISSUE 19): socket-wire partition
+        # windows, the lease store's refused stale-epoch writes (the
+        # split-brain verdict) and torn-tail repairs, and the
+        # sub-step heartbeat detections
+        "partitions": ({
+            "total": len(partitions),
+            "injected": sum(bool(p.get("injected")) for p in partitions),
+            "real_resets": sum(not p.get("injected")
+                               for p in partitions),
+            "dropped_kb": round(sum(float(p.get("dropped_bytes") or 0)
+                                    for p in partitions) / 1024, 3),
+            "windows": hist(f"t{p.get('transfer')}"
+                            for p in partitions),
+        } if partitions else None),
+        "lease_fences": ({
+            "total": len(fences),
+            "refused": sum(bool(f.get("refused")) for f in fences),
+            "split_brain_averted": all(f.get("refused")
+                                       for f in fences),
+            "stale_epochs": hist(f.get("stale_epoch") for f in fences),
+            "claimants": hist(f"p{f.get('claimant')}" for f in fences),
+        } if fences else None),
+        "lease_repairs": ({
+            "total": len(repairs),
+            "torn_bytes": sum(int(r.get("torn_bytes") or 0)
+                              for r in repairs),
+            "restored_epochs": hist(r.get("restored_epoch")
+                                    for r in repairs),
+        } if repairs else None),
+        "heartbeat": ({
+            "stalls": [{"replica": s.get("replica"),
+                        "step": s.get("step"),
+                        "detect_ms": s.get("detect_ms")}
+                       for s in stalls],
+            "misses": hb_misses,
+        } if (stalls or hb_misses) else None),
     }
 
 
@@ -799,6 +853,43 @@ def render_serving_text(rep: dict) -> str:
             lines.append(
                 f"  front-door failovers: {fo['total']} leases moved "
                 f"(max epoch {fo['max_epoch']})  {paths}")
+        if ff.get("partitions"):
+            pt = ff["partitions"]
+            wins = " ".join(f"{k}:{v}" for k, v
+                            in pt["windows"].items())
+            lines.append(
+                f"  wire partitions: {pt['total']} "
+                f"({pt['injected']} injected, {pt['real_resets']} real "
+                f"resets), {pt['dropped_kb']} KB torn mid-stream  "
+                f"{wins}")
+        if ff.get("lease_fences"):
+            lf = ff["lease_fences"]
+            who = " ".join(f"{k}:{v}" for k, v
+                           in lf["claimants"].items())
+            verdict = ("split brain AVERTED"
+                       if lf["split_brain_averted"]
+                       else "SPLIT BRAIN: a stale write was accepted")
+            lines.append(
+                f"  lease fences: {lf['refused']}/{lf['total']} "
+                f"stale-epoch writes refused ({verdict})  {who}")
+        if ff.get("lease_repairs"):
+            lr = ff["lease_repairs"]
+            eps = " ".join(f"e{k}:{v}" for k, v
+                           in lr["restored_epochs"].items())
+            lines.append(
+                f"  lease repairs: {lr['total']} torn tails rolled "
+                f"back ({lr['torn_bytes']} bytes refused)  "
+                f"restored {eps}")
+        if ff.get("heartbeat"):
+            hb = ff["heartbeat"]
+            for s in hb["stalls"]:
+                lines.append(
+                    f"  heartbeat stall: r{s['replica']} declared at "
+                    f"step {s['step']} (detected in "
+                    f"{s['detect_ms']} virtual ms)")
+            if hb["misses"]:
+                lines.append(f"  heartbeat misses observed: "
+                             f"{hb['misses']}")
     return "\n".join(lines)
 
 

@@ -525,7 +525,8 @@ class ServingEngine:
                  serve: ServeConfig | None = None, *,
                  recorder=None, slo=None, mesh=None, metrics_obj=None,
                  tracer=None, telemetry_port=None, prefill_fn=None,
-                 replica_tag=None, pools_info=None, clock=None):
+                 replica_tag=None, pools_info=None, clock=None,
+                 heartbeat_fn=None):
         """``prefill_fn(prompt_padded, true_len, *, rid)`` replaces the
         local prefill when set — the fabric's KV-handoff seam: the
         callable must honor :func:`_prefill_padded`'s contract
@@ -540,7 +541,13 @@ class ServingEngine:
         :class:`~flashmoe_tpu.fabric.vclock.VirtualClock` additionally
         gets its decode tick stepped at the end of every engine step;
         None (the default) is the wall clock, byte-identical to the
-        pre-seam engine."""
+        pre-seam engine.  ``heartbeat_fn(phase)``: invoked at every
+        sub-step phase boundary (``admit`` / ``prefill`` / ``sample`` /
+        ``decode`` / ``end``) — the fabric's liveness seam (a
+        :class:`~flashmoe_tpu.fabric.leasestore.HeartbeatPublisher`):
+        a replica that hangs mid-step stops beating mid-step, so the
+        watchdog catches it without waiting for the step boundary.
+        None (the default) makes zero calls — byte-identical."""
         if cfg.drop_tokens:
             raise ValueError(
                 "the serving engine requires a dropless config "
@@ -565,6 +572,7 @@ class ServingEngine:
         self._clock = clock if clock is not None else time.monotonic
         self._vclock = (clock if hasattr(clock, "complete_step")
                         else None)
+        self._heartbeat = heartbeat_fn
         # ---- live telemetry plane (default off = zero threads, no
         # behavior change; outputs are bit-identical either way) ------
         self.tracer = None
@@ -1183,7 +1191,11 @@ class ServingEngine:
                 [self.slots[i].orig.rid for i in self._active()])
         self._mark_arrivals()
         self._admit()
+        if self._heartbeat is not None:
+            self._heartbeat("admit")
         self._advance_prefill()
+        if self._heartbeat is not None:
+            self._heartbeat("prefill")
 
         # sample each decoding slot's next token from its pending
         # logits (slots mid-chunked-prefill have none yet)
@@ -1218,6 +1230,8 @@ class ServingEngine:
                 if done:
                     self._retire(i, s)
         self.stats["tokens"] += emitted_now
+        if self._heartbeat is not None:
+            self._heartbeat("sample")
 
         # feed the survivors one decode step
         active = self._decoding()
@@ -1257,6 +1271,8 @@ class ServingEngine:
             self.cache = self.cache._replace(k_pages=kp, v_pages=vp)
             for i in active:
                 self.slots[i].length += 1
+        if self._heartbeat is not None:
+            self._heartbeat("decode")
 
         # telemetry
         if self._vclock is not None:
@@ -1303,6 +1319,8 @@ class ServingEngine:
         if self.watchdog is not None:
             self.watchdog.observe_step(self.step_idx, step_ms)
         self.step_idx += 1
+        if self._heartbeat is not None:
+            self._heartbeat("end")
         return rec
 
     # ---- drivers -----------------------------------------------------

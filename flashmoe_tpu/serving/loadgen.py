@@ -266,7 +266,8 @@ def fabric_load_sweep(loads, *, replica_counts=(1, 2, 4),
                       seed: int = 0, page_size: int = 8,
                       num_pages: int = 64,
                       telemetry_port: int | None = None,
-                      vclock: bool = False) -> list[dict]:
+                      vclock: bool = False,
+                      wire: str = "inproc") -> list[dict]:
     """The ``bench.py --fabric`` sweep: one record per (replica count,
     offered-load point), each driving a fresh
     :class:`~flashmoe_tpu.fabric.engine.ServingFabric` on the mocked
@@ -291,7 +292,14 @@ def fabric_load_sweep(loads, *, replica_counts=(1, 2, 4),
     record adds the measured-vs-priced handoff fields plus the
     per-request attribution rollup.  The record identity gains a
     ``vclock`` tag so the perf sentry never baselines virtual-time
-    latencies against wall-clock ones."""
+    latencies against wall-clock ones.
+
+    ``wire`` (``bench.py --fabric --wire tcp``): every KV handoff
+    crosses a REAL localhost socket through a CRC-verifying
+    :class:`~flashmoe_tpu.fabric.transport.HandoffTransport` instead
+    of the in-process wire.  Tokens stay bit-identical (the wire is a
+    byte codec); the record identity gains a ``wire=tcp`` tag so the
+    sentry baselines socket and in-process throughput separately."""
     import os
     import time
 
@@ -299,10 +307,13 @@ def fabric_load_sweep(loads, *, replica_counts=(1, 2, 4),
 
     from flashmoe_tpu.fabric.engine import ServingFabric
     from flashmoe_tpu.fabric.topo import ENV_MOCK_FABRIC
+    from flashmoe_tpu.fabric.transport import WIRE_MODES
     from flashmoe_tpu.models.transformer import init_params
     from flashmoe_tpu.serving.engine import ServeConfig
     from flashmoe_tpu.utils.telemetry import Metrics
 
+    if wire not in WIRE_MODES:
+        raise ValueError(f"wire {wire!r} not in {WIRE_MODES}")
     cfg = tiny_config()
     params = init_params(jax.random.PRNGKey(seed), cfg)
     serve = ServeConfig(
@@ -351,8 +362,16 @@ def fabric_load_sweep(loads, *, replica_counts=(1, 2, 4),
                     from flashmoe_tpu.fabric.vclock import VirtualClock
 
                     vc = VirtualClock()
+                transport = None
+                if wire == "tcp":
+                    from flashmoe_tpu.fabric.transport import (
+                        HandoffTransport,
+                    )
+
+                    transport = HandoffTransport(metrics_obj=mx,
+                                                 wire="tcp")
                 fab = ServingFabric(params, cfg, serve, metrics_obj=mx,
-                                    vclock=vc)
+                                    vclock=vc, transport=transport)
                 driver = fab
                 if vclock:
                     door = FrontDoor(fab)
@@ -387,6 +406,8 @@ def fabric_load_sweep(loads, *, replica_counts=(1, 2, 4),
                 tag = ",telemetry" if server is not None else ""
                 if vclock:
                     tag += ",vclock"
+                if wire != "inproc":
+                    tag += f",wire={wire}"
                 rec = {
                     "metric": f"fabric_load[replicas={int(k)},"
                               f"every={int(every)},"
@@ -452,8 +473,16 @@ def fabric_load_sweep(loads, *, replica_counts=(1, 2, 4),
                         d: doms.count(d) for d in sorted(set(doms))}
                     rec["trace_errors"] = len(errs)
                     door.close()
+                if transport is not None:
+                    # socket-wire provenance: real roundtrips + any
+                    # real connection resets the ladder absorbed
+                    rec["wire"] = wire
+                    rec["wire_transfers"] = transport.transfers
+                    rec["wire_resets"] = transport.reset_total
                 records.append(rec)
                 fab.close()
+                if transport is not None:
+                    transport.close()
     finally:
         if saved is None:
             os.environ.pop(ENV_MOCK_FABRIC, None)
@@ -467,7 +496,9 @@ def fabric_load_sweep(loads, *, replica_counts=(1, 2, 4),
 #: the serving fault-tolerance ladder drilled by ``--fabric --faults``
 #: (chaos.EXPECTED_TIER owns the fault -> recovery-tier mapping)
 SERVING_FAULTS = ("replica_crash", "handoff_corrupt",
-                  "handoff_timeout", "frontdoor_loss")
+                  "handoff_timeout", "frontdoor_loss",
+                  "net_partition", "lease_split_brain",
+                  "replica_stall", "lease_torn_write")
 
 
 def fabric_fault_sweep(faults=None, *, seed: int = 0,
@@ -512,10 +543,20 @@ def fabric_fault_sweep(faults=None, *, seed: int = 0,
             "retries": ev.get("retries", 0),
             "corrupt": ev.get("corrupt", 0),
             "failovers": ev.get("failovers", 0),
+            "partitions": ev.get("partitions", 0),
+            "fences": ev.get("fences", 0),
+            "lease_repairs": ev.get("lease_repairs", 0),
             "shed_frac": 0.0,   # fault drills never shed; the brownout
             "trace_errors": len(ev.get("trace_errors") or []),
             "backend": jax.default_backend(),
         }
+        # sub-step detection latency (virtual ms from the hang to the
+        # watchdog's verdict) — only the heartbeat drill prices one
+        stalls = [d for d in r.decisions
+                  if d.get("decision") == "fabric.heartbeat_stall"]
+        if stalls:
+            rec["heartbeat_detect_ms"] = round(
+                max(d.get("detect_ms", 0.0) for d in stalls), 6)
         if not r.recovered:
             rec["error"] = r.reason[:200]
         records.append(rec)

@@ -265,6 +265,39 @@ SERVING_KNOBS: tuple[KnobSpec, ...] = (
             "recovery (fabric.replica_crash / fabric.migrate).  Off "
             "(None, the default) injects nothing; detection and "
             "migration still guard real probe failures"),
+    KnobSpec(
+        "wire", off_values=("inproc",), on={"wire": "'tcp'"},
+        backends=(), changes_graph=False,
+        doc="the transport's socket wire (fabric/transport.py): "
+            "HandoffTransport(wire='tcp') sends every KV transfer "
+            "through a REAL localhost TCP socket — length-prefixed "
+            "frames, per-page CRC32 verify on receive — so connection "
+            "reset, partial read and recv timeout are genuine kernel "
+            "failure modes feeding the same capped-backoff retry "
+            "ladder (fabric.partition / fabric.handoff_retry "
+            "reason='reset'), with wasted wire time priced into the "
+            "virtual clock as retry_ms.  Off ('inproc', the default) "
+            "hands the serialized frames across in-process: no "
+            "sockets, no threads, byte-identical payloads — the wire "
+            "is a byte codec either way, so tcp is token-bit-equal "
+            "too (tests/test_transport.py)"),
+    KnobSpec(
+        "heartbeat", off_values=(None,),
+        on={"heartbeat": "HeartbeatConfig()"},
+        backends=(), changes_graph=False,
+        doc="sub-step heartbeat crash detection (fabric/leasestore.py "
+            "+ fabric/engine.py): ServingFabric(heartbeat=...) makes "
+            "every decode replica publish monotonic per-phase "
+            "heartbeats (admit/prefill/sample/decode/end, vclock-"
+            "stamped) into the fcntl-locked external lease store, and "
+            "a watchdog with misses_to_stall hysteresis declares a "
+            "replica that stops beating WITH pending work stalled "
+            "mid-step (fabric.heartbeat_miss / fabric.heartbeat_stall) "
+            "— triggering the same fence+evacuate+adopt migration as "
+            "a probed crash, detection latency priced in virtual ms.  "
+            "Off (None, the default) installs no heartbeat_fn: zero "
+            "engine callbacks, no store file, byte-identical to the "
+            "probe-only PR 18 path"),
 )
 
 SERVING_KNOBS_BY_NAME = {k.name: k for k in SERVING_KNOBS}

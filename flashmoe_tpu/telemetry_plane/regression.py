@@ -170,6 +170,30 @@ def reference_points(gen: str = "v5e") -> dict[str, dict]:
         points[f"fabric_recovery_ms[{name},d={GOLDEN_D},{gen}]"] = {
             "value": round(2 * tick + ms, 4), "unit": "ms",
         }
+        # cross-process plane (ISSUE 19): the sub-step heartbeat
+        # detection deadline (watchdog hysteresis x decode tick — the
+        # virtual ms between a mid-step hang and the stall verdict)
+        # and the modeled per-handoff socket-wire overhead for the
+        # golden KV payload (tcp vs the free in-process wire).  Pure
+        # arithmetic over committed constants: retuning the watchdog
+        # default or the framing overhead model trips the sentry
+        # before any drill measures it
+        from flashmoe_tpu.fabric.leasestore import HeartbeatConfig
+        from flashmoe_tpu.fabric.transport import wire_overhead_ms
+        from flashmoe_tpu.planner.model import kv_page_mb
+
+        hb = HeartbeatConfig()
+        points[f"fabric_heartbeat_detect_ms[{name},d={GOLDEN_D},"
+               f"{gen}]"] = {
+            "value": round(hb.misses_to_stall * tick, 4), "unit": "ms",
+        }
+        payload_bytes = int(GOLDEN_KV_PAGES
+                            * kv_page_mb(base, GOLDEN_KV_PAGE) * 2**20)
+        points[f"fabric_wire_overhead_ms[{name},d={GOLDEN_D},{gen},"
+               f"wire=tcp]"] = {
+            "value": round(wire_overhead_ms(payload_bytes, "tcp"), 4),
+            "unit": "ms",
+        }
     # brownout shed fraction at the default BrownoutConfig against the
     # reference flood: deterministic hysteresis arithmetic — retuning
     # the admission controller's thresholds/debounce moves this row,

@@ -92,6 +92,15 @@ DECISION_NAMES: dict[str, str] = {
         "the handoff transport retransmitted a failed transfer "
         "(corrupt or timed out): attempt number, wasted wire ms, "
         "capped-exponential backoff, remaining retry budget",
+    "fabric.heartbeat_miss":
+        "a decode replica with pending work advanced no heartbeat seq "
+        "across one fabric-step observation: consecutive miss count "
+        "and remaining deadline budget before a stall is declared",
+    "fabric.heartbeat_stall":
+        "the heartbeat watchdog declared a replica stalled MID-STEP "
+        "(its probe still answers — only the sub-step heartbeat "
+        "deadline catches a hang): last published phase/seq and the "
+        "detection latency in virtual decode ms",
     "fabric.migrate":
         "a crashed replica's request moved to a survivor: the resumed "
         "prompt carries every delivered token, so the deterministic "
@@ -99,6 +108,11 @@ DECISION_NAMES: dict[str, str] = {
     "fabric.replica_crash":
         "the fabric's health probes detected a dead decode replica: "
         "in-flight and queued victim counts, surviving rotation",
+    "fabric.partition":
+        "the KV wire dropped a transfer mid-stream (injected "
+        "net_partition, or a real kernel-socket reset on the tcp "
+        "wire): bytes that never crossed, attempt number — the "
+        "receiver discarded the partial transfer at the short read",
     "fabric.route":
         "the replica router placed a request (session affinity or "
         "join-shortest-queue over live /healthz depths)",
@@ -109,6 +123,15 @@ DECISION_NAMES: dict[str, str] = {
     "frontdoor.failover":
         "a dead front-door peer's namespace lease moved to a survivor: "
         "shard, old/new owner, bumped epoch",
+    "frontdoor.fence":
+        "the external lease store REFUSED a stale-epoch lease write: "
+        "the claimant's fencing token is not newer than the stored "
+        "epoch — the split-brain guard (a zombie door cannot take a "
+        "shard back)",
+    "frontdoor.lease_repair":
+        "the lease store found a torn tail (a writer died mid-append) "
+        "and rolled the log back to the last intact CRC-framed "
+        "record: torn bytes dropped, restored epoch",
     "frontdoor.shed":
         "a brownout admission verdict: the arriving request was shed "
         "(rejected) or degraded (token budget capped) instead of "

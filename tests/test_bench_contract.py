@@ -646,10 +646,10 @@ def test_cli_fabric_plumbs_load_sweep(monkeypatch):
     seen = {}
 
     def fake_fabric(loads, *, requests, max_batch, telemetry_port=None,
-                    vclock=False):
+                    vclock=False, wire="inproc"):
         seen.update(loads=loads, requests=requests,
                     max_batch=max_batch, telemetry_port=telemetry_port,
-                    vclock=vclock)
+                    vclock=vclock, wire=wire)
 
     monkeypatch.setattr(bench, "_bench_fabric", fake_fabric)
     monkeypatch.setattr(_sys, "argv",
@@ -657,12 +657,19 @@ def test_cli_fabric_plumbs_load_sweep(monkeypatch):
                          "0", "--deadline", "0"])
     bench.main()
     assert seen == {"loads": [4, 2, 1], "requests": 8, "max_batch": 4,
-                    "telemetry_port": 0, "vclock": False}
+                    "telemetry_port": 0, "vclock": False,
+                    "wire": "inproc"}
     monkeypatch.setattr(_sys, "argv",
                         ["bench.py", "--fabric", "--vclock",
                          "--deadline", "0"])
     bench.main()
     assert seen["vclock"] is True and seen["telemetry_port"] is None
+    # --wire tcp plumbs through to the sweep's socket-wire arm
+    monkeypatch.setattr(_sys, "argv",
+                        ["bench.py", "--fabric", "--wire", "tcp",
+                         "--deadline", "0"])
+    bench.main()
+    assert seen["wire"] == "tcp"
 
 
 def test_cli_fabric_flag_exclusivity(monkeypatch, capsys):
@@ -684,6 +691,11 @@ def test_cli_fabric_flag_exclusivity(monkeypatch, capsys):
         ["bench.py", "--telemetry-port", "0"],
         ["bench.py", "--vclock"],
         ["bench.py", "--serve", "--vclock"],
+        # the socket wire carries fabric KV handoffs only, and the
+        # fault sweep picks each drill's wire itself
+        ["bench.py", "--wire", "tcp"],
+        ["bench.py", "--serve", "--wire", "tcp"],
+        ["bench.py", "--fabric", "--faults", "--wire", "tcp"],
     ]
     for argv in cases:
         monkeypatch.setattr(_sys, "argv", argv)

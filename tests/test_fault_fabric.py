@@ -8,9 +8,13 @@ token-bit-equal streams, hysteretic brownout shedding, and the
 lease-replicated front-door cluster's epoch-bumped failover — all on
 mocked ``FLASHMOE_MOCK_FABRIC`` worlds stepping a
 :class:`VirtualClock` (trace validation needs virtual time: sibling
-jit compiles hole a wall-clock timeline).  The slow lane runs the four
-chaos-matrix drills end to end (``@pytest.mark.slow`` per the lint's
-tier-1 budget guard).
+jit compiles hole a wall-clock timeline).  PR 19 adds the
+cross-process arms: the REAL tcp socket wire (cut mid-stream =>
+reconnect + retry, bit-equal payload), the sub-step heartbeat
+watchdog (a mid-step hang the probes cannot see), and the external
+fenced lease store (tests/test_leasestore.py owns the store itself).
+The slow lane runs the eight serving chaos-matrix drills end to end
+(``@pytest.mark.slow`` per the lint's tier-1 budget guard).
 """
 
 import dataclasses
@@ -44,7 +48,9 @@ SERVE = ServeConfig(max_batch=2, page_size=8, num_pages=64,
                     prompt_bucket=8)
 
 SERVING_FAULTS = ("replica_crash", "handoff_corrupt",
-                  "handoff_timeout", "frontdoor_loss")
+                  "handoff_timeout", "frontdoor_loss",
+                  "net_partition", "lease_split_brain",
+                  "replica_stall", "lease_torn_write")
 
 
 @pytest.fixture(scope="module")
@@ -194,6 +200,85 @@ def test_transport_backoff_caps_and_validates():
         HandoffTransport(plan=FaultPlan("nan_grad"))
     with pytest.raises(ValueError, match="max_retries"):
         HandoffTransport(max_retries=-1)
+    with pytest.raises(ValueError, match="wire"):
+        HandoffTransport(wire="carrier_pigeon")
+
+
+# ----------------------------------------------------------------------
+# The socket wire (real localhost TCP, no engine)
+# ----------------------------------------------------------------------
+
+def test_tcp_wire_clean_roundtrip_bit_identical():
+    """A clean tcp send really crosses a kernel socket and comes back
+    byte-equal — same payload contract as the in-process wire."""
+    mx = Metrics()
+    t = HandoffTransport(metrics_obj=mx, wire="tcp")
+    try:
+        p = _payload()
+        res = t.send(p, modeled_ms=0.5, rid=0)
+        assert res.attempts == 1 and res.retries == 0
+        np.testing.assert_array_equal(np.asarray(res.payload.k),
+                                      np.asarray(p.k))
+        np.testing.assert_array_equal(np.asarray(res.payload.v),
+                                      np.asarray(p.v))
+        snap = t.snapshot()
+        assert snap["wire"] == "tcp" and snap["reset_total"] == 0
+        assert snap["wire_drops"] == 0
+    finally:
+        t.close()
+
+
+def test_tcp_wire_killed_mid_transfer_retries_bit_equal():
+    """The wire is cut MID-STREAM (partial bytes really reach the
+    receiver's socket, then the connection dies): the receiver
+    discards the torn transfer, the sender reconnects and the retry
+    delivers a bit-equal payload with the wasted time priced."""
+    mx = Metrics()
+    t = HandoffTransport(metrics_obj=mx, wire="tcp",
+                         plan=FaultPlan("net_partition", step=0,
+                                        duration=1))
+    try:
+        p = _payload()
+        res = t.send(p, modeled_ms=0.5, rid=3, replica=1)
+        assert res.attempts == 2 and res.retries == 1
+        assert res.retry_ms > 0.5      # modeled wire time + backoff
+        np.testing.assert_array_equal(np.asarray(res.payload.k),
+                                      np.asarray(p.k))
+        np.testing.assert_array_equal(np.asarray(res.payload.v),
+                                      np.asarray(p.v))
+        parts = [d for d in mx.decisions
+                 if d["decision"] == "fabric.partition"]
+        retries = [d for d in mx.decisions
+                   if d["decision"] == "fabric.handoff_retry"]
+        assert len(parts) == 1 and parts[0]["injected"] is True
+        assert parts[0]["wire"] == "tcp"
+        assert parts[0]["dropped_bytes"] > 0
+        assert len(retries) == 1 and retries[0]["reason"] == "reset"
+        # the receiver really saw (and refused) a partial stream
+        assert t.snapshot()["wire_drops"] == 1
+        # the next transfer is clean: the reconnect healed the wire
+        res2 = t.send(_payload(8), modeled_ms=0.5, rid=4)
+        assert res2.retries == 0
+    finally:
+        t.close()
+
+
+def test_inproc_partition_plan_needs_no_socket():
+    """net_partition on the in-process wire models the drop (no
+    partial bytes exist to count) — the retry ladder is identical."""
+    mx = Metrics()
+    t = HandoffTransport(metrics_obj=mx,
+                         plan=FaultPlan("net_partition", step=0,
+                                        duration=1))
+    res = t.send(_payload(), modeled_ms=0.5)
+    assert res.retries == 1
+    parts = [d for d in mx.decisions
+             if d["decision"] == "fabric.partition"]
+    assert len(parts) == 1 and parts[0]["wire"] == "inproc"
+    assert parts[0]["dropped_bytes"] is None
+    assert t.snapshot()["wire_drops"] == 0
+    t.close()                      # idempotent on the socketless wire
+    t.close()
 
 
 # ----------------------------------------------------------------------
@@ -380,6 +465,131 @@ def test_frontdoor_cluster_failover_bit_equal(params, trace, baseline,
     assert all(lease["owner"] != 0 for lease in cl.leases.values())
 
 
+def test_fabric_replica_stall_heartbeat_migration_bit_equal(
+        params, trace, baseline, mock2):
+    """A replica hangs MID-STEP: its probe still answers, so only the
+    sub-step heartbeat deadline catches it — then the same
+    fence+evacuate+adopt migration as a probed crash, token-bit-equal."""
+    from flashmoe_tpu.fabric import HeartbeatConfig
+
+    reqs, arrivals = trace
+    mx = Metrics()
+    fab = ServingFabric(params, CFG, SERVE, metrics_obj=mx,
+                        vclock=VirtualClock(),
+                        heartbeat=HeartbeatConfig(misses_to_stall=2),
+                        fault_plan=FaultPlan("replica_stall", step=3,
+                                             expert=0))
+    door = FrontDoor(fab)
+    out = door.run(reqs, arrivals)
+    errs = door.validate()
+    door.close()
+    fab.close()
+    _assert_bit_equal(out, baseline)
+    assert errs == []
+    stalls = [d for d in mx.decisions
+              if d["decision"] == "fabric.heartbeat_stall"]
+    misses = [d for d in mx.decisions
+              if d["decision"] == "fabric.heartbeat_miss"]
+    crash = [d for d in mx.decisions
+             if d["decision"] == "fabric.replica_crash"]
+    assert len(stalls) == 1 and stalls[0]["replica"] == 0
+    assert stalls[0]["detect_ms"] > 0
+    # detection is LATE by design: the hysteresis window, not the
+    # hang step (the probe can never see a stall)
+    assert stalls[0]["step"] > 3
+    assert len(misses) == 2        # misses_to_stall consecutive
+    assert len(crash) == 1 and fab.router.failed() == (0,)
+    assert 0 in fab._stalled
+
+
+def test_fabric_heartbeat_off_is_default_and_invisible(params, trace,
+                                                       baseline, mock2):
+    """heartbeat=None (the default) installs NO engine callback and
+    no store file — the probe-only path byte-identical to PR 18."""
+    reqs, arrivals = trace
+    fab = ServingFabric(params, CFG, SERVE, metrics_obj=Metrics(),
+                        vclock=VirtualClock())
+    assert fab.hb_watchdog is None
+    assert all(e._heartbeat is None for e in fab.engines)
+    door = FrontDoor(fab)
+    out = door.run(reqs, arrivals)
+    door.close()
+    fab.close()
+    _assert_bit_equal(out, baseline)
+
+
+def test_fabric_heartbeat_armed_clean_run_bit_equal(params, trace,
+                                                    baseline, mock2):
+    """Heartbeats on with NO fault: zero misses, zero stalls, outputs
+    bit-equal — the watchdog never false-positives on a healthy
+    fleet."""
+    from flashmoe_tpu.fabric import HeartbeatConfig
+
+    reqs, arrivals = trace
+    mx = Metrics()
+    fab = ServingFabric(params, CFG, SERVE, metrics_obj=mx,
+                        vclock=VirtualClock(),
+                        heartbeat=HeartbeatConfig())
+    store_path = fab._own_store_path
+    assert store_path and os.path.exists(store_path)
+    door = FrontDoor(fab)
+    out = door.run(reqs, arrivals)
+    door.close()
+    fab.close()
+    _assert_bit_equal(out, baseline)
+    assert not [d for d in mx.decisions
+                if d["decision"] in ("fabric.heartbeat_miss",
+                                     "fabric.heartbeat_stall")]
+    assert not os.path.exists(store_path)   # close() reaped the store
+
+
+def test_frontdoor_cluster_store_parity_with_in_memory(params, trace,
+                                                       baseline, mock2,
+                                                       tmp_path):
+    """The externally-stored lease table is a drop-in for the
+    in-memory one: same failover decisions (shard/epoch/peers), same
+    tokens, plus fencing on the store."""
+    from flashmoe_tpu.fabric import LeaseStore, StaleLeaseError
+
+    reqs, arrivals = trace
+
+    def run_cluster(store):
+        mx = Metrics()
+        fab = ServingFabric(params, CFG, SERVE, metrics_obj=mx,
+                            vclock=VirtualClock())
+        cl = FrontDoorCluster(fab, n_doors=2, n_shards=8,
+                              metrics_obj=mx, store=store)
+        out = cl.run(reqs, arrivals, fail_at=2, fail_peer=0)
+        snap = cl.snapshot()
+        cl.close()
+        fab.close()
+        fo = [{k: d[k] for k in ("shard", "from_peer", "to_peer",
+                                 "epoch")}
+              for d in mx.decisions
+              if d["decision"] == "frontdoor.failover"]
+        return out, fo, snap
+
+    store = LeaseStore(str(tmp_path / "leases.bin"),
+                       metrics_obj=Metrics())
+    out_mem, fo_mem, _ = run_cluster(None)
+    out_ext, fo_ext, snap = run_cluster(store)
+    _assert_bit_equal(out_mem, baseline)
+    _assert_bit_equal(out_ext, baseline)
+    assert fo_ext == fo_mem          # identical failover ledger
+    assert snap["external_store"]
+    # the store remembers across instances, and fences stale epochs
+    reopened = LeaseStore(str(tmp_path / "leases.bin"),
+                          metrics_obj=Metrics())
+    moved = sorted(d["shard"] for d in fo_ext)
+    assert moved and all(reopened.leases()[s].owner != 0
+                         and reopened.leases()[s].epoch >= 1
+                         for s in moved)
+    shard = moved[0]
+    with pytest.raises(StaleLeaseError):
+        reopened.write_lease(shard, 0,
+                             reopened.leases()[shard].epoch)
+
+
 def test_frontdoor_cluster_validates_and_fences(params, mock2):
     fab = ServingFabric(params, CFG, SERVE, metrics_obj=Metrics(),
                         vclock=VirtualClock())
@@ -404,8 +614,10 @@ def test_serving_faults_registered_with_tiers():
         assert EXPECTED_TIER[fault].startswith("fabric:")
     for name in ("fabric.handoff_corrupt", "fabric.handoff_retry",
                  "fabric.migrate", "fabric.replica_crash",
-                 "frontdoor.brownout", "frontdoor.failover",
-                 "frontdoor.shed"):
+                 "fabric.partition", "fabric.heartbeat_miss",
+                 "fabric.heartbeat_stall", "frontdoor.brownout",
+                 "frontdoor.failover", "frontdoor.fence",
+                 "frontdoor.lease_repair", "frontdoor.shed"):
         assert name in DECISION_NAMES
 
 
@@ -464,7 +676,10 @@ def test_fabric_fault_sweep_record_contract(monkeypatch):
     assert [r["metric"] for r in recs] == [
         "fabric_fault[replica_crash]", "fabric_fault[handoff_corrupt]",
         "fabric_fault[handoff_timeout]",
-        "fabric_fault[frontdoor_loss]", "fabric_shed[brownout]"]
+        "fabric_fault[frontdoor_loss]", "fabric_fault[net_partition]",
+        "fabric_fault[lease_split_brain]",
+        "fabric_fault[replica_stall]",
+        "fabric_fault[lease_torn_write]", "fabric_shed[brownout]"]
     crash = recs[0]
     assert crash["unit"] == "ms" and crash["value"] == 123.0
     assert crash["migrated"] == 2 and crash["retries"] == 1
@@ -496,3 +711,17 @@ def test_serving_fault_drill_recovers(fault):
         assert ev["retries"] == 2 and ev["retried_drift"] == 2
     elif fault == "frontdoor_loss":
         assert ev["failovers"] >= 1
+    elif fault == "net_partition":
+        # real socket cuts: partial bytes crossed, retried as resets
+        assert ev["partitions"] == 2 and ev["retries"] == 2
+        assert ev["retried_drift"] == 2
+    elif fault == "lease_split_brain":
+        assert ev["zombie_attempts"] >= 1
+        assert ev["zombie_refused"] == ev["zombie_attempts"]
+        assert ev["fences"] == ev["zombie_refused"]
+    elif fault == "replica_stall":
+        assert ev["stalls"] == 1 and ev["heartbeat_misses"] >= 2
+        assert ev["crashes"] == 1 and ev["migrations"] >= 1
+    elif fault == "lease_torn_write":
+        assert ev["lease_repairs"] >= 1 and ev["torn_bytes"] > 0
+        assert ev["restored_epoch"] == 1 and ev["failovers"] >= 1

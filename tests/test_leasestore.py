@@ -1,0 +1,352 @@
+"""The external fenced lease store + sub-step heartbeat watchdog
+(PR 19): the pieces that make the front-door cluster survive REAL
+process boundaries.
+
+Fast lanes exercise the store file directly — strictly-newer epoch
+fencing (a zombie's re-assert refused with a ``frontdoor.fence``
+decision), torn-tail recovery including a genuine ``kill -9`` of a
+writer mid-append (the kernel releases the flock, the next writer
+truncates the garbage), monotonic heartbeat sequencing, and the
+watchdog's deadline hysteresis (a slow-but-alive replica that beats
+every other observation is NEVER declared stalled; a hung one is
+declared after exactly ``misses_to_stall`` consecutive misses).
+
+The slow lane is the cross-OS-process drill the ISSUE demands: a real
+``doorproc`` child process sharing ONLY the store file with the
+parent's fabric (tcp socket wire, heartbeats armed), one door failed
+over AND one decode replica killed, token-bit-equal output, zero
+orphan spans, and the child's stale-epoch refusal visible in the
+merged fleet telemetry.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from flashmoe_tpu.fabric.leasestore import (
+    HeartbeatConfig, HeartbeatWatchdog, LeaseStore, StaleLeaseError,
+)
+from flashmoe_tpu.utils.telemetry import Metrics
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return LeaseStore(str(tmp_path / "leases.bin"),
+                      metrics_obj=Metrics(), peer=0)
+
+
+# ----------------------------------------------------------------------
+# epoch fencing
+# ----------------------------------------------------------------------
+
+def test_lease_fencing_strictly_newer(store):
+    store.init_leases({0: 0, 1: 1})
+    assert store.leases()[0].epoch == 0
+    lease = store.write_lease(0, 1, 1, reason="failover")
+    assert (lease.owner, lease.epoch) == (1, 1)
+    # equal epoch is STALE — strictly-newer is the fencing rule, so a
+    # zombie replaying the same token it just lost with cannot win
+    with pytest.raises(StaleLeaseError, match="fenced off"):
+        store.write_lease(0, 0, 1, reason="zombie_reassert")
+    # and so is anything older
+    with pytest.raises(StaleLeaseError):
+        store.write_lease(0, 0, 0)
+    assert store.fenced == 2
+    table = store.leases()
+    assert (table[0].owner, table[0].epoch) == (1, 1)   # unclobbered
+
+
+def test_fence_decision_names_the_zombie(store):
+    store.init_leases({3: 1})
+    store.write_lease(3, 0, 2, reason="failover")
+    with pytest.raises(StaleLeaseError):
+        store.write_lease(3, 1, 2, reason="zombie_reassert")
+    fences = [d for d in store.metrics.decisions
+              if d["decision"] == "frontdoor.fence"]
+    assert len(fences) == 1
+    f = fences[0]
+    assert f["shard"] == 3 and f["refused"] is True
+    assert f["claimant"] == 1 and f["stale_epoch"] == 2
+    assert f["current_epoch"] == 2 and f["current_owner"] == 0
+    assert f["reason"] == "zombie_reassert"
+
+
+def test_init_leases_adopts_live_table(store):
+    """A second process joining an existing store must NOT reset it."""
+    store.init_leases({0: 0, 1: 1})
+    store.write_lease(1, 0, 5, reason="failover")
+    joiner = LeaseStore(store.path, metrics_obj=Metrics(), peer=1)
+    joiner.init_leases({0: 1, 1: 1, 2: 1})      # 0/1 exist, 2 is new
+    table = joiner.leases()
+    assert (table[0].owner, table[0].epoch) == (0, 0)
+    assert (table[1].owner, table[1].epoch) == (0, 5)
+    assert (table[2].owner, table[2].epoch) == (1, 0)
+
+
+# ----------------------------------------------------------------------
+# torn-write recovery
+# ----------------------------------------------------------------------
+
+def test_torn_tail_skipped_on_read_repaired_on_write(store):
+    store.init_leases({0: 0})
+    store.write_lease(0, 1, 1, reason="survives")
+    store.write_lease(0, 0, 2, reason="the victim")
+    torn = store.tear_last_record()
+    assert torn > 0
+    # readers never see the half-written epoch 2 — and read() leaves
+    # the repair to the next WRITER
+    assert store.leases()[0].epoch == 1
+    assert store.repairs == 0
+    store.write_lease(0, 1, 2, reason="post_crash")
+    assert store.repairs == 1
+    reps = [d for d in store.metrics.decisions
+            if d["decision"] == "frontdoor.lease_repair"]
+    assert len(reps) == 1
+    assert reps[0]["torn_bytes"] == torn
+    assert reps[0]["restored_epoch"] == 1
+    table = store.leases()
+    assert (table[0].owner, table[0].epoch) == (1, 2)
+
+
+_KILLER = textwrap.dedent("""\
+    import os, signal, sys
+    from flashmoe_tpu.fabric.leasestore import LeaseStore
+
+    store = LeaseStore(sys.argv[1], metrics_obj=None, peer=9)
+
+    class Die(Exception):
+        pass
+
+    real_write = LeaseStore._write
+
+    def half_write_then_die(self, fh, state):
+        # emulate the kernel yanking the process mid-append: flush
+        # HALF the frame while still holding the flock, then SIGKILL
+        # ourselves — no unlock, no truncate, no atexit.
+        import flashmoe_tpu.fabric.leasestore as L
+        frame = L._frame(state)
+        fh.seek(0, os.SEEK_END)
+        fh.write(frame[: len(frame) // 2])
+        fh.flush()
+        os.fsync(fh.fileno())
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    LeaseStore._write = half_write_then_die
+    store.write_lease(0, 9, 99, reason="doomed")
+    """)
+
+
+def test_kill9_mid_append_recovers(store):
+    """A real writer process SIGKILLed mid-append through the actual
+    ``write_lease`` path: the survivor sees the pre-crash table, is
+    not deadlocked by the dead writer's flock (the kernel released
+    it), and the next write rolls the torn tail back."""
+    store.init_leases({0: 0})
+    store.write_lease(0, 1, 1, reason="pre_crash")
+    before = os.path.getsize(store.path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _KILLER, store.path],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    assert os.path.getsize(store.path) > before     # garbage landed
+    # the flock died with the writer: this read would hang forever if
+    # the kernel had not released it
+    assert store.leases()[0].epoch == 1             # 99 never existed
+    store.write_lease(0, 0, 2, reason="post_crash")
+    assert store.repairs == 1
+    assert store.leases()[0].epoch == 2
+
+
+# ----------------------------------------------------------------------
+# heartbeats + watchdog hysteresis
+# ----------------------------------------------------------------------
+
+def test_heartbeat_seq_is_monotonic(store):
+    assert store.heartbeat(0, 5, ts_ms=1.0, phase="decode", step=2)
+    assert not store.heartbeat(0, 5, ts_ms=2.0)     # replay dropped
+    assert not store.heartbeat(0, 4, ts_ms=3.0)     # regression dropped
+    assert store.heartbeat(0, 6, ts_ms=4.0, phase="end", step=2)
+    row = store.beats()["0"]
+    assert row["seq"] == 6 and row["phase"] == "end"
+    assert row["ts_ms"] == 4.0 and row["step"] == 2
+
+
+def test_heartbeat_config_validates():
+    with pytest.raises(ValueError, match="misses_to_stall"):
+        HeartbeatConfig(misses_to_stall=0)
+    assert HeartbeatConfig().misses_to_stall >= 2   # hysteresis default
+
+
+def test_watchdog_slow_replica_never_false_positives(store):
+    """The no-false-positive gate: a replica beating every OTHER
+    observation keeps resetting its miss count and is never declared
+    stalled, no matter how long the run."""
+    mx = Metrics()
+    wd = HeartbeatWatchdog(store, misses_to_stall=2, tick_ms=1.0,
+                           metrics_obj=mx)
+    seq = 0
+    for step in range(20):
+        if step % 2 == 0:               # slow: beats on even steps only
+            seq += 1
+            store.heartbeat(7, seq)
+        assert wd.observe(step, [7], pending=lambda r: True) == []
+    assert wd.stalled_total == 0
+    assert not [d for d in mx.decisions
+                if d["decision"] == "fabric.heartbeat_stall"]
+    # it DID take misses — hysteresis absorbed them
+    misses = [d for d in mx.decisions
+              if d["decision"] == "fabric.heartbeat_miss"]
+    assert misses and all(m["misses"] == 1 for m in misses)
+
+
+def test_watchdog_declares_stall_after_exact_hysteresis(store):
+    mx = Metrics()
+    wd = HeartbeatWatchdog(store, misses_to_stall=3, tick_ms=0.5,
+                           metrics_obj=mx)
+    store.heartbeat(4, 1, phase="prefill", step=0)
+    assert wd.observe(0, [4], pending=lambda r: True) == []  # fresh
+    assert wd.observe(1, [4], pending=lambda r: True) == []  # miss 1
+    assert wd.observe(2, [4], pending=lambda r: True) == []  # miss 2
+    assert wd.observe(3, [4], pending=lambda r: True) == [4]  # stalled
+    stalls = [d for d in mx.decisions
+              if d["decision"] == "fabric.heartbeat_stall"]
+    assert len(stalls) == 1
+    s = stalls[0]
+    assert s["replica"] == 4 and s["misses"] == 3
+    assert s["detect_ms"] == pytest.approx(1.5)     # 3 misses x 0.5 ms
+    assert s["last_phase"] == "prefill"             # WHERE it froze
+
+
+def test_watchdog_idle_replica_owes_no_beat(store):
+    """Miss accounting is gated on pending work: an idle replica that
+    never beats is not a stall candidate."""
+    wd = HeartbeatWatchdog(store, misses_to_stall=1, tick_ms=1.0,
+                           metrics_obj=Metrics())
+    for step in range(5):
+        assert wd.observe(step, [2], pending=lambda r: False) == []
+    assert wd.stalled_total == 0
+
+
+# ----------------------------------------------------------------------
+# the cross-OS-process drill
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_cross_process_drill_two_doors_socket_wire(tmp_path,
+                                                   monkeypatch):
+    """The acceptance drill: door peer 1 is a REAL child process
+    (``python -m flashmoe_tpu.fabric.doorproc``) sharing only the
+    lease store file with the parent.  The parent drives the fleet
+    over the tcp socket wire with heartbeats armed, fails the child's
+    door over mid-trace AND kills a decode replica — tokens stay
+    bit-equal, no spans orphan, the child is fenced (exit code 3) and
+    its stale-epoch refusal shows up in the merged fleet telemetry."""
+    import time as _time
+
+    import jax
+
+    from flashmoe_tpu.chaos import FaultPlan
+    from flashmoe_tpu.fabric import (
+        FrontDoorCluster, HandoffTransport, ServingFabric, VirtualClock,
+    )
+    from flashmoe_tpu.fabric.topo import ENV_MOCK_FABRIC
+    from flashmoe_tpu.models.transformer import init_params
+    from flashmoe_tpu.observe import merge_report
+    from flashmoe_tpu.serving.engine import ServeConfig, ServingEngine
+    from flashmoe_tpu.serving.loadgen import build_requests, tiny_config
+
+    cfg = tiny_config()
+    serve = ServeConfig(max_batch=2, page_size=8, num_pages=64,
+                        max_pages_per_slot=4, ctx_bucket_pages=1,
+                        prompt_bucket=8)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    reqs, arrivals = build_requests(6, vocab=cfg.vocab_size,
+                                    prompt_len=8, max_new=4, seed=0,
+                                    arrival_every=1)
+    eng = ServingEngine(params, cfg, serve, metrics_obj=Metrics())
+    baseline = eng.run(reqs, arrivals)
+    eng.close()
+
+    monkeypatch.setenv(ENV_MOCK_FABRIC, "2")
+    store_path = str(tmp_path / "leases.bin")
+    child_shard = str(tmp_path / "telemetry.door1.jsonl")
+    parent_shard = str(tmp_path / "telemetry.door0.jsonl")
+
+    mx = Metrics()
+    store = LeaseStore(store_path, metrics_obj=mx, peer=0)
+    transport = HandoffTransport(metrics_obj=mx, wire="tcp")
+    fab = ServingFabric(
+        params, cfg, serve, metrics_obj=mx, vclock=VirtualClock(),
+        transport=transport,
+        heartbeat=HeartbeatConfig(misses_to_stall=2,
+                                  store_path=store_path),
+        fault_plan=FaultPlan("replica_crash", step=3, expert=0))
+    cluster = FrontDoorCluster(fab, n_doors=2, n_shards=8,
+                               metrics_obj=mx, store=store)
+
+    child = subprocess.Popen(
+        [sys.executable, "-m", "flashmoe_tpu.fabric.doorproc",
+         "--store", store_path, "--peer", "1",
+         "--telemetry", child_shard,
+         "--iterations", "2000", "--interval", "0.02"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        # wait for the child's first heartbeat so the failover races a
+        # LIVE peer, not a process still importing
+        deadline = _time.monotonic() + 60
+        while _time.monotonic() < deadline:
+            if "door1" in store.beats():
+                break
+            _time.sleep(0.05)
+        else:
+            pytest.fail("doorproc child never heartbeat")
+
+        out = cluster.run(reqs, arrivals, fail_at=2, fail_peer=1)
+        errs = cluster.validate()
+
+        child.wait(timeout=60)
+    finally:
+        if child.poll() is None:
+            child.kill()                # kill -9: drill cleanup arm
+        child.wait(timeout=30)
+        cluster.close()
+        fab.close()
+        transport.close()
+
+    # tokens bit-equal through door failover + replica crash + tcp wire
+    assert sorted(out) == sorted(baseline)
+    for rid in baseline:
+        assert out[rid] == baseline[rid], f"rid {rid} diverged"
+    assert errs == []                   # zero orphan spans
+    assert transport.transfers > 0      # KV really crossed the socket
+
+    # the child played the zombie and the store fenced it off
+    assert child.returncode == 3, (child.stdout, child.stderr)
+    crashes = [d for d in mx.decisions
+               if d["decision"] == "fabric.replica_crash"]
+    failovers = [d for d in mx.decisions
+                 if d["decision"] == "frontdoor.failover"]
+    assert len(crashes) == 1 and failovers
+
+    # merged fleet view: both per-door shards, and the child's own
+    # telemetry carries the stale-epoch refusal
+    with open(parent_shard, "w") as fh:
+        for d in mx.decisions:
+            fh.write(json.dumps(d, default=str) + "\n")
+    rep = merge_report([parent_shard, child_shard])
+    assert sorted(rep["hosts"]) == ["door0", "door1"]
+    child_recs = [json.loads(line)
+                  for line in open(child_shard, encoding="utf-8")]
+    child_fences = [r for r in child_recs
+                    if r.get("decision") == "frontdoor.fence"]
+    assert child_fences and child_fences[0]["refused"] is True
+    assert child_fences[0]["peer"] == 1
+    assert child_fences[0]["current_epoch"] > child_fences[0][
+        "stale_epoch"] - 1              # stale = cached + 1 == current
