@@ -449,7 +449,8 @@ def _bench_profile(obs_dir: str | None, *, steps: int = 1,
 
 
 def _bench_serve(loads, *, requests: int, max_batch: int,
-                 telemetry_port: int | None = None):
+                 telemetry_port: int | None = None,
+                 speculate: int | None = None):
     """Offered-load serving sweep (``--serve``): the continuous-
     batching engine (flashmoe_tpu/serving/) driven by a seeded arrival
     trace at each offered-load point, one JSON record per point with
@@ -458,12 +459,18 @@ def _bench_serve(loads, *, requests: int, max_batch: int,
     sized model; identical procedure on real chips.
     ``telemetry_port`` arms the live scrape plane for the sweep's
     duration; each record then carries a mid-sweep ``/metrics``
-    self-scrape (``telemetry_scrape``)."""
+    self-scrape (``telemetry_scrape``).  ``speculate`` (``--serve
+    --speculate K``) arms speculative decoding at ``draft_tokens=K``:
+    each record gains a ``spec=kK`` identity tag, the realized
+    ``accept_rate`` / ``spec_tokens_per_step``, an equal-SLO TPOT
+    comparison against a per-point non-speculative baseline, and the
+    asserted ``bit_equal_to_baseline`` exactness bit."""
     from flashmoe_tpu.serving.loadgen import serve_load_sweep
 
     for rec in serve_load_sweep(loads, n_requests=requests,
                                 max_batch=max_batch,
-                                telemetry_port=telemetry_port):
+                                telemetry_port=telemetry_port,
+                                speculate=speculate):
         print(json.dumps(rec), flush=True)
         _flush_observability(rec)
 
@@ -1277,6 +1284,12 @@ def main():
                     help="requests per --serve load point")
     ap.add_argument("--serve-batch", type=int, default=4,
                     help="engine decode-batch width for --serve")
+    ap.add_argument("--speculate", type=int, default=None, metavar="K",
+                    help="with --serve: arm speculative decoding at "
+                         "draft_tokens=K — per-record accept_rate / "
+                         "spec_tokens_per_step, an equal-SLO TPOT "
+                         "comparison against a per-point baseline, "
+                         "and the spec=kK metric-identity tag")
     ap.add_argument("--telemetry-port", type=int, default=None,
                     metavar="PORT",
                     help="with --serve: arm the live scrape plane for "
@@ -1433,6 +1446,14 @@ def main():
         # other mode would silently ignore it
         ap.error("--wire-dcn applies to --scaling only (the other "
                  "modes run no cross-slice hop)")
+    if args.speculate is not None and not args.serve:
+        # checked BEFORE any mode dispatches (--fabric/--scaling
+        # return early): a silently-dropped --speculate would report
+        # a plain sweep as a speculative one
+        ap.error("--speculate applies with --serve only (the "
+                 "speculative drill rides the serving engine)")
+    if args.speculate is not None and args.speculate < 1:
+        ap.error("--speculate must be >= 1 draft token")
     if args.fabric:
         # the --profile/--ckpt fail-fast contract: the fabric sweep
         # drives its own CPU-sized drill model over its own mocked
@@ -1588,7 +1609,8 @@ def main():
             signal.alarm(args.deadline)  # host+CPU path: no probe leg
         _bench_serve(loads, requests=args.serve_requests,
                      max_batch=args.serve_batch,
-                     telemetry_port=args.telemetry_port)
+                     telemetry_port=args.telemetry_port,
+                     speculate=args.speculate)
         _finish_regression()
         return
     if args.ckpt:

@@ -49,6 +49,9 @@ from flashmoe_tpu.fabric.topo import fabric_world
 from flashmoe_tpu.serving.engine import ServeConfig, ServingEngine
 from flashmoe_tpu.utils.telemetry import metrics as _global_metrics
 
+# "break-even not priced yet" — distinct from None (priced, infeasible)
+_SPEC_BE_UNSET = object()
+
 
 class _ReplicaStallInjected(RuntimeError):
     """A ``replica_stall`` chaos plan hung the victim mid-step: its
@@ -127,6 +130,11 @@ class ServingFabric:
                         else _global_metrics)
         self.controller = controller
         self.vclock = vclock
+        # fleet speculation trigger state: cumulative (drafted,
+        # accepted) at the last controller observation, and the lazily
+        # priced planner break-even (sentinel = not priced yet)
+        self._spec_prev = (0, 0)
+        self._spec_be = _SPEC_BE_UNSET
 
         devices = jax.devices()
         if replicas is None:
@@ -445,6 +453,49 @@ class ServingFabric:
     def pending(self) -> bool:
         return any(e.pending() for e in self.engines)
 
+    def _spec_break_even(self):
+        """Planner break-even acceptance for the fleet's verify depth,
+        priced once and cached (the shape never changes mid-run).  None
+        when the planner has no feasible decode path for this config —
+        the controller then falls back to its configured floor."""
+        if self._spec_be is _SPEC_BE_UNSET:
+            try:
+                from flashmoe_tpu.planner.model import \
+                    speculate_break_even
+                self._spec_be = speculate_break_even(
+                    self.cfg,
+                    verify_tokens=self.serve.speculate.draft_tokens)
+            except Exception:
+                self._spec_be = None
+        return self._spec_be
+
+    def _observe_spec(self) -> None:
+        """Feed the controller the fleet's INSTANTANEOUS draft
+        acceptance (this step's delta across replicas, not the
+        cumulative rate — a run that started well must still morph when
+        traffic turns adversarial) and execute a morph-off verdict on
+        EVERY replica at once: a per-replica split would fork the
+        measurement identity the planner's spec pricing assumes."""
+        drafted = accepted = 0
+        spec_on = False
+        for e in self.engines:
+            snap = e.spec_snapshot()
+            drafted += snap["spec_drafted"]
+            accepted += snap["spec_accepted"]
+            spec_on = spec_on or snap["spec_on"]
+        d = drafted - self._spec_prev[0]
+        a = accepted - self._spec_prev[1]
+        self._spec_prev = (drafted, accepted)
+        self.controller.observe_spec(
+            self.step_idx, (a / d) if d > 0 else None,
+            break_even=self._spec_break_even())
+        act = self.controller.maybe_morph_spec(
+            self.step_idx, spec_on=spec_on)
+        if act is not None:
+            for e in self.engines:
+                if e._spec is not None:
+                    e.set_speculate(False, reason=act.reason)
+
     def step(self) -> dict:
         """One fabric iteration: inject/detect crashes, then every live
         replica with pending work steps once (decode steps overlap the
@@ -489,6 +540,8 @@ class ServingFabric:
                     self.router.drain(act.replica)
                 else:
                     self.router.undrain(act.replica)
+            if self.serve.speculate is not None:
+                self._observe_spec()
         return {"kind": "fabric_step", "step": self.step_idx,
                 "replica_steps": len(recs),
                 "queue_depth": sum(len(e.queue) for e in self.engines),
@@ -530,6 +583,19 @@ class ServingFabric:
             "migrated": self.migrated,
             "engines": [e.summary() for e in self.engines],
         }
+        if self.serve.speculate is not None:
+            drafted = sum(e.spec_snapshot()["spec_drafted"]
+                          for e in self.engines)
+            accepted = sum(e.spec_snapshot()["spec_accepted"]
+                           for e in self.engines)
+            out["spec"] = {
+                "spec_drafted": drafted,
+                "spec_accepted": accepted,
+                "accept_rate": (round(accepted / drafted, 6)
+                                if drafted else 0.0),
+                "spec_on": [bool(e._spec is not None)
+                            for e in self.engines],
+            }
         if self.hb_watchdog is not None:
             out["heartbeat"] = self.hb_watchdog.snapshot()
         if self.vclock is not None:

@@ -184,6 +184,20 @@ def lm_logits(params, cfg: MoEConfig, h):
     )[:, 0]  # [B, V]
 
 
+def lm_logits_span(params, cfg: MoEConfig, h):
+    """The multi-position twin of :func:`lm_logits`: final-norm +
+    lm_head over a [B, T, H] hidden SPAN -> [B, T, V] f32.  The serving
+    engine's speculative verify step (ISSUE 20) scores ``k+1`` drafted
+    positions per slot in one forward and needs the lm head at every
+    one of them; sharing the tail here keeps each column bit-identical
+    to what :func:`lm_logits` produces from the same hidden row."""
+    h = rms_norm(h, params["final_norm"])
+    return jnp.dot(
+        h.astype(cfg.dtype), params["lm_head"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )  # [B, T, V]
+
+
 def prefill_batched(params, cfg: MoEConfig, prompt, cache: KVCache):
     """Single-pass prefill: :func:`prefill_forward` + the lm head on
     the LAST prompt position.  Returns (logits [B, V], filled cache)."""

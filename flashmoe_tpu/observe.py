@@ -644,7 +644,24 @@ def serving_report(records: list[dict]) -> dict:
         "fabric_failures": _fabric_failures(
             crashes, migrations, retries, corrupts, sheds, brownouts,
             failovers, partitions, fences, repairs, stalls, hb_misses),
+        # speculative decoding (ISSUE 20): acceptance economics and
+        # controller spec-morphs, aggregated from the same records by
+        # the flight-recorder consumer twin of the engine's counters
+        "speculation": _speculation_section(records),
     }
+
+
+def _speculation_section(records):
+    """The ``--serving`` speculation section (None when the run never
+    drafted and never morphed — a non-speculative dump stays
+    byte-identical)."""
+    from flashmoe_tpu.ops.stats import speculation_summary
+
+    s = speculation_summary(records)
+    if not (s["spec_drafted"] or s["steps_spec_on"]
+            or s["spec_morphs"]):
+        return None
+    return s
 
 
 def _fabric_failures(crashes, migrations, retries, corrupts, sheds,
@@ -811,6 +828,16 @@ def render_serving_text(rep: dict) -> str:
         b = rep["slo_breaches"]
         lines.append(f"  SLO breaches: ttft={b['ttft']} "
                      f"tpot={b['tpot']}")
+    sp = rep.get("speculation")
+    if sp:
+        lines.append(
+            f"  speculation: {sp['spec_accepted']}/{sp['spec_drafted']}"
+            f" drafts accepted ({sp['accept_rate']:.1%}), "
+            f"{sp['spec_tokens_per_step']:.2f} tokens/verify-step over "
+            f"{sp['spec_steps']} verify steps"
+            + (f"  [{sp['spec_morphs']} spec morph(s) — controller "
+               f"switched speculation off]" if sp["spec_morphs"]
+               else ""))
     ff = rep.get("fabric_failures")
     if ff:
         lines.append("  -- failures --")

@@ -73,6 +73,16 @@ GOLDEN_QUANT = {"off": {}, "int8": {"expert_quant": "int8"}}
 GOLDEN_KV_WIRES = {"off": None, "e4m3": "e4m3"}
 GOLDEN_KV_PAGE = 16       # page_size the fabric dimension prices at
 GOLDEN_KV_PAGES = 8       # pages per handed-off prompt (128 tokens)
+# the speculative-decode dimension (ISSUE 20,
+# ServeConfig.speculate): the one-token decode step vs the
+# draft_tokens+1 verify span at the decode batch, the modeled
+# tokens/step uplift at the reference acceptance, and the break-even
+# acceptance the controller's spec-morph trigger compares against —
+# frozen so the economics of speculation (cost ratio near 1 at
+# wire/HBM-bound decode shapes => uplift > 1) are themselves
+# golden-gated (tests/test_planner.py)
+GOLDEN_SPEC_K = 3          # drafted tokens per slot priced
+GOLDEN_SPEC_ACCEPT = 0.7   # reference acceptance the uplift is quoted at
 
 _TERMS = ("compute_ms", "hbm_ms", "ici_ms", "dcn_ms", "total_ms")
 
@@ -189,16 +199,49 @@ def _fabric_point(cfg, gen: str) -> dict:
     return point
 
 
+def _speculate_point(cfg, gen: str) -> dict:
+    """One frozen speculation point: decode-step vs verify-span cost at
+    the golden decode batch, the modeled tokens/step uplift at the
+    reference acceptance, and the break-even acceptance
+    (:func:`~flashmoe_tpu.planner.model.speculate_break_even`) the
+    ``controller.spec_morph`` trigger compares the live acceptance EMA
+    against.  The acceptance gate: uplift > 1 with break-even well
+    under the reference acceptance on every golden decode config."""
+    from flashmoe_tpu.planner.model import (speculate_break_even,
+                                            speculate_uplift)
+
+    up = speculate_uplift(cfg, GOLDEN_D, gen,
+                          decode_tokens=GOLDEN_DECODE_TOKENS,
+                          verify_tokens=GOLDEN_SPEC_K,
+                          accept_rate=GOLDEN_SPEC_ACCEPT)
+    be = speculate_break_even(cfg, GOLDEN_D, gen,
+                              decode_tokens=GOLDEN_DECODE_TOKENS,
+                              verify_tokens=GOLDEN_SPEC_K)
+    return {
+        "verify_tokens": GOLDEN_SPEC_K,
+        "accept_rate": GOLDEN_SPEC_ACCEPT,
+        "decode_ms": round(up["t1_ms"], 6),
+        "verify_ms": round(up["tk_ms"], 6),
+        "cost_ratio": round(up["cost_ratio"], 6),
+        "tokens_per_step": round(up["tokens_per_step"], 6),
+        "uplift": round(up["uplift"], 6),
+        "break_even_accept": round(be, 6),
+        "pays": bool(up["uplift"] > 1.0 and be < GOLDEN_SPEC_ACCEPT),
+    }
+
+
 def golden_snapshot() -> dict:
     """Recompute the full golden structure from the live model."""
     from flashmoe_tpu.config import BENCH_CONFIGS
 
     out = {"d": GOLDEN_D, "configs": {}, "decode": {}, "slices": {},
-           "quant": {}, "fabric": {}}
+           "quant": {}, "fabric": {}, "speculate": {}}
     for name in GOLDEN_CONFIGS:
         cfg = BENCH_CONFIGS[name]
         out["fabric"][name] = {gen: _fabric_point(cfg, gen)
                                for gen in GOLDEN_GENS}
+        out["speculate"][name] = {gen: _speculate_point(cfg, gen)
+                                  for gen in GOLDEN_GENS}
     for name in GOLDEN_CONFIGS:
         cfg = BENCH_CONFIGS[name]
         gens = {}

@@ -286,7 +286,8 @@ DECODE_TOKENS_DEFAULT = 64
 
 
 def decode_shape(cfg: MoEConfig, d: int = 1,
-                 decode_tokens: int | None = None) -> MoEConfig:
+                 decode_tokens: int | None = None,
+                 verify_tokens: int | None = None) -> MoEConfig:
     """The per-STEP problem a decode engine actually runs: ``tokens`` =
     the decode batch (``decode_tokens``, rounded up so the ranks
     divide it), inference mode.  This is the config the planner prices
@@ -294,12 +295,23 @@ def decode_shape(cfg: MoEConfig, d: int = 1,
     exchange rows, the regime where per-message alphas dominate the
     tiny slabs and the training-shaped schedule sweeps pick wrong
     (RaMP, arXiv 2604.26039; the reference's inference-mode Decider
-    specialization, ``decider.cuh:177-268``)."""
+    specialization, ``decider.cuh:177-268``).
+
+    ``verify_tokens`` (ISSUE 20): drafted tokens ``k`` a speculative
+    verify step scores on top of the canonical token — every slot
+    feeds a ``k + 1`` position span, so the step moves
+    ``decode_tokens x (k + 1)`` token rows through the layer.  The
+    decode-vs-verify cost RATIO at this shape is the whole economics
+    of speculation: at wire/HBM-bound decode shapes it sits near 1."""
     toks = int(decode_tokens if decode_tokens else DECODE_TOKENS_DEFAULT)
     if toks < 1:
         raise ValueError(f"decode_tokens={decode_tokens!r} must be >= 1")
+    if verify_tokens is not None and int(verify_tokens) < 0:
+        raise ValueError(
+            f"verify_tokens={verify_tokens!r} must be >= 0")
     d = max(int(d), 1)
     toks = -(-toks // d) * d          # ranks must divide the step batch
+    toks *= 1 + int(verify_tokens or 0)
     return cfg.replace(sequence_len=toks, mini_batch=1,
                        is_training=False)
 
@@ -308,6 +320,7 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
                   slices: int = 1, links: int = 4,
                   mxu_fraction: float = 1.0, mode: str = "training",
                   decode_tokens: int | None = None,
+                  verify_tokens: int | None = None,
                   dp: int = 1, dp_over_dcn: bool = False
                   ) -> list[PathPrediction]:
     """Predict every candidate path's latency at (cfg, d ranks, gen).
@@ -328,14 +341,19 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
     ``mode``: the pricing regime — ``'training'`` (default) prices the
     config's own B x S step; ``'decode'`` re-shapes it first
     (:func:`decode_shape`: per-step tokens = ``decode_tokens``, the
-    decode batch); ``'prefill'`` keeps the full-sequence shape but
-    prices inference-mode feasibility (the gather kernel qualifies).
+    decode batch — times ``verify_tokens + 1`` when a speculative
+    verify span is priced); ``'prefill'`` keeps the full-sequence
+    shape but prices inference-mode feasibility (the gather kernel
+    qualifies).
     """
     if mode not in ("training", "prefill", "decode"):
         raise ValueError(
             f"mode {mode!r} not in ('training', 'prefill', 'decode')")
+    if verify_tokens and mode != "decode":
+        raise ValueError("verify_tokens prices the speculative verify "
+                         "span — decode mode only")
     if mode == "decode":
-        cfg = decode_shape(cfg, d, decode_tokens)
+        cfg = decode_shape(cfg, d, decode_tokens, verify_tokens)
     elif mode == "prefill" and cfg.is_training:
         cfg = cfg.replace(is_training=False)
     peak_fs, hbm_bs = _dtype_peak(gen, cfg)   # validates gen first
@@ -546,6 +564,98 @@ def predict_paths(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
 
     rows.sort(key=lambda r: (not r.feasible, r.total_ms))
     return rows
+
+
+# ----------------------------------------------------------------------
+# Speculative-decode economics (ISSUE 20)
+# ----------------------------------------------------------------------
+
+def speculate_tokens_per_step(accept_rate: float, k: int) -> float:
+    """Expected tokens emitted per verify step when ``k`` drafts ride
+    the span and each draft position accepts independently with
+    probability ``accept_rate`` (the prefix-acceptance model): the
+    canonical token always lands, plus the geometric accepted prefix —
+    ``(1 - p^(k+1)) / (1 - p)``, saturating at ``k + 1`` when p = 1."""
+    p = min(max(float(accept_rate), 0.0), 1.0)
+    k = int(k)
+    if k < 0:
+        raise ValueError(f"k={k} must be >= 0")
+    if p >= 1.0:
+        return float(k + 1)
+    return (1.0 - p ** (k + 1)) / (1.0 - p)
+
+
+def _best_decode_ms(cfg: MoEConfig, d: int, gen: str, *,
+                    decode_tokens: int | None,
+                    verify_tokens: int | None) -> float:
+    rows = predict_paths(cfg, d, gen, mode="decode",
+                         decode_tokens=decode_tokens,
+                         verify_tokens=verify_tokens)
+    best = next((r for r in rows if r.feasible), rows[0])
+    return best.total_ms
+
+
+def speculate_uplift(cfg: MoEConfig, d: int = 1, gen: str = "v5e", *,
+                     decode_tokens: int | None = None,
+                     verify_tokens: int = 3,
+                     accept_rate: float = 0.7) -> dict:
+    """Modeled tokens/step uplift of draft-then-verify at
+    ``accept_rate``: expected emitted tokens per step times the
+    one-token/verify-span cost ratio —
+    ``E[n](p) x t1 / tk``.  The reference kernel's wire/HBM-bound
+    decode step makes ``tk / t1`` sit near 1 at decode shapes (the
+    weights stream past once either way), which is why speculation
+    pays at all; the golden ``speculate`` dimension freezes this."""
+    k = int(verify_tokens)
+    if k < 1:
+        raise ValueError(f"verify_tokens={verify_tokens} must be >= 1")
+    t1 = _best_decode_ms(cfg, d, gen, decode_tokens=decode_tokens,
+                         verify_tokens=None)
+    tk = _best_decode_ms(cfg, d, gen, decode_tokens=decode_tokens,
+                         verify_tokens=k)
+    e_n = speculate_tokens_per_step(accept_rate, k)
+    cost_ratio = tk / t1 if t1 > 0 else float("inf")
+    return {
+        "verify_tokens": k,
+        "accept_rate": float(accept_rate),
+        "t1_ms": t1,
+        "tk_ms": tk,
+        "cost_ratio": cost_ratio,
+        "tokens_per_step": e_n,
+        "uplift": e_n / cost_ratio if cost_ratio else float("inf"),
+    }
+
+
+def speculate_break_even(cfg: MoEConfig, d: int = 1, gen: str = "v5e",
+                         *, decode_tokens: int | None = None,
+                         verify_tokens: int = 3) -> float:
+    """The acceptance rate at which speculation exactly pays for its
+    verify span: solves ``E[n](p) = tk / t1`` for p by bisection
+    (E[n] is strictly increasing in p).  Below this the controller's
+    spec-morph trigger switches speculation off
+    (``controller.spec_morph``); returns 1.0 when even perfect
+    acceptance cannot pay (cost ratio > k + 1) and 0.0 when the span
+    is literally free (ratio <= 1)."""
+    k = int(verify_tokens)
+    if k < 1:
+        raise ValueError(f"verify_tokens={verify_tokens} must be >= 1")
+    t1 = _best_decode_ms(cfg, d, gen, decode_tokens=decode_tokens,
+                         verify_tokens=None)
+    tk = _best_decode_ms(cfg, d, gen, decode_tokens=decode_tokens,
+                         verify_tokens=k)
+    ratio = tk / t1 if t1 > 0 else float("inf")
+    if ratio <= 1.0:
+        return 0.0
+    if ratio >= k + 1:
+        return 1.0
+    lo, hi = 0.0, 1.0
+    for _ in range(60):
+        mid = (lo + hi) / 2
+        if speculate_tokens_per_step(mid, k) < ratio:
+            lo = mid
+        else:
+            hi = mid
+    return (lo + hi) / 2
 
 
 def explain_table(preds: list[PathPrediction], *, markdown: bool = True

@@ -105,12 +105,14 @@ class Selection:
                                 # across the candidate chunk counts
 
 
-def _shape_key(cfg: MoEConfig, d: int) -> dict:
-    # wire/wire_combine/chunks/quant ride the key so a latency measured
-    # with payload compression, a chunked pipeline, or a quantized
-    # expert store on is never applied to a run without it (and vice
-    # versa) — tuning.measured_path_latencies matches them STRICTLY,
-    # with "off" / 1 as the implicit defaults for legacy entries
+def _shape_key(cfg: MoEConfig, d: int, spec: str = "off") -> dict:
+    # wire/wire_combine/chunks/quant/spec ride the key so a latency
+    # measured with payload compression, a chunked pipeline, a
+    # quantized expert store, or a speculative verify span on is never
+    # applied to a run without it (and vice versa) —
+    # tuning.measured_path_latencies matches them STRICTLY, with
+    # "off" / 1 as the implicit defaults for legacy entries.  spec is
+    # "v<k>" when the decode step scores a verify_tokens=k span
     from flashmoe_tpu.ops import wire as wr
     from flashmoe_tpu.quant import core as qcore
 
@@ -121,10 +123,21 @@ def _shape_key(cfg: MoEConfig, d: int) -> dict:
                 wire_combine=wr.canonical_name(cfg.wire_dtype_combine),
                 wire_dcn=wr.canonical_name(cfg.wire_dtype_dcn),
                 chunks=cfg.a2a_chunks or 1,
-                quant=qcore.canonical_name(cfg.expert_quant))
+                quant=qcore.canonical_name(cfg.expert_quant),
+                spec=spec)
 
 
-def _bench_record_latencies(cfg: MoEConfig, d: int) -> dict:
+def spec_tag(verify_tokens: int | None) -> str:
+    """The measurement-identity tag of a speculative verify span:
+    ``"off"`` for the plain one-token step, ``"v<k>"`` for a
+    ``verify_tokens=k`` span (rides tuning/bench/select shape keys
+    like ``wire`` / ``chunks``)."""
+    k = int(verify_tokens or 0)
+    return f"v{k}" if k else "off"
+
+
+def _bench_record_latencies(cfg: MoEConfig, d: int,
+                            spec: str = "off") -> dict:
     """Measured path latencies mined from a bench.py JSONL records file
     (``FLASHMOE_BENCH_RECORDS``).  A record matches when its metric
     string carries this exact shape signature (dtype included) AND its
@@ -180,6 +193,11 @@ def _bench_record_latencies(cfg: MoEConfig, d: int) -> dict:
                 # without the field are legacy = off)
                 if str(rec.get("expert_quant", "off")) != quant_sig:
                     continue
+                # speculative-span identity: a verify-span timing
+                # (spec="v<k>") never overrides a plain one-token
+                # decode selection, and vice versa
+                if str(rec.get("spec", "off")) != spec:
+                    continue
                 keep(rec.get("path"), rec.get("value"))
                 keep("xla", rec.get("xla_path_ms"))
     except OSError:
@@ -212,6 +230,7 @@ def select_path(cfg: MoEConfig, d: int = 1, gen: str | None = None, *,
                 sweep_chunks: bool = False,
                 mode: str = "training",
                 decode_tokens: int | None = None,
+                verify_tokens: int | None = None,
                 dp: int = 1, dp_over_dcn: bool = False) -> Selection:
     """Pick the execution path for (cfg, d ranks, gen).
 
@@ -235,6 +254,10 @@ def select_path(cfg: MoEConfig, d: int = 1, gen: str | None = None, *,
     keys, predictions, the decision record) sees the decode-shaped
     problem; a decode measurement therefore keys at decode token
     counts and can never override a training-shape selection.
+    ``verify_tokens`` additionally prices a speculative verify span
+    (``decode_tokens x (k+1)`` rows) and stamps the ``spec="v<k>"``
+    measurement-identity tag on every shape key, so a verify-span
+    timing never crosses with a plain one-token decode timing.
 
     ``dp`` / ``dp_over_dcn``: price the DP gradient allreduce into
     every prediction (``planner.model.dp_allreduce_ms``) — constant
@@ -247,8 +270,12 @@ def select_path(cfg: MoEConfig, d: int = 1, gen: str | None = None, *,
     if mode not in ("training", "prefill", "decode"):
         raise ValueError(
             f"mode {mode!r} not in ('training', 'prefill', 'decode')")
+    if verify_tokens and mode != "decode":
+        raise ValueError("verify_tokens prices the speculative verify "
+                         "span — decode mode only")
+    spec = spec_tag(verify_tokens)
     if mode == "decode":
-        cfg = decode_shape(cfg, d, decode_tokens)
+        cfg = decode_shape(cfg, d, decode_tokens, verify_tokens)
     elif mode == "prefill" and cfg.is_training:
         cfg = cfg.replace(is_training=False)
 
@@ -273,8 +300,8 @@ def select_path(cfg: MoEConfig, d: int = 1, gen: str | None = None, *,
         pw = min(feasible, key=lambda p: p.total_ms)
         meas: dict[str, float] = {}
         meas.update(tuning.measured_path_latencies(
-            gen, **_shape_key(cfg_n, d)))
-        meas.update(_bench_record_latencies(cfg_n, d))
+            gen, **_shape_key(cfg_n, d, spec)))
+        meas.update(_bench_record_latencies(cfg_n, d, spec))
         if measured:
             meas.update(measured)
         runnable = {p.family for p in feasible}
@@ -327,7 +354,7 @@ def select_path(cfg: MoEConfig, d: int = 1, gen: str | None = None, *,
             gen=gen, d=d, slices=slices,
             a2a_chunks=sel.a2a_chunks,
             chunk_sweep=[list(t) for t in chunk_sweep],
-            config=_shape_key(cfg, d),
+            config=_shape_key(cfg, d, spec),
             breakdown=[{
                 "path": p.path, "feasible": p.feasible,
                 "compute_ms": round(p.compute_ms, 4),

@@ -111,6 +111,18 @@ class ControllerConfig:
     replica_queue_high: float = 4.0   # mean per-replica depth above
     replica_queue_low: float = 0.5    # ... and below => drain one
     replica_morph_budget: int = 2
+    # --- spec-morph trigger (ISSUE 20: speculative decoding) ---
+    # armed only when a serving loop feeds observe_spec(); the
+    # controller switches speculation OFF when the observed draft
+    # acceptance runs below the planner's break-even acceptance for
+    # the debounce window (below break-even the verify span prices
+    # under 1x tokens/step — pure overhead).  Exact rejection sampling
+    # makes the morph free: token streams are unchanged either way
+    enable_spec_morph: bool = False
+    # acceptance floor; None defers to the planner break-even passed
+    # to observe_spec(break_even=) by the serving loop
+    spec_accept_floor: float | None = None
+    spec_morph_budget: int = 1
     # --- dynamics ---
     debounce_steps: int = 3        # consecutive triggering observations
     cooldown_steps: int = 8        # no action for N steps after one
@@ -141,6 +153,9 @@ class ControllerConfig:
             raise ValueError(
                 "replica_queue_low must be < replica_queue_high (the "
                 "hysteresis band keeps drain/undrain from oscillating)")
+        if (self.spec_accept_floor is not None
+                and not 0 < self.spec_accept_floor < 1):
+            raise ValueError("spec_accept_floor must be in (0, 1)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -229,6 +244,19 @@ class ReplaceAction:
     @property
     def needs_rebuild(self) -> bool:
         return bool(self.overrides)
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecMorphAction:
+    """Speculation morph: switch draft-then-verify decoding ``off``
+    across the fleet.  The fabric executes the verdict through each
+    engine's :meth:`~flashmoe_tpu.serving.engine.ServingEngine.
+    set_speculate`; exact rejection sampling makes the switch cost
+    zero tokens — only the tokens-per-step multiplier changes."""
+
+    kind: str                      # 'off'
+    trigger: str
+    reason: str
 
 
 @dataclasses.dataclass(frozen=True)
@@ -406,12 +434,19 @@ class RuntimeController:
         self._fab_n = 0
         self._fab_hi_run = 0
         self._fab_lo_run = 0
+        # speculative-decode acceptance signal (ISSUE 20): fed by
+        # observe_spec(), never by the training loops
+        self.spec_accept_ema: float | None = None
+        self._last_spec_accept: float | None = None
+        self._spec_floor: float | None = None
+        self._spec_lo_run = 0
         # --- persistent (manifest-riding) state ---
         self.overrides: dict = {}
         self.morphs_used = 0
         self.replaces_used = 0
         self.wire_morphs_used = 0
         self.replica_morphs_used = 0
+        self.spec_morphs_used = 0
         self.cooldown_until = -1
         self.timeline: list[dict] = []
         self._cooldown_logged: set = set()
@@ -599,6 +634,71 @@ class RuntimeController:
             reason=reason)
         return ReplicaMorphAction(kind, int(target), trig, reason)
 
+    def observe_spec(self, step: int, accept_rate, *,
+                     break_even=None) -> None:
+        """Fold one serving observation of the fleet draft-acceptance
+        rate into the spec-morph trigger state.  ``accept_rate`` None
+        (nothing drafted yet) leaves the state untouched —
+        no-draft steps must not debounce toward a morph.
+        ``break_even`` is the planner's break-even acceptance
+        (:func:`~flashmoe_tpu.planner.model.speculate_break_even`); an
+        explicit ``ControllerConfig.spec_accept_floor`` overrides it.
+        Like every trigger, the debounce counter runs on the
+        INSTANTANEOUS observation; the EMA rides the decision record."""
+        if accept_rate is None:
+            return
+        ar = float(accept_rate)
+        self.spec_accept_ema = self._ema(self.spec_accept_ema, ar)
+        self._last_spec_accept = ar
+        c = self.ccfg
+        floor = c.spec_accept_floor
+        if floor is None and break_even is not None:
+            floor = float(break_even)
+        self._spec_floor = floor
+        if floor is not None and ar < floor:
+            self._spec_lo_run += 1
+        else:
+            self._spec_lo_run = 0
+
+    def maybe_morph_spec(self, step: int, *, spec_on: bool = True):
+        """The serving loop's step-boundary decision: returns a
+        :class:`SpecMorphAction` (switch speculation off) or None.
+        Same debounce / cooldown window / budget / decision-record
+        discipline as every other morph; ``spec_on`` False (already
+        morphed, or never armed) is always a None."""
+        step = int(step)
+        c = self.ccfg
+        if not c.enable_spec_morph or not spec_on:
+            return None
+        if self._spec_lo_run < c.debounce_steps:
+            return None
+        if step < self.cooldown_until:
+            key = ("spec", self.cooldown_until)
+            if key not in self._cooldown_logged:
+                self._cooldown_logged.add(key)
+                self._decide("controller.cooldown", step=step,
+                             trigger="spec",
+                             until=self.cooldown_until)
+            return None
+        if self.spec_morphs_used >= c.spec_morph_budget:
+            return None
+        reason = (f"sustained low draft acceptance "
+                  f"({self._last_spec_accept:.3f} < break-even "
+                  f"{self._spec_floor:.3f}): the verify span prices "
+                  f"below 1x tokens/step — switch speculation off")
+        self.spec_morphs_used += 1
+        self._cooldown(step)
+        self._decide(
+            "controller.spec_morph", step=step, trigger="accept_low",
+            kind="off",
+            accept_ema=(round(self.spec_accept_ema, 4)
+                        if self.spec_accept_ema is not None else None),
+            break_even=(round(self._spec_floor, 4)
+                        if self._spec_floor is not None else None),
+            budget_left=c.spec_morph_budget - self.spec_morphs_used,
+            reason=reason)
+        return SpecMorphAction("off", "accept_low", reason)
+
     def device_load_share(self, device: int) -> float:
         """Observed load share of one device's slot block under the
         CURRENT physical layout (slot s lives on device s // nLx) —
@@ -679,6 +779,7 @@ class RuntimeController:
         self._a2a_run = 0
         self._fab_hi_run = 0
         self._fab_lo_run = 0
+        self._spec_lo_run = 0
         # a fresh baseline: the action changed what "normal" looks like
         self._baseline_seen = []
         self.baseline_ms = None
@@ -870,13 +971,16 @@ class RuntimeController:
                 "wire_morph": c.wire_morph_budget - self.wire_morphs_used,
                 "replica_morph": (c.replica_morph_budget
                                   - self.replica_morphs_used),
+                "spec_morph": (c.spec_morph_budget
+                               - self.spec_morphs_used),
             },
             "cooldown_until": self.cooldown_until,
             "trigger_runs": {"skew": self._skew_run,
                              "slow": self._slow_run,
                              "a2a": self._a2a_run,
                              "replica_hi": self._fab_hi_run,
-                             "replica_lo": self._fab_lo_run},
+                             "replica_lo": self._fab_lo_run,
+                             "spec_lo": self._spec_lo_run},
             "overrides": {k: (list(map(list, v))
                               if k == "expert_replicas" else v)
                           for k, v in self.overrides.items()},
@@ -898,6 +1002,7 @@ class RuntimeController:
                 "replaces_used": self.replaces_used,
                 "wire_morphs_used": self.wire_morphs_used,
                 "replica_morphs_used": self.replica_morphs_used,
+                "spec_morphs_used": self.spec_morphs_used,
                 "timeline": list(self.timeline)}
 
     def load_state_dict(self, sd: dict) -> None:
@@ -919,6 +1024,9 @@ class RuntimeController:
         self.replica_morphs_used = max(
             self.replica_morphs_used,
             int(sd.get("replica_morphs_used", 0)))
+        self.spec_morphs_used = max(
+            self.spec_morphs_used,
+            int(sd.get("spec_morphs_used", 0)))
         stored = list(sd.get("timeline") or [])
         if len(stored) > len(self.timeline):
             self.timeline = stored

@@ -55,14 +55,17 @@ import numpy as np
 
 from flashmoe_tpu.config import MoEConfig
 from flashmoe_tpu.models.generate import (
-    init_cache, lm_logits, prefill_forward,
+    init_cache, lm_logits, lm_logits_span, prefill_forward,
 )
 from flashmoe_tpu.models.transformer import rms_norm, _rope
 from flashmoe_tpu.ops.moe import moe_layer
 from flashmoe_tpu.serving.kvcache import (
     SCRATCH_PAGE, PagePool, ShardedPagePool, ctx_pages_bucket,
     gather_ctx, init_paged_cache, prompt_pad, store_prefill,
-    store_token,
+    store_token, store_tokens,
+)
+from flashmoe_tpu.serving.speculate import (
+    DraftState, SpecConfig, spec_stats_fields,
 )
 from flashmoe_tpu.utils.telemetry import metrics as _global_metrics
 from flashmoe_tpu.utils.telemetry import trace_span
@@ -110,7 +113,17 @@ class ServeConfig:
     prompt cannot hole a decode step.  ``ep_shards`` > 1 runs the
     decode step EP-sharded under ``shard_map`` on an ``("ep",)`` mesh
     with the paged KV slab partitioned alongside the experts (the
-    fabric's decode-pool execution path)."""
+    fabric's decode-pool execution path).
+
+    ``speculate`` (a :class:`~flashmoe_tpu.serving.speculate.
+    SpecConfig`, None = off) arms speculative multi-token decoding
+    (ISSUE 20): each step drafts up to ``draft_tokens`` continuation
+    tokens per slot and verifies them in ONE ``k+1``-position paged
+    forward — output tokens stay bit-equal to non-speculative decode
+    (only canonical samples are ever emitted), and because the config
+    rides ``ServeConfig`` it reaches every fabric replica, so
+    speculation survives pool handoff and replica migration for
+    free."""
 
     max_batch: int = 8
     page_size: int = 8
@@ -122,8 +135,14 @@ class ServeConfig:
     max_steps: int = 10_000
     prefill_chunk: int | None = None
     ep_shards: int = 1
+    speculate: SpecConfig | None = None
 
     def __post_init__(self):
+        if self.speculate is not None \
+                and not isinstance(self.speculate, SpecConfig):
+            raise ValueError(
+                f"speculate must be a SpecConfig or None, got "
+                f"{type(self.speculate).__name__}")
         if self.max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if self.page_size < 1:
@@ -202,6 +221,12 @@ class _Slot:
     prefill_pos: int | None = None  # next chunk start (chunked prefill
                                     # in flight); None = decoding
     prefill_toks: object = None     # padded np prompt for the chunks
+    draft: object = None            # DraftState (speculative decode):
+                                    # the slot's suffix-match table,
+                                    # rebuilt from prompt+emitted so it
+                                    # survives eviction and migration
+    spec_drafted: int = 0           # drafts proposed this incarnation
+    spec_accepted: int = 0          # ... and accepted (= canonical)
 
 
 # ----------------------------------------------------------------------
@@ -356,6 +381,90 @@ def _paged_decode_step(params, cfg: MoEConfig, k_pages, v_pages, toks,
     return lm_logits(params, cfg, x), k_pages, v_pages
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _paged_verify_step(params, cfg: MoEConfig, k_pages, v_pages, toks,
+                       block_tables, positions):
+    """Speculative verify: score a ``T = draft_tokens + 1`` position
+    SPAN per slot in one forward (ISSUE 20).
+
+    toks: [B, T] int32 — column 0 is the canonical last-sampled token,
+    columns 1..k the drafted continuation (pad past the real drafts);
+    positions: [B] base write positions (column t lands at
+    ``positions + t``).  Returns (logits [B, T, V] f32, k_pages,
+    v_pages): logits[:, t] is the next-token distribution after feeding
+    column t — column 0 is bit-equal to what :func:`_paged_decode_step`
+    returns for the same token, columns 1..k are what it WOULD return
+    after each draft, all for one weight pass (the planner's decode
+    mode prices the step as wire/HBM-bound, so the extra columns ride
+    nearly free).
+
+    Span positions past the gathered context (a slot drafted into its
+    context ceiling) route their KV writes to the scratch page and
+    produce garbage columns the host never reads — the host truncates
+    drafts to fit, this is the in-graph belt-and-suspenders.  Rejected
+    columns DO write rows: the host rolls back the block-table/length
+    state, and the next step's span overwrites those exact rows before
+    any causal mask exposes them (the prefill pad-row invariant)."""
+    b, t_span = toks.shape
+    nh, nkv, dh = (cfg.num_heads, cfg.resolved_num_kv_heads,
+                   cfg.resolved_head_dim)
+    page = k_pages.shape[3]
+    ntab = block_tables.shape[1]
+    n_ctx = ntab * page
+    x = params["embed"].astype(cfg.dtype)[toks]              # [B, T, H]
+    pos = (positions[:, None]
+           + jnp.arange(t_span, dtype=jnp.int32)[None, :])   # [B, T]
+    valid = pos < n_ctx
+    pidx = jnp.clip(pos // page, 0, ntab - 1)
+    page_ids = jnp.where(
+        valid, jnp.take_along_axis(block_tables, pidx, axis=1),
+        jnp.int32(SCRATCH_PAGE))
+    rows = jnp.where(valid, pos % page, 0)
+    for li, layer in enumerate(params["layers"]):
+        h_in = rms_norm(x, layer["attn_norm"])
+        q = (h_in @ layer["wq"].astype(x.dtype)).reshape(b, t_span, nh,
+                                                         dh)
+        k = (h_in @ layer["wk"].astype(x.dtype)).reshape(b, t_span, nkv,
+                                                         dh)
+        v = (h_in @ layer["wv"].astype(x.dtype)).reshape(b, t_span, nkv,
+                                                         dh)
+        q, k = _rope(q, k, pos, cfg.rope_theta)
+
+        k_pages = k_pages.at[li].set(
+            store_tokens(k_pages[li], k, page_ids, rows))
+        v_pages = v_pages.at[li].set(
+            store_tokens(v_pages[li], v, page_ids, rows))
+
+        kk = gather_ctx(k_pages[li], block_tables)  # [B, nkv, ctx, D]
+        vv = gather_ctx(v_pages[li], block_tables)
+        if nkv != nh:
+            rep = nh // nkv
+            kk = jnp.repeat(kk, rep, axis=1)
+            vv = jnp.repeat(vv, rep, axis=1)
+        qh = q.transpose(0, 2, 1, 3)                # [B, N, T, D]
+        logits = jnp.einsum(
+            "bntd,bnsd->bnts", qh, kk, preferred_element_type=jnp.float32
+        ) * (dh ** -0.5)
+        mask = (jnp.arange(n_ctx)[None, None, None, :]
+                <= pos[:, None, :, None])
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        ctx = jnp.einsum(
+            "bnts,bnsd->bntd", probs, vv, preferred_element_type=jnp.float32
+        ).transpose(0, 2, 1, 3).reshape(b, t_span, nh * dh).astype(
+            x.dtype)
+        x = x + ctx @ layer["wo"].astype(x.dtype)
+
+        f_in = rms_norm(x, layer["ffn_norm"])
+        layer_cfg = cfg if li in cfg.moe_layer_indices else cfg.replace(
+            num_experts=1, expert_top_k=1, num_shared_experts=0)
+        o = moe_layer(layer["moe"], f_in.reshape(b * t_span, -1),
+                      layer_cfg, use_pallas=False)
+        x = x + o.out.reshape(b, t_span, -1).astype(x.dtype)
+
+    return lm_logits_span(params, cfg, x), k_pages, v_pages
+
+
 # ----------------------------------------------------------------------
 # EP-sharded decode (the fabric's decode-pool execution path)
 # ----------------------------------------------------------------------
@@ -478,6 +587,106 @@ def _ep_decode_fn(mesh, cfg: MoEConfig, params):
         out_specs=(P("ep"), P(None, "ep"), P(None, "ep")),
         check_vma=False))
     _EP_DECODE_CACHE[key] = fn
+    return fn
+
+
+_EP_VERIFY_CACHE: dict = {}
+
+
+def _ep_verify_fn(mesh, cfg: MoEConfig, params):
+    """The EP-sharded twin of :func:`_paged_verify_step`: the same
+    span-scoring body over the LOCAL slot rows and cache slab, MoE
+    through the decode-priced ragged EP path on ``b_local * T`` rows.
+    Cached like :func:`_ep_decode_fn`."""
+    import jax.tree_util as jtu
+    from jax.sharding import PartitionSpec as P
+
+    from flashmoe_tpu.utils.compat import shard_map
+
+    key = (mesh, cfg, jtu.tree_structure(params))
+    cached = _EP_VERIFY_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    from flashmoe_tpu.parallel import ragged_ep
+
+    pspecs = _ep_param_specs(params, cfg)
+    exchange = "ragged" if jax.default_backend() == "tpu" else "dense"
+
+    def body(params, k_pages, v_pages, toks, block_tables, positions):
+        b, t_span = toks.shape
+        nh, nkv, dh = (cfg.num_heads, cfg.resolved_num_kv_heads,
+                       cfg.resolved_head_dim)
+        page = k_pages.shape[3]
+        ntab = block_tables.shape[1]
+        n_ctx = ntab * page
+        x = params["embed"].astype(cfg.dtype)[toks]
+        pos = (positions[:, None]
+               + jnp.arange(t_span, dtype=jnp.int32)[None, :])
+        valid = pos < n_ctx
+        pidx = jnp.clip(pos // page, 0, ntab - 1)
+        page_ids = jnp.where(
+            valid, jnp.take_along_axis(block_tables, pidx, axis=1),
+            jnp.int32(SCRATCH_PAGE))
+        rows = jnp.where(valid, pos % page, 0)
+        for li, layer in enumerate(params["layers"]):
+            h_in = rms_norm(x, layer["attn_norm"])
+            q = (h_in @ layer["wq"].astype(x.dtype)).reshape(
+                b, t_span, nh, dh)
+            k = (h_in @ layer["wk"].astype(x.dtype)).reshape(
+                b, t_span, nkv, dh)
+            v = (h_in @ layer["wv"].astype(x.dtype)).reshape(
+                b, t_span, nkv, dh)
+            q, k = _rope(q, k, pos, cfg.rope_theta)
+
+            k_pages = k_pages.at[li].set(
+                store_tokens(k_pages[li], k, page_ids, rows))
+            v_pages = v_pages.at[li].set(
+                store_tokens(v_pages[li], v, page_ids, rows))
+
+            kk = gather_ctx(k_pages[li], block_tables)
+            vv = gather_ctx(v_pages[li], block_tables)
+            if nkv != nh:
+                rep = nh // nkv
+                kk = jnp.repeat(kk, rep, axis=1)
+                vv = jnp.repeat(vv, rep, axis=1)
+            qh = q.transpose(0, 2, 1, 3)
+            logits = jnp.einsum(
+                "bntd,bnsd->bnts", qh, kk,
+                preferred_element_type=jnp.float32) * (dh ** -0.5)
+            mask = (jnp.arange(n_ctx)[None, None, None, :]
+                    <= pos[:, None, :, None])
+            logits = jnp.where(mask, logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+            ctx = jnp.einsum(
+                "bnts,bnsd->bntd", probs, vv,
+                preferred_element_type=jnp.float32
+            ).transpose(0, 2, 1, 3).reshape(b, t_span, nh * dh).astype(
+                x.dtype)
+            x = x + ctx @ layer["wo"].astype(x.dtype)
+
+            f_in = rms_norm(x, layer["ffn_norm"])
+            if li in cfg.moe_layer_indices:
+                o_out = ragged_ep.decode_moe_rows(
+                    layer["moe"], f_in.reshape(b * t_span, -1), cfg,
+                    axis="ep", exchange=exchange).out
+            else:
+                dense_cfg = cfg.replace(num_experts=1, expert_top_k=1,
+                                        num_shared_experts=0)
+                o_out = moe_layer(layer["moe"],
+                                  f_in.reshape(b * t_span, -1),
+                                  dense_cfg, use_pallas=False).out
+            x = x + o_out.reshape(b, t_span, -1).astype(x.dtype)
+
+        return lm_logits_span(params, cfg, x), k_pages, v_pages
+
+    fn = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(pspecs, P(None, "ep"), P(None, "ep"), P("ep", None),
+                  P("ep", None), P("ep")),
+        out_specs=(P("ep"), P(None, "ep"), P(None, "ep")),
+        check_vma=False))
+    _EP_VERIFY_CACHE[key] = fn
     return fn
 
 
@@ -651,6 +860,20 @@ class ServingEngine:
                     f"{d}, got mesh axes {dict(self.mesh.shape)}")
             self._ep_fn = _ep_decode_fn(self.mesh, cfg, params)
 
+        # ---- speculative decoding (serving/speculate.py) -------------
+        # off (None) keeps the engine byte-identical: no draft tables,
+        # no verify jit, the plain one-token decode step below
+        self._spec = self.serve.speculate
+        self._ep_verify = None   # lazily built EP verify twin
+        self._spec_steps = 0     # steps that ran a verify forward
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        if self._spec is not None:
+            self.metrics.decision(
+                "serve.spec", event="armed",
+                draft_tokens=self._spec.draft_tokens,
+                ngram=self._spec.ngram, source=self._spec.source)
+
         self.cache = init_paged_cache(cfg, self.serve.num_pages,
                                       self.serve.page_size)
         self.pool = (ShardedPagePool(self.serve.num_pages, d) if d > 1
@@ -724,6 +947,8 @@ class ServingEngine:
         }
         if self.replica_tag is not None:
             doc["replica"] = self.replica_tag
+        if self.serve.speculate is not None:
+            doc["spec"] = self.spec_snapshot()
         if self.watchdog is not None:
             doc["slo"] = self.watchdog.snapshot()
         return doc
@@ -1091,17 +1316,22 @@ class ServingEngine:
         resumed prompt carries its earlier output)."""
         return len(s.req.prompt) - len(s.orig.prompt) + len(s.emitted)
 
-    def _grow_pages(self) -> None:
+    def _grow_pages(self, span: int = 0) -> None:
         """Allocate the next page for every active slot whose write
         position crosses its allocated frontier, evicting the youngest
-        request when the pool runs dry."""
+        request when the pool runs dry.  ``span`` extra positions (the
+        verify step's drafted span) are pre-covered; the target index
+        clamps to the slot's table width — the host truncates drafts to
+        fit the context ceiling, and the verify graph routes any
+        residual over-the-edge write to the scratch page."""
         shard = (self._shard_of if self.serve.ep_shards > 1
                  else lambda i: None)
         for i in list(self._decoding()):
             s = self.slots[i]
             if s is None:
                 continue
-            need_idx = s.length // self.serve.page_size
+            need_idx = min((s.length + span) // self.serve.page_size,
+                           self.serve.max_pages_per_slot - 1)
             while need_idx >= len(s.pages):
                 got = self._alloc_pages(i, 1)
                 if got is not None:
@@ -1112,6 +1342,191 @@ class ServingEngine:
                                        "evictable request")
                 if self.slots[i] is None:   # we evicted ourselves
                     break
+
+    def _spec_decode(self, active) -> int | None:
+        """Speculative decode step: draft, verify the span in one
+        forward, emit the drafted prefix the engine's own sampler
+        agrees with (ISSUE 20).
+
+        Exactness: the sampler keys every token on
+        ``fold_in(PRNGKey(seed), token_index)`` — a TOKEN POSITION, not
+        a step — so the canonical sample for drafted position ``t`` is
+        computable from the verify span's column ``t-1`` logits with
+        that position's own key and the shared
+        :func:`_sample_dynamic` numerics.  A draft is emitted iff it
+        EQUALS its canonical sample; the emitted stream is therefore
+        bit-equal to non-speculative decode for every temperature /
+        top-k / top-p arm, and the next step's sample pass (from the
+        pending logits column this method selects) produces exactly the
+        token a rejected draft was compared against.
+
+        Returns the number of EXTRA tokens emitted (accepted drafts;
+        the canonical token was already emitted by the sample pass), or
+        ``None`` when no slot drafted anything — the caller then runs
+        the plain one-token decode step."""
+        sv = self.serve
+        spec = self._spec
+        k = spec.draft_tokens
+        # ---- draft (host-only: per-slot suffix-match tables) ---------
+        drafts: dict[int, list] = {}
+        with trace_span("serve.draft"):
+            for i in active:
+                s = self.slots[i]
+                hist = list(s.req.prompt) + s.emitted
+                if s.draft is None:
+                    # deterministic rebuild from prompt + emitted: the
+                    # same history the eviction / migration resume
+                    # carries, so speculation survives both for free
+                    s.draft = DraftState(spec, hist)
+                else:
+                    s.draft.sync(hist)
+                dr = s.draft.draft(k)
+                # truncate to the remaining token budget and the
+                # context ceiling: every ACCEPTED draft's KV row must
+                # land in a real page
+                dr = dr[:max(0, s.orig.max_new_tokens
+                             - self._delivered(s))]
+                dr = dr[:max(0, sv.max_context - 1 - s.length)]
+                if dr:
+                    drafts[i] = [int(t) for t in dr]
+        if not drafts:
+            return None
+
+        # pre-cover the span's write positions (may evict — re-fetch)
+        self._grow_pages(span=k)
+        active = self._decoding()
+        if not active:
+            return 0
+
+        # ---- verify: score k+1 positions per slot in one forward ----
+        t_span = k + 1
+        feed = np.full((sv.max_batch, t_span), sv.pad_token, np.int32)
+        positions = np.zeros((sv.max_batch,), np.int32)
+        tables = np.full((sv.max_batch, sv.max_pages_per_slot),
+                         SCRATCH_PAGE, np.int32)
+        temps = np.zeros((sv.max_batch, k), np.float32)
+        tks = np.zeros((sv.max_batch, k), np.int32)
+        tps = np.ones((sv.max_batch, k), np.float32)
+        keys = np.zeros((sv.max_batch, k, 2), np.uint32)
+        longest = 1
+        for i in active:
+            s = self.slots[i]
+            feed[i, 0] = s.emitted[-1]
+            dr = drafts.get(i, ())
+            feed[i, 1:1 + len(dr)] = dr
+            positions[i] = s.length
+            tables[i, :len(s.pages)] = s.pages
+            longest = max(longest, s.length + t_span)
+            r = s.req
+            temps[i] = r.temperature
+            tks[i] = r.top_k
+            tps[i] = r.top_p
+            base = self._delivered(s)   # emitted already holds tok_0
+            root = jax.random.PRNGKey(r.seed)
+            for t in range(k):
+                keys[i, t] = np.asarray(
+                    jax.random.fold_in(root, base + t))
+        n_ctx = ctx_pages_bucket(longest, sv.page_size,
+                                 sv.ctx_bucket_pages,
+                                 sv.max_pages_per_slot)
+        self.stats["decode_buckets"].add(n_ctx)
+        with trace_span("serve.verify"):
+            if self._ep_fn is not None:
+                if self._ep_verify is None:
+                    self._ep_verify = _ep_verify_fn(
+                        self.mesh, self.cfg, self.params)
+                span_logits, kp, vp = self._ep_verify(
+                    self.params, self.cache.k_pages,
+                    self.cache.v_pages, jnp.asarray(feed),
+                    jnp.asarray(tables[:, :n_ctx]),
+                    jnp.asarray(positions))
+            else:
+                span_logits, kp, vp = _paged_verify_step(
+                    self.params, self.cfg, self.cache.k_pages,
+                    self.cache.v_pages, jnp.asarray(feed),
+                    jnp.asarray(tables[:, :n_ctx]),
+                    jnp.asarray(positions))
+        self.cache = self.cache._replace(k_pages=kp, v_pages=vp)
+        self._spec_steps += 1
+
+        # canonical samples for every drafted position: column t-1
+        # logits, position-(base+t-1) key, the same sampler numerics
+        cand = np.asarray(_sample_dynamic(
+            span_logits[:, :k, :].reshape(sv.max_batch * k, -1),
+            jnp.asarray(keys.reshape(sv.max_batch * k, 2)),
+            jnp.asarray(temps.reshape(-1)),
+            jnp.asarray(tks.reshape(-1)),
+            jnp.asarray(tps.reshape(-1)))).reshape(sv.max_batch, k)
+
+        # ---- accept the agreeing prefix; roll back the rest ----------
+        n_extra = 0
+        accepted_cols = np.zeros((sv.max_batch,), np.int32)
+        for i in active:
+            s = self.slots[i]
+            dr = drafts.get(i, [])
+            self._spec_drafted += len(dr)
+            s.spec_drafted += len(dr)
+            a = 0
+            done = False
+            for t in range(len(dr)):
+                if int(cand[i, t]) != dr[t]:
+                    break
+                tok = dr[t]
+                s.emitted.append(tok)
+                a += 1
+                n_extra += 1
+                done = (tok in s.req.stop_tokens
+                        or self._delivered(s) >= s.orig.max_new_tokens)
+                if done:
+                    break
+            self._spec_accepted += a
+            s.spec_accepted += a
+            accepted_cols[i] = a
+            s.length += 1 + a
+            # roll back the block table past the accepted frontier:
+            # rejected-draft rows free their surplus pages (LIFO, so
+            # the next growth re-draws the same ids) and the rows
+            # inside kept pages are overwritten by the next span
+            # before any causal mask exposes them
+            keep = (s.length - 1) // sv.page_size + 1
+            if keep < len(s.pages):
+                surplus = s.pages[keep:]
+                del s.pages[keep:]
+                self._free_slot_pages(i, surplus)
+            if done:
+                self._retire(i, s)
+        # pending logits = the column after each slot's last emitted
+        # token — exactly what the plain decode step would have
+        # returned after feeding that token
+        self._logits = span_logits[
+            jnp.arange(sv.max_batch), jnp.asarray(accepted_cols)]
+        return n_extra
+
+    def set_speculate(self, enabled: bool, *, reason=None) -> None:
+        """Morph speculation on/off at a step boundary (the runtime
+        controller's actuator).  Off tears down nothing the sampler
+        sees: draft tables idle on the slots, the next step simply runs
+        the plain decode path — token streams are unchanged by
+        construction, so morphing mid-request loses zero tokens."""
+        if enabled and self.serve.speculate is None:
+            raise ValueError(
+                "cannot enable speculation: ServeConfig.speculate was "
+                "never configured on this engine")
+        was = self._spec is not None
+        self._spec = self.serve.speculate if enabled else None
+        if (self._spec is not None) != was:
+            self.metrics.decision(
+                "serve.spec",
+                event="morph_on" if enabled else "morph_off",
+                step=self.step_idx, reason=reason)
+
+    def spec_snapshot(self) -> dict:
+        """Live acceptance stats (the controller's observation feed)."""
+        return dict(
+            spec_stats_fields(self._spec_drafted, self._spec_accepted,
+                              self._spec_steps),
+            spec_steps=self._spec_steps,
+            spec_on=self._spec is not None)
 
     def _retire(self, slot: int, s: _Slot) -> None:
         now = self._clock()
@@ -1147,16 +1562,25 @@ class ServingEngine:
             self.tracer.on_retire(s.orig.rid, self.step_idx,
                                   tokens=n_tok, ttft_ms=ttft_ms,
                                   tpot_ms=tpot_ms)
+        spec_kw = {}
+        if self.serve.speculate is not None:
+            spec_kw = {
+                "spec_drafted": s.spec_drafted,
+                "spec_accepted": s.spec_accepted,
+                "accept_rate": (round(s.spec_accepted / s.spec_drafted,
+                                      6) if s.spec_drafted else None),
+            }
         self.metrics.decision(
             "serve.retire", rid=s.orig.rid, step=self.step_idx,
             slot=slot, tokens=n_tok,
             ttft_ms=round(ttft_ms, 3) if ttft_ms is not None else None,
-            tpot_ms=round(tpot_ms, 3) if tpot_ms is not None else None)
+            tpot_ms=round(tpot_ms, 3) if tpot_ms is not None else None,
+            **spec_kw)
         if self.recorder is not None:
             self.recorder.record(
                 kind="serve_request", step=self.step_idx,
                 rid=s.orig.rid, tokens=n_tok, ttft_ms=ttft_ms,
-                tpot_ms=tpot_ms)
+                tpot_ms=tpot_ms, **spec_kw)
         if self.watchdog is not None:
             dominant = None
             if self.tracer is not None:
@@ -1233,12 +1657,20 @@ class ServingEngine:
         if self._heartbeat is not None:
             self._heartbeat("sample")
 
-        # feed the survivors one decode step
+        # feed the survivors one decode step — speculative (draft +
+        # span verify, possibly emitting extra tokens) when armed and
+        # anything drafted, else the plain one-token step
         active = self._decoding()
         if active:
             self._grow_pages()
             active = self._decoding()
-        if active:
+        n_extra = None
+        if active and self._spec is not None:
+            n_extra = self._spec_decode(active)
+            if n_extra is not None:
+                emitted_now += n_extra
+                self.stats["tokens"] += n_extra
+        if active and n_extra is None:
             feed = np.full((sv.max_batch,), sv.pad_token, np.int32)
             positions = np.zeros((sv.max_batch,), np.int32)
             tables = np.full((sv.max_batch, sv.max_pages_per_slot),
@@ -1314,6 +1746,9 @@ class ServingEngine:
             "completed": self.stats["completed"],
             "step_ms": round(step_ms, 3),
         }
+        if self.serve.speculate is not None:
+            rec["spec_tokens"] = int(n_extra or 0)
+            rec["spec_on"] = self._spec is not None
         if self.recorder is not None:
             self.recorder.record(**rec)
         if self.watchdog is not None:
@@ -1360,6 +1795,8 @@ class ServingEngine:
         tp = self.metrics.sketches.get("serve.tpot_ms")
         if tp is not None and tp.n:
             s["tpot_ms_mean"] = round(tp.mean, 3)
+        if self.serve.speculate is not None:
+            s.update(self.spec_snapshot())
         s["decode_plan"] = list(self.decode_plan)
         s["prefill_plan"] = list(self.prefill_plan)
         if self.quant_info is not None:

@@ -91,6 +91,23 @@ def store_token(pages, token_kv, page_ids, rows):
     return pages.at[page_ids, :, rows, :].set(token_kv)
 
 
+def store_tokens(pages, span_kv, page_ids, rows):
+    """Scatter a verify step's drafted SPAN into its pages — the
+    multi-position twin of :func:`store_token` (ISSUE 20 speculative
+    decode).
+
+    pages: ``[P, N_kv, page, D]`` (one layer's pool); span_kv:
+    ``[B, T, N_kv, D]`` for a ``T = draft_tokens + 1`` wide span;
+    page_ids/rows: ``[B, T]`` int32.  The advanced indices at axes 0
+    and 2 are split by the head-axis slice, so numpy semantics front
+    the broadcast ``[B, T]`` dims — the result aligns with ``span_kv``
+    exactly.  Out-of-span and inactive positions pass ``SCRATCH_PAGE``;
+    rejected-draft rows land in pages the engine rolls back (or rows a
+    later step overwrites before any causal mask exposes them — the
+    same invariant prefill pad rows rely on)."""
+    return pages.at[page_ids, :, rows, :].set(span_kv)
+
+
 def gather_ctx(pages, block_tables):
     """Gather each slot's context window from its pages.
 

@@ -30,16 +30,28 @@ def tiny_config(*, hidden: int = 64, experts: int = 4, layers: int = 2,
 
 def build_requests(n: int, *, vocab: int, prompt_len: int,
                    max_new: int, seed: int, arrival_every: int,
-                   temperature: float = 0.0):
+                   temperature: float = 0.0,
+                   repetitive: bool = False):
     """The seeded trace: ``n`` requests with deterministic prompts and
     staggered arrivals (one PAIR of arrivals every ``arrival_every``
-    engine steps)."""
+    engine steps).  ``repetitive`` tiles each prompt from a per-request
+    random bigram motif instead of i.i.d. tokens — the speculative
+    sweep's trace, where the n-gram drafter has suffix matches to
+    propose from (an i.i.d. prompt never drafts, which would bench the
+    no-op path)."""
     import jax
 
     from flashmoe_tpu.serving.engine import Request
 
-    toks = jax.random.randint(
-        jax.random.PRNGKey(seed), (n, prompt_len), 0, vocab)
+    if repetitive:
+        motif = jax.random.randint(
+            jax.random.PRNGKey(seed), (n, 2), 0, vocab)
+        reps = -(-prompt_len // 2)
+        toks = [([int(t) for t in motif[i]] * reps)[:prompt_len]
+                for i in range(n)]
+    else:
+        toks = jax.random.randint(
+            jax.random.PRNGKey(seed), (n, prompt_len), 0, vocab)
     reqs = [Request(rid=i, prompt=tuple(int(t) for t in toks[i]),
                     max_new_tokens=max_new, temperature=temperature,
                     seed=seed + i)
@@ -63,7 +75,8 @@ def serve_load_sweep(loads, *, n_requests: int = 8, max_batch: int = 4,
                      prompt_len: int = 8, max_new: int = 6,
                      seed: int = 0, page_size: int = 8,
                      num_pages: int = 64,
-                     telemetry_port: int | None = None) -> list[dict]:
+                     telemetry_port: int | None = None,
+                     speculate: int | None = None) -> list[dict]:
     """One bench record per offered-load point (``loads``: arrival
     gaps in engine steps, descending = rising load).  ``vs_baseline``
     is each point's throughput relative to the LIGHTEST load measured
@@ -76,7 +89,18 @@ def serve_load_sweep(loads, *, n_requests: int = 8, max_batch: int = 4,
     ``/metrics`` self-scrape (``telemetry_scrape``: exposition size,
     whether the TTFT/TPOT summary quantiles were present and the text
     parsed) — the live plane drilled by the same contract tests as the
-    rest of the bench surface."""
+    rest of the bench surface.
+
+    ``speculate`` (``bench.py --serve --speculate``, ISSUE 20): arm
+    speculative decoding at ``draft_tokens=speculate`` over a
+    repetitive trace and run an EQUAL-SLO baseline per point — the
+    same requests at the same offered load with speculation off — so
+    each record carries its own TPOT comparison
+    (``baseline_tpot_ms_p50/p99``), the realized ``accept_rate`` /
+    ``spec_tokens_per_step``, and ``bit_equal_to_baseline`` (the
+    exactness guarantee, asserted per point, not trusted).  The metric
+    identity gains a ``spec=kN`` tag: a speculative run's numbers must
+    never baseline a plain run's in the sentry."""
     import time
 
     import jax
@@ -92,6 +116,13 @@ def serve_load_sweep(loads, *, n_requests: int = 8, max_batch: int = 4,
         max_pages_per_slot=max(
             2, -(-(prompt_len + max_new) // page_size) + 1),
         ctx_bucket_pages=1, prompt_bucket=page_size)
+    if speculate:
+        import dataclasses
+
+        from flashmoe_tpu.serving.speculate import SpecConfig
+
+        serve = dataclasses.replace(
+            serve, speculate=SpecConfig(draft_tokens=int(speculate)))
     holder = [Metrics()]
     server = None
     if telemetry_port is not None:
@@ -144,13 +175,39 @@ def _sweep_points(loads, params, cfg, serve, holder, server, *,
 
     records = []
     base_tps = None
+    spec = serve.speculate
     for every in loads:
         if every < 1:
             raise ValueError(f"offered-load gap {every} must be >= 1 "
                              f"engine step")
         reqs, arrivals = build_requests(
             n_requests, vocab=cfg.vocab_size, prompt_len=prompt_len,
-            max_new=max_new, seed=seed, arrival_every=int(every))
+            max_new=max_new, seed=seed, arrival_every=int(every),
+            repetitive=spec is not None)
+        spec_rec = None
+        if spec is not None:
+            # equal-SLO baseline: the SAME trace at the SAME offered
+            # load with speculation off — the comparison each record
+            # carries, and the oracle the exactness assert checks
+            # against
+            import dataclasses as _dc
+
+            bmx = Metrics()
+            b_eng = ServingEngine(
+                params, cfg, _dc.replace(serve, speculate=None),
+                metrics_obj=bmx)
+            b_eng.run(list(reqs), list(arrivals))
+            b_ret = [d for d in bmx.decisions
+                     if d.get("decision") == "serve.retire"]
+            spec_rec = {
+                "baseline_outputs": dict(b_eng.outputs),
+                "baseline_tpot_ms_p50": pctl(
+                    [d["tpot_ms"] for d in b_ret
+                     if d.get("tpot_ms") is not None], 0.5),
+                "baseline_tpot_ms_p99": pctl(
+                    [d["tpot_ms"] for d in b_ret
+                     if d.get("tpot_ms") is not None], 0.99),
+            }
         mx = Metrics()   # private stream per point: clean retire stats
         holder[0] = mx   # the live server scrapes THIS point now
         engine = ServingEngine(params, cfg, serve, metrics_obj=mx)
@@ -190,6 +247,8 @@ def _sweep_points(loads, params, cfg, serve, holder, server, *,
         # telemetry arming rides the measurement identity: an armed
         # run's numbers never baseline an unarmed run's in the sentry
         tag = ",telemetry" if server is not None else ""
+        if spec is not None:
+            tag += f",spec=k{spec.draft_tokens}"
         records.append({
             "metric": f"serve_load[every={every},B={max_batch},"
                       f"req={n_requests}{tag}]",
@@ -214,6 +273,24 @@ def _sweep_points(loads, params, cfg, serve, holder, server, *,
         if scrape_rec is not None:
             records[-1]["telemetry_scrape"] = scrape_rec
             records[-1]["telemetry_port"] = server.port
+        if spec_rec is not None:
+            snap = engine.spec_snapshot()
+            bit_equal = dict(engine.outputs) \
+                == spec_rec.pop("baseline_outputs")
+            records[-1].update(spec_rec)
+            records[-1].update({
+                "accept_rate": snap["accept_rate"],
+                "spec_tokens_per_step": snap["spec_tokens_per_step"],
+                "spec_drafted": snap["spec_drafted"],
+                "spec_accepted": snap["spec_accepted"],
+                "bit_equal_to_baseline": bit_equal,
+            })
+            if not bit_equal:
+                # exactness is the whole contract — a diverged stream
+                # is a broken run, not a data point
+                raise AssertionError(
+                    f"speculative decode diverged from baseline at "
+                    f"load point every={every}")
     return records
 
 

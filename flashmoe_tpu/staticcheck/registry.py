@@ -298,6 +298,27 @@ SERVING_KNOBS: tuple[KnobSpec, ...] = (
             "Off (None, the default) installs no heartbeat_fn: zero "
             "engine callbacks, no store file, byte-identical to the "
             "probe-only PR 18 path"),
+    KnobSpec(
+        "speculate", off_values=(None,),
+        on={"speculate": "SpecConfig(draft_tokens=3)"},
+        backends=(), changes_graph=False,
+        doc="speculative multi-token decoding (serving/speculate.py + "
+            "engine.py): ServeConfig(speculate=SpecConfig(...)) drafts "
+            "up to draft_tokens continuation tokens per slot from an "
+            "n-gram/prompt-lookup index over each request's history "
+            "and scores them in ONE k+1-position paged verify forward "
+            "(serve.draft / serve.verify spans).  Only CANONICAL "
+            "samples are emitted — each draft column is re-sampled "
+            "with the per-request fold_in key stream the plain decode "
+            "step would have used, so accepted prefixes are token-"
+            "bit-equal to non-speculative decode at every temperature/"
+            "top-k/top-p arm; KV pages for rejected suffixes roll "
+            "back before the causal mask ever exposes them.  Off "
+            "(None, the default) never builds the verify jit and "
+            "traces the byte-identical decode graph; on is priced by "
+            "the planner's verify_tokens axis and morphed off fleet-"
+            "wide by the controller under sustained low acceptance "
+            "(controller.spec_morph) with zero lost tokens"),
 )
 
 SERVING_KNOBS_BY_NAME = {k.name: k for k in SERVING_KNOBS}

@@ -44,6 +44,8 @@ UNIT_TOLERANCE = {
     "ratio_vs_serialized": 0.15,
     "hidden_frac": 0.15,
     "frac": 0.15,
+    "accept_rate": 0.15,
+    "tokens_per_step": 0.15,
 }
 DEFAULT_TOLERANCE = 0.25
 _DIR = {
@@ -52,6 +54,9 @@ _DIR = {
     "ratio_vs_serialized": -1.0,  # overlap efficiency: down is worse
     "hidden_frac": -1.0,          # handoff overlap: less hidden = worse
     "frac": +1.0,                 # shed fraction: more shedding = worse
+    "accept_rate": +1.0,          # break-even acceptance: up = speculation
+                                  # pays later = worse
+    "tokens_per_step": -1.0,      # speculation uplift: down is worse
 }
 
 
@@ -193,6 +198,32 @@ def reference_points(gen: str = "v5e") -> dict[str, dict]:
                f"wire=tcp]"] = {
             "value": round(wire_overhead_ms(payload_bytes, "tcp"), 4),
             "unit": "ms",
+        }
+        # speculative-decoding plane (ISSUE 20): the break-even
+        # acceptance of the golden verify depth (the floor the
+        # controller's spec-morph trigger defends) and the modeled
+        # tokens/step at the golden acceptance rate.  Pure cost-model
+        # arithmetic: a verify-span pricing drift moves the break-even,
+        # a draft-economics drift moves the uplift — either trips the
+        # sentry before any acceptance-rate drill measures it
+        from flashmoe_tpu.planner.golden import (
+            GOLDEN_SPEC_ACCEPT, GOLDEN_SPEC_K,
+        )
+        from flashmoe_tpu.planner.model import (
+            speculate_break_even, speculate_tokens_per_step,
+        )
+
+        points[f"decode_accept_rate[{name},d={GOLDEN_D},{gen},"
+               f"spec=k{GOLDEN_SPEC_K}]"] = {
+            "value": round(speculate_break_even(
+                cfg, GOLDEN_D, gen, verify_tokens=GOLDEN_SPEC_K), 4),
+            "unit": "accept_rate",
+        }
+        points[f"spec_tokens_per_step[{name},d={GOLDEN_D},{gen},"
+               f"spec=k{GOLDEN_SPEC_K}]"] = {
+            "value": round(speculate_tokens_per_step(
+                GOLDEN_SPEC_ACCEPT, GOLDEN_SPEC_K), 4),
+            "unit": "tokens_per_step",
         }
     # brownout shed fraction at the default BrownoutConfig against the
     # reference flood: deterministic hysteresis arithmetic — retuning

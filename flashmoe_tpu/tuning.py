@@ -112,12 +112,14 @@ def measured_path_latencies(gen: str | None = None, **shape) -> dict:
     The ``wire`` / ``wire_combine`` / ``wire_dcn`` keys (EP payload
     compression, ``MoEConfig.wire_dtype`` family — ``wire_dcn`` is the
     cross-slice hop override), the ``chunks`` key (chunked a2a
-    pipeline depth, ``MoEConfig.a2a_chunks``) and the ``quant`` key
-    (quantized expert weight store, ``MoEConfig.expert_quant``) are
-    matched STRICTLY with implicit ``"off"`` / ``1`` defaults on both
-    sides: a latency measured with compression, chunking, or int8
-    weights on is never applied to a run without it — and a legacy
-    entry without the keys never applies to one that has them.
+    pipeline depth, ``MoEConfig.a2a_chunks``), the ``quant`` key
+    (quantized expert weight store, ``MoEConfig.expert_quant``) and
+    the ``spec`` key (speculative verify span, ``"v<k>"`` for a
+    ``verify_tokens=k`` decode measurement) are matched STRICTLY with
+    implicit ``"off"`` / ``1`` defaults on both sides: a latency
+    measured with compression, chunking, int8 weights, or a
+    speculative span on is never applied to a run without it — and a
+    legacy entry without the keys never applies to one that has them.
 
     The planner's measured-winner override
     (:mod:`flashmoe_tpu.planner.select`) consults this: a committed
@@ -137,7 +139,7 @@ def measured_path_latencies(gen: str | None = None, **shape) -> dict:
         if any(str(m.pop(wk, dv)) != str(shape.get(wk, dv))
                for wk, dv in (("wire", "off"), ("wire_combine", "off"),
                               ("wire_dcn", "off"), ("chunks", 1),
-                              ("quant", "off"))):
+                              ("quant", "off"), ("spec", "off"))):
             continue
         if all(shape.get(kk) == v for kk, v in m.items()):
             if path not in best or len(m) > best[path][0]:
@@ -159,7 +161,8 @@ ENTRY_SCHEMA = {
 #: keys an entry ``match`` dict may constrain (shape facts + the
 #: measurement-identity knobs the lookups compare strictly)
 MATCH_KEYS = {"h", "i", "e", "k", "s", "d", "cap", "dtype", "path",
-              "wire", "wire_combine", "wire_dcn", "chunks", "quant"}
+              "wire", "wire_combine", "wire_dcn", "chunks", "quant",
+              "spec"}
 
 
 def validate_entries(doc) -> list[str]:
@@ -199,7 +202,7 @@ def validate_entries(doc) -> list[str]:
                     f"{where}: unknown match key {mk!r}; known: "
                     f"{sorted(MATCH_KEYS)}")
             elif mk in ("dtype", "path", "wire", "wire_combine",
-                        "wire_dcn", "quant"):
+                        "wire_dcn", "quant", "spec"):
                 if not isinstance(mv, str):
                     problems.append(
                         f"{where}: match.{mk} must be a string, got "

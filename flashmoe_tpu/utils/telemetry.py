@@ -72,6 +72,11 @@ DECISION_NAMES: dict[str, str] = {
         "the controller drained (sustained-idle fabric) or returned "
         "(sustained queue pressure) a decode replica in the fabric "
         "router's rotation",
+    "controller.spec_morph":
+        "the controller switched speculative decoding off after the "
+        "fleet acceptance EMA ran below the planner's break-even "
+        "acceptance for the debounce window (token streams unchanged "
+        "by construction — the morph costs zero tokens)",
     "controller.wire_morph":
         "the controller flipped the DCN-hop wire dtype after sustained "
         "a2a-leg dominance on a multi-slice job",
@@ -185,7 +190,12 @@ DECISION_NAMES: dict[str, str] = {
         "buys (flashmoe_tpu/quant/)",
     "serve.retire":
         "a request completed (stop token or max length) with its "
-        "TTFT/TPOT",
+        "TTFT/TPOT (plus per-request draft-acceptance stats when "
+        "speculation is configured)",
+    "serve.spec":
+        "speculative decoding lifecycle: armed at engine build, "
+        "morph_on/morph_off at a controller (or operator) toggle — "
+        "with the SpecConfig knobs or the morph reason",
     "serve.trace":
         "a request's trace closed at retirement: trace_id, span count, "
         "evictions, end-to-end duration (telemetry_plane/tracing.py)",
@@ -232,6 +242,13 @@ SPAN_NAMES: dict[str, str] = {
         "to the decode replica",
     "serve.decode":
         "serving engine: one continuous-batching decode step",
+    "serve.draft":
+        "serving engine: host-side n-gram drafting over the per-slot "
+        "suffix-match tables (speculative decode's propose phase)",
+    "serve.verify":
+        "serving engine: one speculative verify forward scoring "
+        "draft_tokens+1 positions per slot (replaces serve.decode on "
+        "steps where anything was drafted)",
     "serve.queued":
         "request trace: queue wait from arrival (or eviction — "
         "``resumed``) to admission; the visible eviction gap",

@@ -214,6 +214,47 @@ def test_cli_serve_flag_exclusivity(monkeypatch, capsys):
         capsys.readouterr()
 
 
+def test_cli_speculate_plumbs_and_guards(monkeypatch, capsys):
+    """--speculate K threads into serve_load_sweep(speculate=K)
+    (gated end-to-end by tests/test_serving.py), and fail-fasts where
+    it would be silently dropped: without --serve, under --fabric
+    (whose dispatch returns before the serve lane), and at K < 1."""
+    import sys as _sys
+
+    import bench
+    from flashmoe_tpu.serving import loadgen
+
+    seen = {}
+
+    def fake_sweep(loads, *, speculate=None, **kw):
+        seen["speculate"] = speculate
+        return [{"metric": "serve_load[every=4,B=2,req=3,spec=k3]",
+                 "value": 120.0, "unit": "tokens_per_sec",
+                 "vs_baseline": 1.0, "ttft_ms_p50": 5.0,
+                 "tpot_ms_p50": 1.0, "completed": 3}]
+
+    monkeypatch.setattr(loadgen, "serve_load_sweep", fake_sweep)
+    monkeypatch.setattr(_sys, "argv",
+                        ["bench.py", "--serve", "--speculate", "3",
+                         "--serve-loads", "4", "--serve-requests", "3",
+                         "--serve-batch", "2", "--deadline", "0"])
+    bench.main()
+    assert seen == {"speculate": 3}
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert ",spec=k3]" in rec["metric"]
+
+    for argv in [
+        ["bench.py", "--speculate", "3"],           # needs --serve
+        ["bench.py", "--fabric", "--speculate", "3"],
+        ["bench.py", "--serve", "--speculate", "0"],
+    ]:
+        monkeypatch.setattr(_sys, "argv", argv)
+        with pytest.raises(SystemExit) as e:
+            bench.main()
+        assert e.value.code == 2, argv
+        capsys.readouterr()
+
+
 def test_cli_tiles_flag_exclusivity(monkeypatch, capsys):
     """--tiles fail-fasts on knobs/modes the rowwin tile sweep would
     silently ignore (the --profile/--ckpt/--serve contract)."""

@@ -557,3 +557,91 @@ def test_wire_morph_slices_autodetect(monkeypatch, devices):
     # detection must never block a step boundary: garbage mock -> 1
     monkeypatch.setenv("FLASHMOE_MOCK_SLICES", "banana")
     assert detected_slices() == 1
+
+
+# ----------------------------------------------------------------------
+# Speculation morph trigger (ISSUE 20)
+# ----------------------------------------------------------------------
+
+def _spec_ctrl(**cc):
+    base = dict(debounce_steps=2, cooldown_steps=4, baseline_steps=2,
+                ema_decay=0.5, enable_spec_morph=True,
+                spec_accept_floor=0.5)
+    base.update(cc)
+    return _ctrl(ccfg=ControllerConfig(**base))
+
+
+def test_spec_morph_fires_after_debounce_with_budget():
+    from flashmoe_tpu.runtime.controller import SpecMorphAction
+
+    c, m = _spec_ctrl()
+    # no-draft observations (None) never debounce toward a morph
+    c.observe_spec(0, None)
+    assert c._spec_lo_run == 0
+    c.observe_spec(0, 0.2)
+    assert c.maybe_morph_spec(1) is None      # below the window
+    c.observe_spec(1, 0.9)                    # recovery resets the run
+    assert c._spec_lo_run == 0
+    c.observe_spec(2, 0.2)
+    c.observe_spec(3, 0.1)
+    act = c.maybe_morph_spec(4)
+    assert isinstance(act, SpecMorphAction) and act.kind == "off"
+    assert act.trigger == "accept_low"
+    rec = m.last_decision("controller.spec_morph")
+    assert rec is not None and rec["kind"] == "off"
+    assert rec["break_even"] == 0.5
+    assert c.spec_morphs_used == 1
+    assert c.snapshot()["budgets"]["spec_morph"] == 0
+    # budget spent: sustained low acceptance never double-fires
+    for s in range(10, 20):
+        c.observe_spec(s, 0.0)
+    assert c.maybe_morph_spec(20) is None
+
+
+def test_spec_morph_respects_cooldown_and_spec_off():
+    c, m = _spec_ctrl(spec_morph_budget=2)
+    c.observe_spec(0, 0.1)
+    c.observe_spec(1, 0.1)
+    assert c.maybe_morph_spec(2) is not None
+    # inside the cooldown window: suppressed (and logged once)
+    c.observe_spec(3, 0.1)
+    c.observe_spec(4, 0.1)
+    assert c.maybe_morph_spec(4) is None
+    cd = [d for d in m.decisions
+          if d["decision"] == "controller.cooldown"
+          and d.get("trigger") == "spec"]
+    assert len(cd) == 1
+    # spec already off: never acts, whatever the run length
+    c.observe_spec(20, 0.0)
+    c.observe_spec(21, 0.0)
+    assert c.maybe_morph_spec(22, spec_on=False) is None
+    # disabled trigger: no action either
+    c2, _ = _spec_ctrl(enable_spec_morph=False)
+    c2.observe_spec(0, 0.0)
+    c2.observe_spec(1, 0.0)
+    assert c2.maybe_morph_spec(2) is None
+
+
+def test_spec_floor_resolution_and_state_roundtrip():
+    # no configured floor: the planner break-even feeds the trigger
+    c, _ = _spec_ctrl(spec_accept_floor=None)
+    c.observe_spec(0, 0.3, break_even=0.4)
+    assert c._spec_lo_run == 1
+    c.observe_spec(1, 0.3, break_even=0.2)    # above break-even: reset
+    assert c._spec_lo_run == 0
+    # neither floor nor break-even: observation folds EMA, no trigger
+    c.observe_spec(2, 0.1)
+    assert c._spec_lo_run == 0
+    assert c.spec_accept_ema is not None
+    with pytest.raises(ValueError, match="spec_accept_floor"):
+        ControllerConfig(spec_accept_floor=1.5)
+    # persistence: spec_morphs_used survives a state roundtrip and
+    # stays monotonic
+    a, _ = _spec_ctrl()
+    a.observe_spec(0, 0.1)
+    a.observe_spec(1, 0.1)
+    assert a.maybe_morph_spec(2) is not None
+    b, _ = _spec_ctrl()
+    b.load_state_dict(a.state_dict())
+    assert b.spec_morphs_used == 1
+    assert b.maybe_morph_spec(10) is None     # budget rides the state

@@ -725,3 +725,102 @@ def test_serving_fault_drill_recovers(fault):
     elif fault == "lease_torn_write":
         assert ev["lease_repairs"] >= 1 and ev["torn_bytes"] > 0
         assert ev["restored_epoch"] == 1 and ev["failovers"] >= 1
+
+
+# ----------------------------------------------------------------------
+# Speculative decoding under faults (ISSUE 20)
+# ----------------------------------------------------------------------
+
+def _spec_serve(k: int = 3) -> ServeConfig:
+    from flashmoe_tpu.serving.speculate import SpecConfig
+
+    return dataclasses.replace(SERVE, speculate=SpecConfig(draft_tokens=k))
+
+
+@pytest.fixture(scope="module")
+def spec_trace():
+    """Repetitive prompts (tiled bigram motifs): the n-gram drafter has
+    suffix matches to propose from, so the fault drills exercise real
+    acceptance instead of the empty-draft fallthrough."""
+    return build_requests(6, vocab=CFG.vocab_size, prompt_len=8,
+                          max_new=6, seed=3, arrival_every=1,
+                          repetitive=True)
+
+
+@pytest.fixture(scope="module")
+def spec_baseline(params, spec_trace):
+    """Gold standard for the speculative drills: the same trace through
+    one uninterrupted NON-speculative engine — exact rejection sampling
+    must hold through crashes and morphs, not just clean runs."""
+    reqs, arrivals = spec_trace
+    eng = ServingEngine(params, CFG, SERVE, metrics_obj=Metrics())
+    out = eng.run(reqs, arrivals)
+    eng.close()
+    return out
+
+
+@pytest.mark.slow
+def test_fabric_crash_migration_spec_bit_equal(params, spec_trace,
+                                               spec_baseline, mock2):
+    """A replica dies mid-stream with speculation armed: the migrated
+    requests re-prefill on the adopter, the DraftState rebuilds from
+    ``prompt + emitted``, and every stream stays token-bit-equal to the
+    non-speculative single-engine oracle."""
+    reqs, arrivals = spec_trace
+    mx = Metrics()
+    fab = ServingFabric(params, CFG, _spec_serve(), metrics_obj=mx,
+                        vclock=VirtualClock(),
+                        fault_plan=FaultPlan("replica_crash", step=3,
+                                             expert=0))
+    door = FrontDoor(fab)
+    out = door.run(reqs, arrivals)
+    errs = door.validate()
+    summ = fab.summary()
+    door.close()
+    fab.close()
+    _assert_bit_equal(out, spec_baseline)
+    assert errs == []
+    crash = [d for d in mx.decisions
+             if d["decision"] == "fabric.replica_crash"]
+    assert len(crash) == 1 and crash[0]["replica"] == 0
+    assert [d for d in mx.decisions
+            if d["decision"] == "fabric.migrate"]
+    # not vacuous: drafts flowed (and some were accepted) fleet-wide
+    assert summ["spec"]["spec_drafted"] > 0
+    assert summ["spec"]["spec_accepted"] > 0
+    assert summ["spec"]["spec_on"] == [True, True]
+
+
+@pytest.mark.slow
+def test_fabric_spec_morph_drill_zero_lost_tokens(params, spec_trace,
+                                                  spec_baseline, mock2):
+    """The controller drill the ISSUE names: a fleet running with an
+    unreachable acceptance floor morphs speculation OFF on every
+    replica at once (a per-replica split would fork measurement
+    identity), loses zero tokens, and stays bit-equal — exact
+    rejection sampling makes the morph free."""
+    from flashmoe_tpu.runtime.controller import (
+        ControllerConfig, RuntimeController,
+    )
+
+    reqs, arrivals = spec_trace
+    mx = Metrics()
+    cc = ControllerConfig(enable_spec_morph=True, spec_accept_floor=0.99,
+                          debounce_steps=1, cooldown_steps=2)
+    ctl = RuntimeController(CFG, cc, metrics=mx)
+    fab = ServingFabric(params, CFG, _spec_serve(), metrics_obj=mx,
+                        vclock=VirtualClock(), controller=ctl)
+    door = FrontDoor(fab)
+    out = door.run(reqs, arrivals)
+    errs = door.validate()
+    summ = fab.summary()
+    door.close()
+    fab.close()
+    _assert_bit_equal(out, spec_baseline)        # zero lost tokens
+    assert errs == []
+    assert ctl.spec_morphs_used == 1
+    assert summ["spec"]["spec_on"] == [False, False]
+    morphs = [d for d in mx.decisions
+              if d["decision"] == "controller.spec_morph"]
+    assert len(morphs) == 1
+    assert morphs[0]["trigger"] == "accept_low"

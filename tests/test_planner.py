@@ -575,3 +575,108 @@ def test_select_path_keys_measurements_on_dcn_wire(tmp_path,
                          record=False)
     assert sel_on.mode == "measured"
     assert sel_on.measured_ms == pytest.approx(0.001)
+
+
+# ----------------------------------------------------------------------
+# Speculative decoding economics (ISSUE 20)
+# ----------------------------------------------------------------------
+
+def test_golden_tables_cover_speculate_dimension():
+    """CI gate for the speculation axis: every golden (config, gen)
+    point carries the k=GOLDEN_SPEC_K verify pricing, the uplift at
+    the golden acceptance beats 1x, and the break-even acceptance sits
+    below the golden acceptance — speculation must PAY at the golden
+    point, or the regenerated table fails review here."""
+    from flashmoe_tpu.planner.golden import (
+        GOLDEN_CONFIGS, GOLDEN_SPEC_ACCEPT, GOLDEN_SPEC_K,
+    )
+
+    frozen = load_golden()
+    assert set(frozen["speculate"]) == set(GOLDEN_CONFIGS)
+    for cname, gens in frozen["speculate"].items():
+        assert set(gens) == set(GOLDEN_GENS), cname
+        for gen, pt in gens.items():
+            assert pt["verify_tokens"] == GOLDEN_SPEC_K
+            assert pt["accept_rate"] == GOLDEN_SPEC_ACCEPT
+            # the verify span must price as a span, not k+1 steps
+            assert 1.0 <= pt["cost_ratio"] < GOLDEN_SPEC_K + 1
+            assert pt["uplift"] > 1.0, (cname, gen)
+            assert pt["break_even_accept"] < GOLDEN_SPEC_ACCEPT, \
+                (cname, gen)
+            assert pt["pays"] is True, (cname, gen)
+
+
+def test_speculate_model_math():
+    """E[n] closed form, bisection break-even, and the verify_tokens
+    pricing axis on decode shapes."""
+    from flashmoe_tpu.planner.model import (
+        decode_shape, predict_paths, speculate_break_even,
+        speculate_tokens_per_step, speculate_uplift,
+    )
+
+    cfg = BENCH_CONFIGS["reference"].replace(ep=8)
+    # E[n](p) = (1 - p^(k+1)) / (1 - p); exact at the endpoints
+    assert speculate_tokens_per_step(0.0, 3) == pytest.approx(1.0)
+    assert speculate_tokens_per_step(1.0, 3) == pytest.approx(4.0)
+    assert speculate_tokens_per_step(0.5, 3) == pytest.approx(1.875)
+    # verify_tokens multiplies decode tokens AFTER d-rounding
+    s1 = decode_shape(cfg, 8, decode_tokens=64)
+    s4 = decode_shape(cfg, 8, decode_tokens=64, verify_tokens=3)
+    assert s4.tokens == 4 * s1.tokens
+    up = speculate_uplift(cfg, 8, "v5e", decode_tokens=64,
+                          verify_tokens=3, accept_rate=0.7)
+    assert up["cost_ratio"] == pytest.approx(
+        up["tk_ms"] / up["t1_ms"])
+    assert up["uplift"] == pytest.approx(
+        up["tokens_per_step"] / up["cost_ratio"])
+    be = speculate_break_even(cfg, 8, "v5e", decode_tokens=64,
+                              verify_tokens=3)
+    # the break-even acceptance exactly repays the verify span
+    eq = speculate_uplift(cfg, 8, "v5e", decode_tokens=64,
+                          verify_tokens=3, accept_rate=be)
+    assert eq["uplift"] == pytest.approx(1.0, abs=1e-6)
+    with pytest.raises(ValueError, match="verify_tokens"):
+        decode_shape(cfg, 8, verify_tokens=-1)
+    with pytest.raises(ValueError, match="decode"):
+        predict_paths(cfg, 8, "v5e", verify_tokens=3)  # not decode mode
+
+
+def test_select_path_spec_measurement_identity(tmp_path, monkeypatch):
+    """The spec tag rides the measured-latency shape key: a spec=off
+    tuning entry must never price a verify-span selection, and
+    vice versa."""
+    import json as _json
+
+    from flashmoe_tpu import tuning
+    from flashmoe_tpu.planner.select import (
+        _shape_key, select_path, spec_tag,
+    )
+
+    assert spec_tag(None) == "off" and spec_tag(3) == "v3"
+    cfg = BENCH_CONFIGS["reference"].replace(ep=8)
+    key_off = _shape_key(cfg, 8)
+    key_on = _shape_key(cfg, 8, spec="v3")
+    assert key_off["spec"] == "off" and key_on["spec"] == "v3"
+    assert {k: v for k, v in key_on.items() if k != "spec"} \
+        == {k: v for k, v in key_off.items() if k != "spec"}
+    # a measured entry tagged spec=off only matches the off selection
+    # (the decode selection keys on the DECODE-shaped config: s = the
+    # per-step token count, not the training sequence)
+    from flashmoe_tpu.planner.model import decode_shape
+
+    dkey = _shape_key(decode_shape(cfg, 8, 64), 8)
+    path = str(tmp_path / "v5e.json")
+    with open(path, "w") as f:
+        _json.dump({"generation": "v5e", "entries": [
+            {"kernel": "path_latency",
+             "match": dict(dkey, path="collective"),
+             "measured_ms": 0.001}]}, f)
+    monkeypatch.setenv("FLASHMOE_TUNING_FILE", path)
+    tuning._load.cache_clear()
+    sel_off = select_path(cfg, 8, "v5e", mode="decode",
+                          decode_tokens=64, record=False)
+    sel_on = select_path(cfg, 8, "v5e", mode="decode",
+                         decode_tokens=64, verify_tokens=3,
+                         record=False)
+    assert sel_off.mode == "measured"
+    assert sel_on.mode == "predicted"

@@ -615,3 +615,220 @@ def test_engine_summary_uses_sketches_not_decision_scan(params,
     assert mx.sketches["serve.step_ms"].n == s["steps"]
     # windowed rates ride the gauges
     assert "serve.tokens_per_s" in mx.gauges
+
+
+# ----------------------------------------------------------------------
+# Speculative multi-token decoding (ISSUE 20)
+# ----------------------------------------------------------------------
+
+def _spec_serve(speculate=None, **kw):
+    from flashmoe_tpu.serving.speculate import SpecConfig
+
+    base = dict(max_batch=4, page_size=8, num_pages=32,
+                max_pages_per_slot=4, ctx_bucket_pages=1,
+                prompt_bucket=8)
+    base.update(kw)
+    if speculate is not None:
+        base["speculate"] = SpecConfig(draft_tokens=speculate)
+    return ServeConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def spec_prompts():
+    """Repetitive prompts (tiled bigram motifs): the n-gram drafter
+    has suffix matches to propose from, so the verify path actually
+    exercises acceptance instead of the empty-draft fallthrough."""
+    motifs = jax.random.randint(jax.random.PRNGKey(7), (8, 2), 0,
+                                CFG.vocab_size)
+    return jnp.asarray([[int(motifs[i][j % 2]) for j in range(8)]
+                        for i in range(8)])
+
+
+def test_speculative_decode_bit_equal_greedy(params, spec_prompts):
+    """The exactness acceptance: speculation on emits token-bit-equal
+    streams to the non-speculative oracle, while actually accepting
+    drafts (not vacuously passing through the no-draft path)."""
+    engine = ServingEngine(params, CFG, _spec_serve(speculate=3))
+    out = engine.run(_requests(spec_prompts, 4, max_new=8),
+                     arrivals=[0, 0, 1, 2])
+    snap = engine.spec_snapshot()
+    assert snap["spec_drafted"] > 0, "drill never drafted — vacuous"
+    assert snap["spec_accepted"] > 0
+    assert snap["spec_tokens_per_step"] > 1.0
+    for i in range(4):
+        np.testing.assert_array_equal(
+            np.asarray(out[i]),
+            _oracle(params, spec_prompts, i, max_new=8))
+
+
+def test_speculative_decode_bit_equal_sampled(params, spec_prompts):
+    """Exact rejection sampling: the per-request fold_in key stream
+    makes speculative output bit-equal at every sampling arm, and
+    bit-equal across batch-composition changes (staggered arrivals vs
+    all-at-once)."""
+    def run(spec, arrivals=None):
+        engine = ServingEngine(params, CFG, _spec_serve(
+            speculate=3 if spec else None))
+        reqs = _requests(spec_prompts, 4, max_new=6, temperature=0.8,
+                         top_k=20, top_p=0.9, seed=21)
+        return engine.run(reqs, arrivals=arrivals)
+
+    base = run(False)
+    spec = run(True)
+    stagger = run(True, arrivals=[0, 1, 2, 3])
+    for i in range(4):
+        np.testing.assert_array_equal(np.asarray(base[i]),
+                                      np.asarray(spec[i]))
+        np.testing.assert_array_equal(np.asarray(base[i]),
+                                      np.asarray(stagger[i]))
+
+
+def test_speculative_eviction_bit_equal(params, spec_prompts):
+    """A starved pool evicts mid-speculation; the DraftState rebuilds
+    from the resumed prompt (prompt + delivered tokens), and the
+    re-prefilled request completes bit-equal."""
+    mx = Metrics()
+    engine = ServingEngine(params, CFG,
+                           _spec_serve(speculate=3, num_pages=8),
+                           metrics_obj=mx)
+    out = engine.run(_requests(spec_prompts, 4, max_new=10))
+    s = engine.summary()
+    assert s["evictions"] > 0 and s["completed"] == 4
+    assert s["spec_drafted"] > 0
+    for i in range(4):
+        np.testing.assert_array_equal(
+            np.asarray(out[i]),
+            _oracle(params, spec_prompts, i, max_new=10))
+
+
+def test_spec_stats_ride_retire_and_flight_records(params,
+                                                   spec_prompts):
+    """Per-request acceptance stats land on serve.retire decisions and
+    serve_request flight records; per-step spec_tokens/spec_on ride
+    serve_step records; summary() and the health snapshot carry the
+    fleet numbers."""
+    mx = Metrics()
+    recorder = FlightRecorder()
+    engine = ServingEngine(params, CFG, _spec_serve(speculate=3),
+                           metrics_obj=mx, recorder=recorder)
+    engine.run(_requests(spec_prompts, 4, max_new=8))
+    retires = [d for d in mx.decisions
+               if d["decision"] == "serve.retire"]
+    assert retires and all("spec_drafted" in d and "accept_rate" in d
+                           for d in retires)
+    req_recs = [r for r in recorder.records
+                if r.get("kind") == "serve_request"]
+    assert req_recs and all("spec_accepted" in r for r in req_recs)
+    steps = [r for r in recorder.records
+             if r.get("kind") == "serve_step"]
+    assert steps and all("spec_tokens" in r and "spec_on" in r
+                         for r in steps)
+    assert sum(r["spec_tokens"] for r in steps) \
+        == engine.spec_snapshot()["spec_accepted"]
+    s = engine.summary()
+    assert s["spec_drafted"] == engine.spec_snapshot()["spec_drafted"]
+    assert engine._health_snapshot()["spec"]["spec_on"] is True
+    # the recorder dump reduces to the same numbers through the
+    # host-side consumer twin
+    from flashmoe_tpu.ops.stats import speculation_summary
+
+    agg = speculation_summary(recorder.records)
+    assert agg["spec_drafted"] == s["spec_drafted"]
+    assert agg["spec_accepted"] == s["spec_accepted"]
+    assert agg["spec_steps"] > 0
+
+
+def test_spec_off_graph_and_config_identity(params, prompts):
+    """speculate=None is the off value: the ServeConfig is EQUAL to
+    one that never named the field (one jit cache entry), the engine
+    builds no verify function, and the decode step's traced graph is
+    byte-identical before vs after a speculative engine ran."""
+    from flashmoe_tpu.serving.engine import _paged_decode_step
+    from flashmoe_tpu.staticcheck.graph import jaxpr_text
+
+    assert _spec_serve() == _spec_serve(speculate=None)
+
+    def decode_jaxpr():
+        sv = _spec_serve()
+        k, v = init_paged_cache(CFG, sv.num_pages, sv.page_size)
+        toks = jnp.zeros((sv.max_batch,), jnp.int32)
+        pos = jnp.zeros((sv.max_batch,), jnp.int32)
+        tables = jnp.zeros((sv.max_batch, sv.ctx_bucket_pages),
+                           jnp.int32)
+        closed = jax.make_jaxpr(
+            lambda *a: _paged_decode_step.__wrapped__(params, CFG, *a))
+        return jaxpr_text(closed(k, v, toks, tables, pos).jaxpr)
+
+    before = decode_jaxpr()
+    engine = ServingEngine(params, CFG, _spec_serve(speculate=2))
+    engine.run(_requests(prompts, 1, max_new=3))
+    assert decode_jaxpr() == before
+    plain = ServingEngine(params, CFG, _spec_serve())
+    assert plain._spec is None
+    assert "spec_drafted" not in plain.summary()
+
+
+def test_set_speculate_morphs_and_validates(params, spec_prompts):
+    """set_speculate flips the live engine off/on with serve.spec
+    decisions; enabling on an engine that never armed a SpecConfig is
+    a config error."""
+    mx = Metrics()
+    engine = ServingEngine(params, CFG, _spec_serve(speculate=3),
+                           metrics_obj=mx)
+    engine.set_speculate(False, reason="drill")
+    assert engine._spec is None
+    out = engine.run(_requests(spec_prompts, 2, max_new=6))
+    assert engine.spec_snapshot()["spec_drafted"] == 0
+    for i in range(2):
+        np.testing.assert_array_equal(
+            np.asarray(out[i]),
+            _oracle(params, spec_prompts, i, max_new=6))
+    morphs = [d for d in mx.decisions
+              if d["decision"] == "serve.spec"
+              and d.get("event") == "morph_off"]
+    assert len(morphs) == 1
+    plain = ServingEngine(params, CFG, _spec_serve())
+    with pytest.raises(ValueError, match="speculate"):
+        plain.set_speculate(True)
+
+
+def test_serve_load_sweep_speculate_arm():
+    """bench --serve --speculate contract: spec=kN metric identity,
+    per-record acceptance stats, the equal-SLO baseline TPOT
+    comparison, and the asserted exactness bit."""
+    recs = serve_load_sweep([3], n_requests=4, max_batch=2, max_new=5,
+                            speculate=2)
+    assert len(recs) == 1
+    r = recs[0]
+    assert ",spec=k2]" in r["metric"]
+    assert r["bit_equal_to_baseline"] is True
+    assert r["spec_drafted"] >= r["spec_accepted"] >= 0
+    assert 0.0 <= r["accept_rate"] <= 1.0
+    assert r["spec_tokens_per_step"] >= 1.0
+    assert r["baseline_tpot_ms_p50"] is not None
+    assert "baseline_outputs" not in r   # payload stays JSON-sized
+
+
+def test_draft_state_ngram_index():
+    """DraftState unit: suffix-match drafting, continuation fallback
+    to the previous occurrence, sync after external token appends."""
+    from flashmoe_tpu.serving.speculate import (
+        DraftState, SpecConfig, spec_stats_fields,
+    )
+
+    spec = SpecConfig(draft_tokens=3, ngram=2)
+    ds = DraftState(spec, [1, 2, 3, 1, 2])
+    assert ds.draft(3) == [3, 1, 2]        # continue the seen bigram
+    ds.extend([3])                         # now ...1 2 3; suffix [2,3]
+    assert ds.draft(3) == [1, 2, 3]
+    ds.sync([1, 2, 3, 1, 2, 3, 9, 9])      # external append resyncs
+    assert ds.draft(2) == []               # suffix [9,9] never seen
+    with pytest.raises(ValueError):
+        SpecConfig(draft_tokens=0)
+    with pytest.raises(ValueError):
+        SpecConfig(ngram=0)
+    with pytest.raises(ValueError):
+        SpecConfig(source="magic")
+    f = spec_stats_fields(4, 3, 2)
+    assert f["accept_rate"] == 0.75
+    assert f["spec_tokens_per_step"] == 2.5

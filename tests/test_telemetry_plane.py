@@ -460,7 +460,8 @@ def test_reference_points_deterministic():
 
     a, b = reg.reference_points(), reg.reference_points()
     assert a == b and len(a) >= 3
-    assert all(v["unit"] in ("ms", "hidden_frac", "frac")
+    assert all(v["unit"] in ("ms", "hidden_frac", "frac",
+                             "accept_rate", "tokens_per_step")
                and v["value"] > 0 for v in a.values())
     # the measured-latency plane rides along (PR 17): a virtual-clock
     # TTFT and a hidden-fraction point per golden config
@@ -475,6 +476,15 @@ def test_reference_points_deterministic():
                v["unit"] == "ms" for k, v in a.items())
     shed = a["fabric_shed_frac[brownout,reference]"]
     assert shed["unit"] == "frac" and 0 < shed["value"] < 1.0
+    # ISSUE 20: the speculative-decode plane — a modeled break-even
+    # acceptance and an expected-tokens-per-verify-step point per
+    # golden config
+    assert any(k.startswith("decode_accept_rate[") and
+               v["unit"] == "accept_rate" and 0 < v["value"] < 1.0
+               for k, v in a.items())
+    assert any(k.startswith("spec_tokens_per_step[") and
+               v["unit"] == "tokens_per_step" and v["value"] > 1.0
+               for k, v in a.items())
 
 
 def test_check_regression_zero_baseline_direction_aware():

@@ -73,6 +73,9 @@ def test_schema_validation_catches_malformed_entries(tmp_path):
         {"kernel": "path_latency",
          "match": {"path": "fused", "h": 2048, "d": 8},
          "measured_ms": 2.71},
+        {"kernel": "path_latency",
+         "match": {"path": "collective", "h": 2048, "spec": "v3"},
+         "measured_ms": 3.4},       # speculative verify span (ISSUE 20)
     ]}
     assert tuning.validate_entries(ok) == []
 
@@ -93,6 +96,9 @@ def test_schema_validation_catches_malformed_entries(tmp_path):
     assert bad({"kernel": "path_latency",
                 "match": {"path": "fused"},
                 "measured_ms": "fast"})               # non-numeric ms
+    assert bad({"kernel": "path_latency",
+                "match": {"path": "fused", "spec": 3},
+                "measured_ms": 2.0})                  # spec tag not str
     assert bad({"kernel": "fused_ep", "match": {"h": 2048}})  # no set
     assert tuning.validate_entries({"entries": "nope"})
     assert tuning.validate_entries([])                # not an object
